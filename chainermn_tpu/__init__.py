@@ -1,0 +1,29 @@
+"""chainermn_tpu — a TPU-native distributed training framework.
+
+A ground-up rebuild of the capabilities of ChainerMN (reference:
+codealphago/chainermn, a mirror of pfnet/chainermn) on the JAX/XLA stack:
+device meshes + compiled collectives over ICI/DCN instead of MPI + NCCL,
+functional transforms instead of define-by-run hooks, and `pjit`/`shard_map`
+SPMD instead of an mpiexec process-per-GPU model.
+
+Public surface mirrors the reference's top level
+(chainermn/__init__.py per SURVEY.md §2.5; reference mount was empty):
+``create_communicator``, ``create_multi_node_optimizer``, ``scatter_dataset``,
+``functions``, ``links``, the multi-node iterator/evaluator/checkpointer
+factories, and the global exception hook.
+"""
+
+from chainermn_tpu.comm import (
+    CommunicatorBase,
+    XlaCommunicator,
+    create_communicator,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CommunicatorBase",
+    "XlaCommunicator",
+    "create_communicator",
+    "__version__",
+]

@@ -1,0 +1,10 @@
+from .base import CommunicatorBase
+from .factory import create_communicator
+from .xla import DEFAULT_AXIS, XlaCommunicator
+
+__all__ = [
+    "CommunicatorBase",
+    "XlaCommunicator",
+    "create_communicator",
+    "DEFAULT_AXIS",
+]

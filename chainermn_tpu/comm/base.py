@@ -1,0 +1,202 @@
+"""Abstract communicator contract.
+
+TPU-native re-design of the reference's ``CommunicatorBase``
+(reference: chainermn/communicators/communicator_base.py — module path cited from
+SURVEY.md; the reference mount was empty, so line numbers are unavailable).
+
+The reference contract is an MPI-rank-per-GPU object exposing
+``rank/size/intra_rank/intra_size/inter_rank/inter_size``, array collectives,
+pickled-object collectives, and model-level ``bcast_data``/``allreduce_grad``.
+
+This rebuild keeps the exact surface but maps it onto the JAX single-controller
+SPMD model:
+
+* **Device ranks** are coordinates in a :class:`jax.sharding.Mesh`. ``size`` is
+  the number of devices the communicator spans; ``rank`` is the global index of
+  this process's first addressable device (0 in single-process runs, where the
+  driver acts on behalf of every rank).
+* **intra/inter** mirror the reference's node topology: ``intra`` = devices
+  local to this process (ICI-connected), ``inter`` = across processes (DCN).
+* **Array collectives** are dual-mode: called on tracers (inside ``jit`` /
+  ``shard_map``) they lower to XLA collectives (``psum``, ``all_gather``,
+  ``all_to_all``, ``ppermute``) over the communicator's mesh axes; called on
+  concrete arrays they operate on *stacked per-rank* values (leading axis ==
+  ``size``) and are jitted so XLA inserts the real collectives for sharded
+  inputs.
+* **Object collectives** ride the host object plane (``jax.distributed`` /
+  multihost utilities), whose world is the *process* space — the analog of the
+  reference's MPI object plane.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional, Sequence
+
+
+class CommunicatorBase(abc.ABC):
+    """Abstract base for all communicators.
+
+    Matches the reference ABC's method surface (SURVEY.md §2.1). Concrete
+    subclasses: :class:`~chainermn_tpu.comm.xla.XlaCommunicator` and its
+    single-device degenerate forms.
+    """
+
+    # ------------------------------------------------------------------
+    # topology
+    # ------------------------------------------------------------------
+
+    @property
+    @abc.abstractmethod
+    def size(self) -> int:
+        """Total number of ranks (devices) this communicator spans."""
+
+    @property
+    @abc.abstractmethod
+    def rank(self) -> int:
+        """Global rank of this process's first local device."""
+
+    @property
+    @abc.abstractmethod
+    def intra_rank(self) -> int:
+        """Rank within this process's local (ICI-connected) device group."""
+
+    @property
+    @abc.abstractmethod
+    def intra_size(self) -> int:
+        """Number of local devices (reference: GPUs per node)."""
+
+    @property
+    @abc.abstractmethod
+    def inter_rank(self) -> int:
+        """Process index (reference: node index)."""
+
+    @property
+    @abc.abstractmethod
+    def inter_size(self) -> int:
+        """Number of processes (reference: node count)."""
+
+    # ------------------------------------------------------------------
+    # mesh access (rebuild-specific, the idiomatic seam)
+    # ------------------------------------------------------------------
+
+    @property
+    @abc.abstractmethod
+    def mesh(self):
+        """The :class:`jax.sharding.Mesh` backing this communicator."""
+
+    @property
+    @abc.abstractmethod
+    def axis_names(self) -> tuple:
+        """Mesh axis names this communicator reduces over (ordered)."""
+
+    # ------------------------------------------------------------------
+    # sub-communicators
+    # ------------------------------------------------------------------
+
+    @abc.abstractmethod
+    def split(self, color: int, key: int) -> "CommunicatorBase":
+        """Create a sub-communicator (reference: ``MPI_Comm_split`` semantics).
+
+        In the mesh world a split is a factorization: ranks with equal
+        ``color`` form a group. Only regular partitions (equal-sized,
+        stride-contiguous groups) are supported, because irregular groups
+        cannot be expressed as a mesh axis.
+        """
+
+    # ------------------------------------------------------------------
+    # array collectives (dual-mode: in-graph on tracers, driver on arrays)
+    # ------------------------------------------------------------------
+
+    @abc.abstractmethod
+    def allreduce(self, x, op: str = "sum"):
+        """All-reduce. Tracer: psum-family over mesh axes. Concrete: reduce
+        the stacked leading rank axis."""
+
+    @abc.abstractmethod
+    def bcast(self, x, root: int = 0):
+        """Broadcast from ``root``."""
+
+    @abc.abstractmethod
+    def allgather(self, x):
+        """Gather every rank's array on every rank (stacked on axis 0)."""
+
+    @abc.abstractmethod
+    def alltoall(self, x):
+        """All-to-all: rank r's chunk s goes to rank s's slot r."""
+
+    @abc.abstractmethod
+    def gather(self, x, root: int = 0):
+        """Gather to ``root`` (single-controller: the driver holds it)."""
+
+    @abc.abstractmethod
+    def scatter(self, x, root: int = 0):
+        """Scatter ``root``'s stacked array across ranks."""
+
+    @abc.abstractmethod
+    def send(self, x, dest: int, tag: int = 0):
+        """Point-to-point send (in-graph only; lowers to collective-permute)."""
+
+    @abc.abstractmethod
+    def recv(self, src: int, tag: int = 0):
+        """Point-to-point recv (in-graph only; lowers to collective-permute)."""
+
+    # ------------------------------------------------------------------
+    # object collectives (process-plane; reference: pickled MPI messages)
+    # ------------------------------------------------------------------
+
+    @abc.abstractmethod
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        ...
+
+    @abc.abstractmethod
+    def gather_obj(self, obj: Any, root: int = 0) -> Optional[Sequence[Any]]:
+        ...
+
+    @abc.abstractmethod
+    def allgather_obj(self, obj: Any) -> Sequence[Any]:
+        ...
+
+    @abc.abstractmethod
+    def allreduce_obj(self, obj: Any, op: str = "sum") -> Any:
+        ...
+
+    @abc.abstractmethod
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        ...
+
+    @abc.abstractmethod
+    def recv_obj(self, src: int, tag: int = 0) -> Any:
+        ...
+
+    # ------------------------------------------------------------------
+    # model-level ops (the reference's headline API)
+    # ------------------------------------------------------------------
+
+    @abc.abstractmethod
+    def bcast_data(self, params, root: int = 0):
+        """Synchronize a parameter pytree across ranks (reference:
+        ``bcast_data(model)`` packing params into one buffer and
+        broadcasting). Single-controller: replicate over the mesh."""
+
+    @abc.abstractmethod
+    def allreduce_grad(self, grads, op: str = "mean"):
+        """All-reduce a gradient pytree (reference: the hot
+        ``allreduce_grad(model)`` pack → NCCL allreduce → unpack × 1/N).
+        Lowered to per-leaf ``psum``/``pmean`` fused by XLA; optional
+        communication dtype (``allreduce_grad_dtype``) casts before the
+        collective and back after."""
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+
+    def finalize(self) -> None:
+        """Release resources (reference: NCCL comm destroy). No-op here —
+        XLA owns collective lifetimes."""
+
+    @property
+    def is_master(self) -> bool:
+        """True on the process that should do logging/reporting (the
+        reference convention ``if comm.rank == 0:``)."""
+        return self.inter_rank == 0

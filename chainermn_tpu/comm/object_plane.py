@@ -1,0 +1,154 @@
+"""Host-side object plane.
+
+TPU-native replacement for the reference's pickled-MPI object transport
+(reference: chainermn/communicators/mpi_communicator_base.py — object ops
+``bcast_obj``/``gather_obj``/``send_obj``/``recv_obj`` built on mpi4py's
+pickle-based messaging; module path per SURVEY.md §2.1, reference mount empty).
+
+Here the object world is the set of JAX *processes* (hosts), matching the
+reference's node-level object plane. Transport:
+
+* single process — trivial identity paths (the common single-controller case);
+* multi-process — pickled payloads ride ``jax.experimental.multihost_utils``
+  (uint8 tensors over the DCN collective fabric) for collectives, and the
+  ``jax.distributed`` coordinator's KV store for point-to-point, chunked to
+  bound coordinator message sizes (the analog of the reference's 256 MB
+  ``max_buf_len`` chunking in scatter_dataset).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional
+
+import numpy as np
+
+import jax
+
+# KV-store chunk bound: coordinator values are strings; keep chunks modest.
+_KV_CHUNK = 4 * 1024 * 1024
+
+
+def _client():
+    """The jax.distributed coordinator client, or None."""
+    try:
+        from jax._src import distributed  # noqa: internal, only path to KV store
+
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
+class ObjectPlane:
+    """Process-plane object collectives."""
+
+    def __init__(self) -> None:
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+        self._p2p_seq = {}
+
+    # -- collectives ----------------------------------------------------
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        if self.process_count == 1:
+            return obj
+        from jax.experimental import multihost_utils
+
+        payload = pickle.dumps(obj) if self.process_index == root else b""
+        # Ship (length, data) as uint8; broadcast_one_to_all roots at process 0,
+        # so first hop payloads to process 0 over the KV store if root differs.
+        # The relay key carries a sequence number like every other KV channel:
+        # the coordinator rejects duplicate keys, and a reused key would hand
+        # process 0 the previous bcast's stale payload.
+        if root != 0:
+            seq = self._next_seq(f"bcast_root/{root}")
+            if self.process_index == root:
+                self._kv_put(f"bcast_root/{root}/{seq}", payload)
+            if self.process_index == 0:
+                payload = self._kv_get(f"bcast_root/{root}/{seq}")
+        n = np.array([len(payload)], dtype=np.int64)
+        n = multihost_utils.broadcast_one_to_all(n)
+        buf = np.zeros(int(n[0]), dtype=np.uint8)
+        if self.process_index == 0 and payload:
+            buf = np.frombuffer(payload, dtype=np.uint8).copy()
+        buf = multihost_utils.broadcast_one_to_all(buf)
+        return pickle.loads(buf.tobytes())
+
+    def allgather_obj(self, obj: Any) -> List[Any]:
+        if self.process_count == 1:
+            return [obj]
+        # KV-store allgather: every process publishes, barriers, reads all.
+        client = _client()
+        seq = self._next_seq("allgather")
+        key = f"og/ag/{seq}"
+        self._kv_put(f"{key}/{self.process_index}", pickle.dumps(obj))
+        client.wait_at_barrier(f"{key}/barrier", 60_000)
+        return [
+            pickle.loads(self._kv_get(f"{key}/{i}"))
+            for i in range(self.process_count)
+        ]
+
+    def gather_obj(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
+        out = self.allgather_obj(obj)
+        return out if self.process_index == root else None
+
+    def scatter_obj(self, objs: Optional[List[Any]], root: int = 0) -> Any:
+        if self.process_count == 1:
+            assert objs is not None
+            return objs[0]
+        client = _client()
+        seq = self._next_seq("scatter")
+        key = f"og/sc/{seq}"
+        if self.process_index == root:
+            assert objs is not None and len(objs) == self.process_count
+            for i, o in enumerate(objs):
+                if i != root:
+                    self._kv_put(f"{key}/{i}", pickle.dumps(o))
+        client.wait_at_barrier(f"{key}/barrier", 600_000)
+        if self.process_index == root:
+            return objs[self.process_index]
+        return pickle.loads(self._kv_get(f"{key}/{self.process_index}"))
+
+    # -- point-to-point -------------------------------------------------
+
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        if self.process_count == 1:
+            raise RuntimeError("send_obj with a single process has no peer")
+        seq = self._next_seq(f"p2p/{self.process_index}/{dest}/{tag}")
+        self._kv_put(
+            f"og/p2p/{self.process_index}/{dest}/{tag}/{seq}", pickle.dumps(obj)
+        )
+
+    def recv_obj(self, src: int, tag: int = 0) -> Any:
+        if self.process_count == 1:
+            raise RuntimeError("recv_obj with a single process has no peer")
+        seq = self._next_seq(f"p2p/{src}/{self.process_index}/{tag}")
+        data = self._kv_get(
+            f"og/p2p/{src}/{self.process_index}/{tag}/{seq}", timeout_ms=600_000
+        )
+        return pickle.loads(data)
+
+    # -- kv helpers (chunked; coordinator values are bounded strings) ----
+
+    def _next_seq(self, channel: str) -> int:
+        n = self._p2p_seq.get(channel, 0)
+        self._p2p_seq[channel] = n + 1
+        return n
+
+    def _kv_put(self, key: str, data: bytes) -> None:
+        client = _client()
+        nchunks = max(1, (len(data) + _KV_CHUNK - 1) // _KV_CHUNK)
+        client.key_value_set(f"{key}/n", str(nchunks))
+        for c in range(nchunks):
+            chunk = data[c * _KV_CHUNK : (c + 1) * _KV_CHUNK]
+            client.key_value_set_bytes(f"{key}/{c}", chunk)
+
+    def _kv_get(self, key: str, timeout_ms: int = 600_000) -> bytes:
+        client = _client()
+        nchunks = int(client.blocking_key_value_get(f"{key}/n", timeout_ms))
+        parts = []
+        for c in range(nchunks):
+            parts.append(
+                client.blocking_key_value_get_bytes(f"{key}/{c}", timeout_ms)
+            )
+        return b"".join(parts)

@@ -1,0 +1,39 @@
+"""Test bootstrap: 8 virtual CPU devices with REAL XLA collectives.
+
+The reference tests distributed behavior by running the whole pytest suite
+under ``mpiexec -n 2`` on one host — real MPI/NCCL, tiny world, no mocks
+(SURVEY.md §4). The TPU-native analog: force 8 host-platform devices so a
+single process gets a real 8-device mesh whose collectives are real XLA
+collectives, then run everything SPMD under jit/shard_map.
+"""
+
+import os
+
+# Must run before jax initializes its backends. The environment may pin
+# JAX_PLATFORMS to a TPU plugin (axon); tests always run on the virtual CPU
+# mesh, so force it both via env and via jax.config (the latter wins even if
+# a site hook rewrites the env var on import).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def n_devices():
+    return jax.device_count()
+
+
+@pytest.fixture()
+def comm():
+    import chainermn_tpu
+
+    return chainermn_tpu.create_communicator("xla")
