@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Benchmark entry point — prints ONE JSON line.
+
+Measures data-parallel training throughput (images/sec) of the current
+flagship model on the available devices. The north-star metric
+(BASELINE.md) is ImageNet ResNet-50 images/sec/chip with ≥90% scaling
+v5e-8 → v5e-256; on a single chip this reports absolute images/sec/chip,
+with ``vs_baseline`` = 1.0 until a reference figure exists to normalize
+against (BASELINE.json's ``published`` field is empty).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.training.step import make_data_parallel_train_step
+
+    comm = chainermn_tpu.create_communicator("xla")
+    n_dev = comm.size
+
+    try:
+        from chainermn_tpu.models.resnet import ResNet50
+
+        model = ResNet50(num_classes=1000)
+        image = np.zeros((2, 224, 224, 3), np.float32)
+        per_device_batch = 32
+        name = "resnet50"
+        mutable = ("batch_stats",)
+    except ImportError:
+        from chainermn_tpu.models import MLP
+
+        model = MLP(n_units=1000, n_out=10)
+        image = np.zeros((2, 28, 28), np.float32)
+        per_device_batch = 512
+        name = "mlp"
+        mutable = None
+
+    global_batch = per_device_batch * n_dev
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, image, *(() if mutable is None else ()))
+    params = comm.bcast_data(variables["params"])
+    extra = (
+        {k: comm.bcast_data(variables[k]) for k in mutable}
+        if mutable else None
+    )
+
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm
+    )
+    state = (
+        (params, opt.init(params), extra)
+        if mutable else (params, opt.init(params))
+    )
+    step = make_data_parallel_train_step(model, opt, comm, mutable=mutable)
+
+    shape = (global_batch,) + image.shape[1:]
+    x = np.random.RandomState(0).rand(*shape).astype(np.float32)
+    y = np.random.RandomState(1).randint(
+        0, 10 if name == "mlp" else 1000, size=(global_batch,)
+    ).astype(np.int32)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axes = comm.axis_names
+    dsh = NamedSharding(comm.mesh, P(axes if len(axes) > 1 else axes[0]))
+    x = jax.device_put(x, dsh)
+    y = jax.device_put(y, dsh)
+
+    # warmup (compile) + steady state
+    state, m = step(state, x, y)
+    jax.block_until_ready(m)
+    n_iters = 20 if name == "mlp" else 10
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, m = step(state, x, y)
+    jax.block_until_ready(m)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = n_iters * global_batch / dt
+    per_chip = images_per_sec / n_dev
+    print(json.dumps({
+        "metric": f"{name}_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

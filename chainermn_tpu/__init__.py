@@ -18,6 +18,22 @@ from chainermn_tpu.comm import (
     XlaCommunicator,
     create_communicator,
 )
+from chainermn_tpu import functions, links
+from chainermn_tpu.datasets import (
+    create_empty_dataset,
+    scatter_dataset,
+)
+from chainermn_tpu.extensions import (
+    create_multi_node_checkpointer,
+    create_multi_node_evaluator,
+    install_global_except_hook,
+)
+from chainermn_tpu.iterators import (
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+from chainermn_tpu.links import MultiNodeBatchNormalization, MultiNodeChainList
+from chainermn_tpu.optimizers import create_multi_node_optimizer
 
 __version__ = "0.1.0"
 
@@ -25,5 +41,17 @@ __all__ = [
     "CommunicatorBase",
     "XlaCommunicator",
     "create_communicator",
+    "create_multi_node_optimizer",
+    "scatter_dataset",
+    "create_empty_dataset",
+    "create_multi_node_iterator",
+    "create_synchronized_iterator",
+    "create_multi_node_evaluator",
+    "create_multi_node_checkpointer",
+    "install_global_except_hook",
+    "functions",
+    "links",
+    "MultiNodeBatchNormalization",
+    "MultiNodeChainList",
     "__version__",
 ]

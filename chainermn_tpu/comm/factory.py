@@ -40,7 +40,6 @@ def create_communicator(
     mesh: Optional[Mesh] = None,
     allreduce_grad_dtype: Optional[Any] = None,
     axes=None,
-    **kwargs,
 ) -> XlaCommunicator:
     """Create a communicator by name.
 

@@ -89,8 +89,20 @@ class ObjectPlane:
         ]
 
     def gather_obj(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
-        out = self.allgather_obj(obj)
-        return out if self.process_index == root else None
+        if self.process_count == 1:
+            return [obj]
+        # like allgather, but only root pays the N reads
+        client = _client()
+        seq = self._next_seq("gather")
+        key = f"og/g/{seq}"
+        self._kv_put(f"{key}/{self.process_index}", pickle.dumps(obj))
+        client.wait_at_barrier(f"{key}/barrier", 600_000)
+        if self.process_index != root:
+            return None
+        return [
+            pickle.loads(self._kv_get(f"{key}/{i}"))
+            for i in range(self.process_count)
+        ]
 
     def scatter_obj(self, objs: Optional[List[Any]], root: int = 0) -> Any:
         if self.process_count == 1:

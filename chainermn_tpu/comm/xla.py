@@ -181,7 +181,23 @@ class XlaCommunicator(CommunicatorBase):
         the common closed forms ``('block', k)`` / ``('stride', k)``.
         Only regular partitions are supported — they are the ones expressible
         as a mesh axis factorization.
+
+        ``key`` (MPI rank-ordering within each group) is honored only in its
+        order-preserving form — ``None`` or monotonically increasing (the
+        ubiquitous ``key=rank`` idiom). Reordering keys would permute shard
+        identities inside a compiled mesh axis, which has no XLA analog.
         """
+        if key is not None:
+            try:
+                keys = list(key)
+            except TypeError:
+                keys = None  # scalar key: no ordering information to violate
+            if keys is not None and keys != sorted(keys):
+                raise NotImplementedError(
+                    "split(key=...) that reorders ranks within a group is "
+                    "not supported on a mesh; use the default rank order "
+                    "(key=None or key=rank)"
+                )
         n = self._size
         if isinstance(color, tuple) and color[0] in ("block", "stride"):
             kind, k = color

@@ -1,0 +1,119 @@
+"""Dataset scattering across the process plane.
+
+Reference: chainermn/datasets/scatter_dataset.py (SURVEY.md §2.5, §3.4; mount
+empty — module path citation). Root shuffles a global index permutation,
+splits the dataset into ``size`` contiguous sub-datasets, and ships each
+shard as pickled ≤256 MB chunks over MPI; ``create_empty_dataset`` stubs
+ranks that hold no data.
+
+TPU-native mapping: ranks-that-load-data are *processes* (hosts), not chips —
+device-level sharding happens per global batch inside the compiled step. So
+``scatter_dataset`` splits across ``comm.inter_size`` and ships shards over
+the host object plane (chunked KV-store transport, the analog of the MPI
+chunking); single-process programs get the whole (shuffled) dataset, which is
+exactly the single-controller contract. Variable-length Python samples
+(seq2seq) are supported — the object plane pickles anything.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from chainermn_tpu.comm.base import CommunicatorBase
+
+
+class SubDataset:
+    """A view of ``dataset`` at ``order[start:stop]`` (reference:
+    chainer.datasets.SubDataset semantics, local rebuild)."""
+
+    def __init__(self, dataset, order: Sequence[int]):
+        self._dataset = dataset
+        self._order = np.asarray(order, dtype=np.int64)
+
+    def __len__(self):
+        return len(self._order)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._dataset[int(j)] for j in self._order[i]]
+        return self._dataset[int(self._order[i])]
+
+
+def split_indices(
+    n: int,
+    k: int,
+    shuffle: bool = False,
+    seed: Optional[int] = None,
+    force_equal_length: bool = True,
+):
+    """Root's index plan: permutation of ``range(n)`` split into ``k`` parts.
+
+    ``force_equal_length`` pads the tail shards by wrapping (reference
+    behavior keeping every rank's epoch the same length).
+    """
+    order = np.arange(n)
+    if shuffle:
+        rng = np.random.RandomState(seed)
+        rng.shuffle(order)
+    if force_equal_length:
+        per = -(-n // k)  # ceil
+        padded = np.resize(order, per * k)  # wraps around, reference-style
+        return [padded[r * per:(r + 1) * per] for r in range(k)]
+    base = n // k
+    rem = n % k
+    out, at = [], 0
+    for r in range(k):
+        ln = base + (1 if r < rem else 0)
+        out.append(order[at:at + ln])
+        at += ln
+    return out
+
+
+def scatter_dataset(
+    dataset,
+    comm: CommunicatorBase,
+    shuffle: bool = False,
+    root: int = 0,
+    seed: Optional[int] = None,
+    max_buf_len: int = 256 * 1024 * 1024,
+    force_equal_length: bool = True,
+):
+    """Split ``dataset`` across the process plane; return this process's shard.
+
+    Single-process: the whole dataset (shuffled view if requested) — device
+    sharding is the compiled step's job. Multi-process: the root computes the
+    index plan and scatters index arrays (cheap) — every process is assumed
+    to reach the same storage, the common TPU-pod case; processes without
+    shared storage should ship samples via ``comm.scatter_obj`` themselves.
+    ``max_buf_len`` is accepted for API parity; chunking lives in the object
+    plane transport.
+    """
+    k = comm.inter_size
+    if k == 1:
+        # one process: it is the root whatever `root` says
+        my = split_indices(len(dataset), k, shuffle, seed,
+                           force_equal_length)[0]
+    else:
+        if comm.inter_rank == root:
+            plans = split_indices(len(dataset), k, shuffle, seed,
+                                  force_equal_length)
+        else:
+            plans = None
+        my = comm.scatter_obj(plans, root=root)
+    return SubDataset(dataset, my)
+
+
+class _EmptyDataset:
+    def __len__(self):
+        return 0
+
+    def __getitem__(self, i):
+        raise IndexError("empty dataset")
+
+
+def create_empty_dataset(dataset=None):
+    """Stub dataset for processes that hold no data (reference:
+    create_empty_dataset in chainermn/datasets/__init__.py)."""
+    return _EmptyDataset()

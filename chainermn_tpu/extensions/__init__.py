@@ -1,0 +1,112 @@
+"""Training-loop extensions: evaluator, persistent-state sync, abort hook.
+
+Reference: chainermn/extensions/__init__.py and chainermn/global_except_hook.py
+(SURVEY.md §2.5; mount empty — module path citations).
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+from typing import Any, Callable
+
+import jax
+
+from chainermn_tpu.comm.base import CommunicatorBase
+from .checkpoint import MultiNodeCheckpointer, create_multi_node_checkpointer
+
+__all__ = [
+    "create_multi_node_evaluator",
+    "AllreducePersistent",
+    "allreduce_persistent",
+    "MultiNodeCheckpointer",
+    "create_multi_node_checkpointer",
+    "install_global_except_hook",
+]
+
+
+def create_multi_node_evaluator(actual_evaluator, communicator: CommunicatorBase):
+    """Each process evaluates its shard; scalar results are averaged across
+    the process plane (reference: allreduce_obj mean of the result dict).
+
+    ``actual_evaluator`` is any callable returning a dict of scalar metrics;
+    the wrapper keeps its attributes (reference delegates the same way).
+    """
+
+    class _MultiNodeEvaluator:
+        def __init__(self, ev, comm):
+            self._ev = ev
+            self._comm = comm
+
+        def __call__(self, trainer=None, *args, **kwargs):
+            # Run the inner evaluator WITHOUT the trainer so it cannot
+            # publish un-reduced local metrics; publish only the job-wide
+            # means (the whole point of the multi-node evaluator).
+            local = self._ev(*args, **kwargs)
+            scalars = {k: float(v) for k, v in local.items()}
+            reduced = self._comm.allreduce_obj(scalars, "mean")
+            if trainer is not None:
+                trainer.observation.update(reduced)
+            return reduced
+
+        def __getattr__(self, name):
+            return getattr(self._ev, name)
+
+    return _MultiNodeEvaluator(actual_evaluator, communicator)
+
+
+def allreduce_persistent(state, communicator: CommunicatorBase, op: str = "mean"):
+    """Average persistent (non-gradient) arrays — BN running stats — across
+    ranks so snapshots and eval see consistent values.
+
+    Reference: AllreducePersistent extension (chainermn/extensions/). Call on
+    the state pytree inside the jitted step (varying leaves get reduced) or
+    on driver-level stacked arrays.
+    """
+    return communicator.allreduce_grad(state, op)
+
+
+class AllreducePersistent:
+    """Extension-object form for trainer integration (reference API shape)."""
+
+    def __init__(self, model_state_getter: Callable[[], Any],
+                 communicator: CommunicatorBase,
+                 model_state_setter: Callable[[Any], None]):
+        self._get = model_state_getter
+        self._set = model_state_setter
+        self._comm = communicator
+
+    def __call__(self, trainer=None):
+        self._set(allreduce_persistent(self._get(), self._comm))
+
+
+def install_global_except_hook(communicator: CommunicatorBase = None):
+    """Fail-fast job abort: any uncaught exception tears the whole job down.
+
+    Reference: chainermn/global_except_hook.py — prints the traceback and
+    calls MPI_Abort so no rank is left deadlocked inside a collective. Here:
+    print, best-effort shutdown of the jax.distributed coordinator (which
+    poisons every other process's barriers/collectives), hard-exit. With one
+    process it degrades to print-and-exit, still avoiding a wedged TPU
+    runtime on partially-enqueued programs.
+    """
+
+    prev_hook = sys.excepthook
+
+    def _hook(exc_type, exc_value, exc_tb):
+        try:
+            sys.stderr.write("chainermn_tpu: uncaught exception — aborting job\n")
+            traceback.print_exception(exc_type, exc_value, exc_tb)
+            sys.stderr.flush()
+        finally:
+            try:
+                if jax.process_count() > 1:
+                    jax.distributed.shutdown()
+            except Exception:
+                pass
+            import os
+
+            os._exit(13)
+
+    sys.excepthook = _hook
+    return prev_hook

@@ -1,0 +1,16 @@
+from .collective import allgather, allreduce, alltoall, bcast, gather, scatter
+from .point_to_point import DelegateVariable, pseudo_connect, recv, send, transfer
+
+__all__ = [
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "bcast",
+    "gather",
+    "scatter",
+    "send",
+    "recv",
+    "transfer",
+    "pseudo_connect",
+    "DelegateVariable",
+]

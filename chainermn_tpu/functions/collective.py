@@ -1,0 +1,56 @@
+"""Differentiable collective communication functions.
+
+Reference: chainermn/functions/collective_communication.py (SURVEY.md §2.3;
+mount empty — module path citation): Chainer Functions for allgather (bwd:
+reduce-scatter), alltoall (bwd: alltoall), bcast (bwd: gather+sum at root),
+gather/scatter (bwd: each other).
+
+JAX's collectives are already differentiable with exactly these transposes —
+``all_gather`` ↔ ``psum_scatter``, ``all_to_all`` self-transposes, ``psum``'s
+transpose broadcasts — so these wrappers only add the reference's API shape
+(communicator-first signatures) on top of the in-graph comm ops. All of them
+must be called inside a jitted/shard_map program on the communicator's mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def allgather(communicator, x):
+    """Every shard receives every shard's ``x``, stacked on axis 0.
+    Backward: reduce-scatter of the output gradient."""
+    return communicator.allgather(x)
+
+
+def alltoall(communicator, x):
+    """Chunk-exchange: shard r's chunk s goes to shard s's slot r.
+    ``x``'s leading axis must be divisible by the communicator size.
+    Backward: the reverse alltoall."""
+    return communicator.alltoall(x)
+
+
+def bcast(communicator, x, root: int = 0):
+    """Broadcast ``x`` from shard ``root`` to all shards.
+    Backward: gradient psum arriving at root."""
+    return communicator.bcast(x, root=root)
+
+
+def gather(communicator, x, root: int = 0):
+    """Gather every shard's ``x``. In uniform SPMD the gathered stack is
+    materialized on every shard (the root distinction is a host-side
+    concern). Backward: scatter."""
+    return communicator.gather(x, root=root)
+
+
+def scatter(communicator, x, root: int = 0):
+    """Each shard takes its own slice of the (replicated) stacked ``x``.
+    Backward: gather."""
+    return communicator.scatter(x, root=root)
+
+
+def allreduce(communicator, x, op: str = "sum"):
+    """All-reduce (not in the reference's functions module — it exposes this
+    only at the communicator level — included for orthogonality)."""
+    return communicator.allreduce(x, op)

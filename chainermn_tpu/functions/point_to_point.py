@@ -1,0 +1,127 @@
+"""Differentiable point-to-point communication.
+
+Reference: chainermn/functions/point_to_point_communication.py (SURVEY.md
+§2.3; mount empty — module path citation). There, ``send`` is a Chainer
+Function whose forward does a blocking MPI send and returns a *delegate
+variable* (a dummy output carrying the autograd edge), and ``recv``'s
+backward sends the gradient back — deadlock-free only if every rank issues
+its calls in a globally consistent order.
+
+TPU-native redesign: a transfer is a compiled ``lax.ppermute`` (XLA
+collective-permute over ICI) executed by *all* shards of a ``shard_map``
+program. JAX's ppermute is already differentiable — its transpose is the
+reversed permutation — so the reference's hand-written reverse-communication
+backward falls out of autodiff, and the runtime-deadlock class is eliminated:
+the schedule is fixed at trace time.
+
+The delegate-variable pattern survives as :class:`DelegateVariable`, a pytree
+carrying the in-flight value between the ``send`` and ``recv`` calls, so
+reference-shaped code (``phi = send(x, comm, dest); ...; y = recv(comm, src,
+delegate_variable=phi)``) works unchanged inside the traced program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.tree_util.register_pytree_node_class
+class DelegateVariable:
+    """Carries an in-flight transferred value between send() and recv().
+
+    Reference: the dummy output of Send keeping the send on the backward
+    graph (point_to_point_communication.py). Here it simply holds the
+    ppermuted array (valid on the destination shard, zeros elsewhere), so
+    data dependence — and therefore the reverse transfer in backward — is
+    explicit.
+    """
+
+    def __init__(self, data, src: int, dest: int):
+        self.data = data
+        self.src = src
+        self.dest = dest
+
+    def tree_flatten(self):
+        return (self.data,), (self.src, self.dest)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0], aux[1])
+
+
+def transfer(x, communicator, edges: Sequence[Tuple[int, int]]):
+    """Move shard-local values along ``edges`` = [(src_rank, dst_rank), ...].
+
+    Every shard executes this (SPMD). A shard that is a dst in ``edges``
+    receives the src's value; all other shards receive zeros. Lowered to one
+    XLA collective-permute; differentiable (transpose = reversed edges).
+    """
+    axis = communicator.axis_name
+    return jax.tree_util.tree_map(
+        lambda l: lax.ppermute(l, axis, list(edges)), x
+    )
+
+
+def send(x, communicator, rank: int, *, self_rank: Optional[int] = None,
+         tag: int = 0) -> DelegateVariable:
+    """Send ``x`` from shard ``self_rank`` to shard ``rank``.
+
+    Single-controller SPMD note: the reference infers the sender from the
+    calling process; in a compiled uniform program the sender must be named
+    statically — pass ``self_rank`` (MultiNodeChainList does this for you).
+    Returns a :class:`DelegateVariable` to hand to :func:`recv`.
+    """
+    if self_rank is None:
+        raise ValueError(
+            "send() inside a compiled SPMD program needs the sending rank "
+            "spelled out: send(x, comm, dest, self_rank=src)"
+        )
+    moved = transfer(x, communicator, [(self_rank, rank)])
+    return DelegateVariable(moved, src=self_rank, dest=rank)
+
+
+def recv(communicator, rank: int, delegate_variable: Optional[DelegateVariable] = None,
+         tag: int = 0):
+    """Receive the value sent from shard ``rank``.
+
+    Pass the matching :class:`DelegateVariable` from :func:`send`. The
+    returned array is the sent value on the destination shard (zeros on
+    others — uniform SPMD); gradients flow back through the reversed
+    collective-permute automatically.
+    """
+    if delegate_variable is None:
+        raise ValueError(
+            "recv() in the compiled SPMD world consumes the DelegateVariable "
+            "returned by the matching send(); free-standing recv has no "
+            "eager channel to read from"
+        )
+    if delegate_variable.src != rank:
+        raise ValueError(
+            f"recv(rank={rank}) does not match delegate sent from "
+            f"rank {delegate_variable.src}"
+        )
+    return delegate_variable.data
+
+
+def pseudo_connect(delegate_variable: DelegateVariable, *actual_variables):
+    """Merge a delegate's graph edge into real variables.
+
+    Reference: chainermn/functions/pseudo_connect.py — keeps a dangling
+    send's backward alive when its output is unused. Functional autodiff
+    makes data dependence explicit, so this adds a symbolic zero tying the
+    delegate into the returned value(s): backward will traverse the transfer.
+    """
+    def tie(v):
+        zero = jnp.zeros((), v.dtype)
+        for leaf in jax.tree_util.tree_leaves(delegate_variable.data):
+            zero = zero + jnp.sum(leaf * 0).astype(v.dtype)
+        return v + zero
+
+    if not actual_variables:
+        return delegate_variable
+    out = tuple(tie(v) for v in actual_variables)
+    return out[0] if len(out) == 1 else out

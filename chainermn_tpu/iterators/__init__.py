@@ -1,0 +1,129 @@
+"""Iterators: serial base + multi-node wrappers.
+
+Reference: chainermn/iterators/ (SURVEY.md §2.5; mount empty — module path
+citation): ``create_multi_node_iterator`` has the master rank iterate and
+broadcast each batch (for data that cannot be scattered);
+``create_synchronized_iterator`` seeds every rank's RNG identically so ranks
+draw the same batches. The serial iterator itself came from Chainer; a local
+equivalent lives here so the framework is standalone.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from chainermn_tpu.comm.base import CommunicatorBase
+
+
+class SerialIterator:
+    """Epoch-aware batch iterator (local rebuild of the Chainer contract:
+    ``next()``, ``epoch``, ``is_new_epoch``, ``reset()``)."""
+
+    def __init__(self, dataset, batch_size: int, repeat: bool = True,
+                 shuffle: bool = True, seed: Optional[int] = None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self._repeat = repeat
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.epoch = 0
+        self.is_new_epoch = False
+        self._at = 0
+        self._order = self._new_order()
+
+    def _new_order(self):
+        order = np.arange(len(self.dataset))
+        if self._shuffle:
+            self._rng.shuffle(order)
+        return order
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = len(self.dataset)
+        if self._at >= n:
+            if not self._repeat and self.epoch >= 1:
+                raise StopIteration
+        batch_idx = self._order[self._at:self._at + self.batch_size]
+        self._at += self.batch_size
+        self.is_new_epoch = self._at >= n
+        if self.is_new_epoch:
+            self.epoch += 1
+            if self._repeat:
+                short = self.batch_size - len(batch_idx)
+                self._order = self._new_order()
+                self._at = 0
+                if short:
+                    batch_idx = np.concatenate([batch_idx, self._order[:short]])
+                    self._at = short
+            elif len(batch_idx) == 0:
+                raise StopIteration
+        return [self.dataset[int(i)] for i in batch_idx]
+
+    next = __next__
+
+    @property
+    def epoch_detail(self):
+        return self.epoch + self._at / max(1, len(self.dataset))
+
+
+def create_multi_node_iterator(actual_iterator, communicator: CommunicatorBase,
+                               rank_master: int = 0):
+    """Master process iterates; every process receives the master's batch.
+
+    Reference: chainermn/iterators/multi_node_iterator.py. Here the batch
+    rides the host object plane; with one process it is a passthrough.
+    """
+    if communicator.inter_size == 1:
+        return actual_iterator
+    return _MultiNodeIterator(actual_iterator, communicator, rank_master)
+
+
+class _MultiNodeIterator:
+    def __init__(self, iterator, comm, rank_master):
+        self._it = iterator
+        self._comm = comm
+        self._master = rank_master
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._comm.inter_rank == self._master:
+            try:
+                batch = self._it.next()
+                payload = (batch, self._it.epoch, self._it.is_new_epoch, False)
+            except StopIteration:
+                payload = (None, None, None, True)
+            payload = self._comm.bcast_obj(payload, root=self._master)
+        else:
+            payload = self._comm.bcast_obj(None, root=self._master)
+        batch, epoch, is_new_epoch, stop = payload
+        if stop:
+            # keep the last valid epoch counters; callers may read them
+            raise StopIteration
+        self.epoch, self.is_new_epoch = epoch, is_new_epoch
+        return batch
+
+    next = __next__
+
+
+def create_synchronized_iterator(actual_iterator, communicator: CommunicatorBase):
+    """Synchronize shuffling RNGs so every process draws identical batches.
+
+    Reference: chainermn/iterators/_synchronized_iterator.py — the root's
+    seed is broadcast and every rank reseeds its iterator with it.
+    """
+    seed = communicator.bcast_obj(
+        int(np.random.RandomState().randint(0, 2**31 - 1)), root=0
+    )
+    if isinstance(actual_iterator, SerialIterator):
+        actual_iterator._rng = np.random.RandomState(seed)
+        actual_iterator.reset()
+    return actual_iterator

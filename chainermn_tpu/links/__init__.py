@@ -1,0 +1,4 @@
+from .batch_normalization import MultiNodeBatchNormalization
+from .chain_list import MultiNodeChainList
+
+__all__ = ["MultiNodeBatchNormalization", "MultiNodeChainList"]

@@ -1,0 +1,60 @@
+"""Cross-replica batch normalization.
+
+Reference: chainermn/links/multi_node_batch_normalization.py (SURVEY.md §2.4;
+mount empty — module path citation). The reference packs local ``mean`` and
+``sq-mean`` into one buffer and all-reduces it so BN statistics span every
+replica's batch; backward all-reduces the γ/β gradient terms; running
+averages are kept for inference.
+
+TPU-native form: a flax module whose statistics are ``pmean``-ed over the
+communicator's mesh axes *inside the compiled forward* — the backward sync
+falls out of ``psum``'s transpose, and XLA fuses the two stat reductions into
+one fused collective (the reference's manual packing). Built on
+``flax.linen.BatchNorm(axis_name=...)``, which implements exactly this
+cross-device moment reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MultiNodeBatchNormalization(nn.Module):
+    """BatchNorm whose batch statistics span all replicas.
+
+    Reference signature: ``MultiNodeBatchNormalization(size, comm, decay,
+    eps, dtype)``. ``size`` (the feature count) is inferred from the input in
+    flax and accepted only for API parity; ``comm`` supplies the mesh axes to
+    reduce over. Use inside a ``shard_map``/``pjit`` program whose mesh binds
+    those axes; ``use_running_average=True`` for inference.
+    """
+
+    comm: Any = None
+    size: Optional[int] = None           # parity only; flax infers features
+    decay: float = 0.9
+    eps: float = 2e-5
+    dtype: Optional[Any] = None
+    use_running_average: Optional[bool] = None
+    communication_backend: str = "auto"  # parity only; XLA is the backend
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = (
+            use_running_average
+            if use_running_average is not None
+            else self.use_running_average
+        )
+        axis_name = None
+        if self.comm is not None:
+            names = self.comm.axis_names
+            axis_name = names if len(names) > 1 else names[0]
+        return nn.BatchNorm(
+            use_running_average=bool(use_ra),
+            momentum=self.decay,
+            epsilon=self.eps,
+            dtype=self.dtype,
+            axis_name=axis_name,
+        )(x)

@@ -1,0 +1,23 @@
+"""MLP — the bring-up model (reference: examples/mnist/train_mnist.py's
+three-layer MLP; SURVEY.md §2.6 config #1)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """Reference example topology: 784 → n_units → n_units → n_out."""
+
+    n_units: int = 1000
+    n_out: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        return nn.Dense(self.n_out)(x)

@@ -1,0 +1,90 @@
+"""Multi-node optimizer wrapper.
+
+Reference: chainermn/optimizers/__init__.py (SURVEY.md §2.5; mount empty —
+module path citation). ``create_multi_node_optimizer(opt, comm)`` wraps any
+Chainer optimizer so ``update()`` runs ``communicator.allreduce_grad(model)``
+between backward and the inner update, and ``setup()`` broadcasts initial
+parameters. ``double_buffering=True`` overlaps step t-1's communication with
+step t's compute at the cost of one-step-stale gradients
+(``_DoubleBufferingOptimizer``).
+
+TPU-native form: the wrapper is an :class:`optax.GradientTransformation`
+whose ``update`` inserts the gradient all-reduce *inside the compiled step* —
+XLA's latency-hiding scheduler then overlaps the collective with adjacent
+compute automatically, which is what the reference's double-buffering thread
+approximated by hand. The stale-gradient mode is still available as an
+explicit opt-in (same accuracy caveats as the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import optax
+
+from chainermn_tpu.comm.base import CommunicatorBase
+
+
+class _DoubleBufferState(NamedTuple):
+    inner: Any
+    prev_grads: Any  # step t-1's reduced grads (applied this step)
+    is_first: Any    # scalar flag; first step applies zeros
+
+
+def create_multi_node_optimizer(
+    actual_optimizer: optax.GradientTransformation,
+    communicator: CommunicatorBase,
+    double_buffering: bool = False,
+    op: str = "mean",
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer with the gradient all-reduce.
+
+    Use exactly like the inner optimizer::
+
+        opt = create_multi_node_optimizer(optax.adam(1e-3), comm)
+        state = opt.init(params)              # inside or outside jit
+        updates, state = opt.update(grads, state, params)  # inside the step
+
+    ``update`` must run inside the jitted (shard_map/pjit) training step so
+    the all-reduce compiles into the program. ``allreduce_grad`` is
+    varying-axis-aware (see XlaCommunicator.allreduce_grad), so this is safe
+    both when autodiff already summed the gradients and when it did not.
+    """
+    if not double_buffering:
+
+        def init(params):
+            return actual_optimizer.init(params)
+
+        def update(grads, state, params=None, **extra):
+            grads = communicator.allreduce_grad(grads, op)
+            return actual_optimizer.update(grads, state, params, **extra)
+
+        return optax.GradientTransformation(init, update)
+
+    import jax.numpy as jnp
+
+    def init_db(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return _DoubleBufferState(
+            inner=actual_optimizer.init(params),
+            prev_grads=zeros,
+            is_first=jnp.array(True),
+        )
+
+    def update_db(grads, state, params=None, **extra):
+        # Reference semantics (_DoubleBufferingOptimizer): apply step t-1's
+        # reduced grads while step t's reduction is in flight; first step
+        # applies nothing. In one compiled program "in flight" is the XLA
+        # scheduler's overlap; the visible semantic is the one-step lag.
+        reduced = communicator.allreduce_grad(grads, op)
+        apply = jax.tree_util.tree_map(
+            lambda p: jnp.where(state.is_first, jnp.zeros_like(p), p),
+            state.prev_grads,
+        )
+        updates, inner = actual_optimizer.update(apply, state.inner, params, **extra)
+        return updates, _DoubleBufferState(
+            inner=inner, prev_grads=reduced, is_first=jnp.array(False)
+        )
+
+    return optax.GradientTransformation(init_db, update_db)

@@ -1,0 +1,4 @@
+from .trainer import StandardUpdater, Trainer
+from .reports import LogReport, PrintReport
+
+__all__ = ["Trainer", "StandardUpdater", "LogReport", "PrintReport"]

@@ -1,0 +1,38 @@
+"""Evaluator: run an eval step over a validation iterator.
+
+Wrap with chainermn_tpu.create_multi_node_evaluator for the reference's
+cross-process metric averaging (device-level averaging is already in-graph).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+
+class Evaluator:
+    def __init__(self, iterator_factory: Callable, eval_step: Callable,
+                 updater, converter=None):
+        from .trainer import default_converter
+
+        self._make_it = iterator_factory
+        self._eval_step = eval_step
+        self._updater = updater
+        self._converter = converter or default_converter
+
+    def __call__(self, trainer=None) -> Dict[str, float]:
+        it = self._make_it()
+        sums: Dict[str, float] = {}
+        n = 0
+        for batch in it:
+            arrays = self._converter(batch)
+            arrays = self._updater.shard_batch(arrays)
+            metrics = self._eval_step(self._updater.state, *arrays)
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+        out = {k: v / max(1, n) for k, v in sums.items()}
+        if trainer is not None:
+            trainer.observation.update(out)
+        return out

@@ -1,0 +1,128 @@
+"""Train/eval step factories: the framework's compiled hot path.
+
+Reference hot loop (SURVEY.md §3.1): forward/backward, pack grads, NCCL
+allreduce, unpack, optimizer update — four host-driven phases. Here the whole
+iteration is ONE compiled XLA program over the mesh: loss/grad, gradient
+all-reduce (vma-aware psum), optimizer update, and metric reduction, with
+XLA overlapping the collective against adjacent compute (what the
+reference's double-buffering thread did by hand).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def classifier_loss(model, params, x, y, train: bool = True,
+                    mutable=None, extra_vars=None, rngs=None):
+    """Softmax cross-entropy + accuracy for an (x, y) classifier."""
+    variables = {"params": params, **(extra_vars or {})}
+    if mutable:
+        logits, new_vars = model.apply(variables, x, mutable=mutable,
+                                       rngs=rngs)
+    else:
+        logits = model.apply(variables, x, rngs=rngs)
+        new_vars = {}
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+    return loss, (acc, new_vars)
+
+
+def make_data_parallel_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm,
+    loss_fn: Optional[Callable] = None,
+    mutable: Optional[Tuple[str, ...]] = None,
+    donate: bool = True,
+):
+    """Build the jitted data-parallel train step.
+
+    ``state = (params, opt_state)`` or ``(params, opt_state, extra_vars)``
+    when ``mutable`` names flax variable collections (e.g. BN
+    ``('batch_stats',)`` — their new values are pmean-synced across replicas,
+    the reference's MultiNodeBatchNormalization/AllreducePersistent
+    semantics). The optimizer should already wrap the communicator
+    (create_multi_node_optimizer); a plain optax optimizer also works when
+    autodiff inserts the psum (default shard_map mode).
+    """
+    lf = loss_fn or classifier_loss
+    mesh = comm.mesh
+    axes = comm.axis_names
+    dspec = P(axes if len(axes) > 1 else axes[0])
+
+    def local_step(state, x, y):
+        if mutable:
+            params, opt_state, extra = state
+        else:
+            params, opt_state = state
+            extra = None
+
+        def f(p):
+            return lf(model, p, x, y, train=True, mutable=mutable,
+                      extra_vars=extra)
+
+        (loss, (acc, new_vars)), grads = jax.value_and_grad(
+            f, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "main/loss": lax.pmean(loss, axes),
+            "main/accuracy": lax.pmean(acc, axes),
+        }
+        if mutable:
+            # replica-consistent persistent state (BN running stats)
+            new_extra = jax.tree_util.tree_map(
+                lambda v: lax.pmean(v, axes)
+                if jax.typeof(v).vma else v,
+                new_vars,
+            )
+            return (params, opt_state, new_extra), metrics
+        return (params, opt_state), metrics
+
+    n_state = 3 if mutable else 2
+    step = jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=((P(),) * n_state, dspec, dspec),
+            out_specs=((P(),) * n_state, P()),
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+    return step
+
+
+def make_eval_step(model, comm, loss_fn: Optional[Callable] = None,
+                   extra_vars_in_state: bool = False):
+    """Jitted eval step: (state, x, y) -> metrics dict (pmean-reduced)."""
+    lf = loss_fn or classifier_loss
+    mesh = comm.mesh
+    axes = comm.axis_names
+    dspec = P(axes if len(axes) > 1 else axes[0])
+
+    def local_eval(state, x, y):
+        params = state[0]
+        extra = state[2] if extra_vars_in_state else None
+        loss, (acc, _) = lf(model, params, x, y, train=False,
+                            mutable=None, extra_vars=extra)
+        return {
+            "validation/main/loss": lax.pmean(loss, axes),
+            "validation/main/accuracy": lax.pmean(acc, axes),
+        }
+
+    n_state = 3 if extra_vars_in_state else 2
+    return jax.jit(
+        shard_map(
+            local_eval,
+            mesh=mesh,
+            in_specs=((P(),) * n_state, dspec, dspec),
+            out_specs=P(),
+        )
+    )

@@ -1,0 +1,150 @@
+"""Minimal trainer/updater loop.
+
+The reference delegates its training loop to Chainer's
+``Trainer``/``StandardUpdater`` and integrates via extensions (SURVEY.md
+§3.1). This standalone rebuild ships a lean equivalent: an updater that
+feeds global batches (sharded over the communicator's mesh axis) into one
+jitted train step, and a trainer with interval-triggered extensions — enough
+to run every reference example shape (log/print/eval/snapshot at triggers,
+rank-0-only reporting convention).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def default_converter(batch):
+    """List of (x, y) pairs → stacked arrays (the reference's concat_examples)."""
+    xs = np.stack([b[0] for b in batch])
+    ys = np.stack([b[1] for b in batch])
+    return xs, ys
+
+
+class StandardUpdater:
+    """Pulls a batch, shards it over the data axis, runs the jitted step.
+
+    ``step_fn(state, *batch_arrays) -> (state, metrics_dict)`` must already
+    be jitted (with the collective ops compiled in — see
+    create_multi_node_optimizer). ``state`` is any pytree the caller owns.
+    """
+
+    def __init__(self, iterator, step_fn: Callable, state: Any, comm,
+                 converter: Callable = default_converter):
+        self.iterator = iterator
+        self.step_fn = step_fn
+        self.state = state
+        self.comm = comm
+        self.converter = converter
+        self.iteration = 0
+        self.last_metrics: Dict[str, float] = {}
+        axes = comm.axis_names
+        self._data_sharding = NamedSharding(
+            comm.mesh, P(axes if len(axes) > 1 else axes[0])
+        )
+
+    @property
+    def epoch(self):
+        return getattr(self.iterator, "epoch", 0)
+
+    @property
+    def is_new_epoch(self):
+        return getattr(self.iterator, "is_new_epoch", False)
+
+    def shard_batch(self, arrays):
+        n = self.comm.size
+        for a in arrays:
+            if hasattr(a, "shape") and a.shape and a.shape[0] % n != 0:
+                raise ValueError(
+                    f"global batch size {a.shape[0]} is not divisible by the "
+                    f"{n} devices of the data axis — pick a batch size that "
+                    f"is a multiple of {n}"
+                )
+        return tuple(
+            jax.device_put(a, self._data_sharding) for a in arrays
+        )
+
+    def update(self):
+        batch = next(self.iterator)
+        arrays = self.converter(batch)
+        arrays = self.shard_batch(arrays)
+        self.state, metrics = self.step_fn(self.state, *arrays)
+        self.last_metrics = metrics
+        self.iteration += 1
+
+
+class _Entry:
+    def __init__(self, ext, trigger, name):
+        self.ext = ext
+        self.n, self.unit = trigger
+        self.name = name
+        self._last_epoch = 0
+
+    def due(self, updater) -> bool:
+        if self.unit == "iteration":
+            return updater.iteration % self.n == 0
+        if self.unit == "epoch":
+            if updater.is_new_epoch and updater.epoch % self.n == 0:
+                return True
+            return False
+        raise ValueError(f"unknown trigger unit {self.unit!r}")
+
+
+class Trainer:
+    """Runs the updater until the stop trigger, firing extensions.
+
+    Reference convention preserved: attach reporting extensions only on the
+    master (``if comm.rank == 0: trainer.extend(...)``) — metric reduction
+    happens in-graph or via the multi-node evaluator, not here.
+    """
+
+    def __init__(self, updater: StandardUpdater,
+                 stop_trigger: Tuple[int, str] = (1, "epoch"),
+                 out: str = "result"):
+        self.updater = updater
+        self.stop_n, self.stop_unit = stop_trigger
+        self.out = out
+        self._extensions = []
+        self.observation: Dict[str, float] = {}
+
+    def extend(self, extension, trigger: Tuple[int, str] = (1, "epoch"),
+               name: Optional[str] = None):
+        self._extensions.append(_Entry(extension, trigger, name))
+
+    def _stopped(self) -> bool:
+        if self.stop_unit == "epoch":
+            return self.updater.epoch >= self.stop_n
+        return self.updater.iteration >= self.stop_n
+
+    def _materialize_observation(self, start):
+        # float() blocks on the device — do it only when someone will read
+        # the numbers, so async dispatch keeps the device pipeline full.
+        # update (not replace): extension-published keys (validation/...)
+        # stay visible until their next refresh
+        self.observation.update(
+            {k: float(v) for k, v in self.updater.last_metrics.items()}
+        )
+        self.observation["iteration"] = self.updater.iteration
+        self.observation["epoch"] = self.updater.epoch
+        self.observation["elapsed_time"] = time.time() - start
+
+    def run(self):
+        start = time.time()
+        while not self._stopped():
+            try:
+                self.updater.update()
+            except StopIteration:
+                break  # non-repeating iterator exhausted
+            due = [e for e in self._extensions if e.due(self.updater)]
+            if due:
+                self._materialize_observation(start)
+                for e in due:
+                    e.ext(self)
+        self._materialize_observation(start)

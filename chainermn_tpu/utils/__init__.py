@@ -1,0 +1,24 @@
+"""Small shared utilities."""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_platform():
+    """Make the JAX_PLATFORMS env var authoritative.
+
+    Some environments install site hooks that re-pin jax's platform on
+    import, silently overriding the env var a user set on the command line
+    (observed: an example asked for an 8-device CPU mesh and ran on one TPU
+    chip instead). Calling this before device queries re-asserts the user's
+    choice through jax.config, which wins over the hook.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass

@@ -1,0 +1,61 @@
+"""scatter_dataset / split plan tests (reference: datasets_tests/)."""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.datasets import (
+    SubDataset,
+    create_empty_dataset,
+    split_indices,
+)
+
+
+def test_split_indices_disjoint_cover():
+    plans = split_indices(100, 4, shuffle=True, seed=0,
+                          force_equal_length=False)
+    all_idx = np.concatenate(plans)
+    assert sorted(all_idx.tolist()) == list(range(100))
+    assert [len(p) for p in plans] == [25, 25, 25, 25]
+
+
+def test_split_indices_uneven():
+    plans = split_indices(10, 3, force_equal_length=False)
+    assert [len(p) for p in plans] == [4, 3, 3]
+    assert sorted(np.concatenate(plans).tolist()) == list(range(10))
+
+
+def test_split_indices_equal_length_wraps():
+    plans = split_indices(10, 3, force_equal_length=True)
+    assert all(len(p) == 4 for p in plans)  # ceil(10/3) = 4, tail wraps
+
+
+def test_split_indices_shuffle_deterministic():
+    a = split_indices(50, 2, shuffle=True, seed=7)
+    b = split_indices(50, 2, shuffle=True, seed=7)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_scatter_dataset_single_process():
+    comm = chainermn_tpu.create_communicator("xla")
+    data = list(range(40))
+    shard = chainermn_tpu.scatter_dataset(data, comm, shuffle=True, seed=3)
+    # one process → the whole dataset, permuted
+    assert len(shard) == 40
+    assert sorted(shard[i] for i in range(40)) == data
+
+
+def test_subdataset_view():
+    base = [10, 11, 12, 13, 14]
+    sub = SubDataset(base, [4, 0, 2])
+    assert len(sub) == 3
+    assert [sub[i] for i in range(3)] == [14, 10, 12]
+    assert sub[0:2] == [14, 10]
+
+
+def test_create_empty_dataset():
+    ds = create_empty_dataset()
+    assert len(ds) == 0
+    with pytest.raises(IndexError):
+        ds[0]

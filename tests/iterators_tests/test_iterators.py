@@ -1,0 +1,54 @@
+"""Iterator tests (reference: iterators_tests/)."""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.iterators import (
+    SerialIterator,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+
+
+def test_serial_iterator_epochs():
+    data = list(range(10))
+    it = SerialIterator(data, 4, shuffle=False, repeat=True)
+    b1 = it.next()
+    assert b1 == [0, 1, 2, 3]
+    assert it.epoch == 0
+    it.next()
+    b3 = it.next()           # 8,9 + wrap of 2 from the new epoch
+    assert len(b3) == 4
+    assert it.epoch == 1
+
+
+def test_serial_iterator_no_repeat_stops():
+    data = list(range(6))
+    it = SerialIterator(data, 4, shuffle=False, repeat=False)
+    batches = list(it)
+    assert [len(b) for b in batches] == [4, 2]
+
+
+def test_serial_iterator_shuffle_covers_epoch():
+    data = list(range(12))
+    it = SerialIterator(data, 4, shuffle=True, seed=0)
+    seen = []
+    for _ in range(3):
+        seen.extend(it.next())
+    assert sorted(seen) == data
+
+
+def test_multi_node_iterator_single_process_passthrough():
+    comm = chainermn_tpu.create_communicator("xla")
+    base = SerialIterator(list(range(8)), 4, shuffle=False)
+    it = create_multi_node_iterator(base, comm)
+    assert it is base  # one process: no wrapping needed
+
+
+def test_synchronized_iterator_reseeds():
+    comm = chainermn_tpu.create_communicator("xla")
+    it = SerialIterator(list(range(16)), 4, shuffle=True, seed=None)
+    out = create_synchronized_iterator(it, comm)
+    batch = out.next()
+    assert len(batch) == 4

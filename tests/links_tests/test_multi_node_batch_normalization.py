@@ -1,0 +1,95 @@
+"""MultiNodeBatchNormalization statistical equivalence (BASELINE config #3).
+
+The reference's oracle (SURVEY.md §4 item 4): the distributed result on N
+ranks must match single-process BatchNormalization run on the concatenated
+batch.
+"""
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.links import MultiNodeBatchNormalization
+
+
+@pytest.fixture()
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def test_matches_concatenated_single_device(comm):
+    n = comm.size
+    per = 4
+    feat = 6
+    x = np.random.RandomState(0).randn(n * per, feat).astype(np.float32)
+
+    mnbn = MultiNodeBatchNormalization(comm=comm)
+    variables = mnbn.init(jax.random.PRNGKey(0), x[:2],
+                          use_running_average=False)
+
+    spec = P(comm.axis_names[0])
+
+    def f(x):
+        y, new_vars = mnbn.apply(
+            variables, x, use_running_average=False,
+            mutable=["batch_stats"],
+        )
+        return y
+
+    y_dist = jax.jit(
+        shard_map(f, mesh=comm.mesh, in_specs=(spec,), out_specs=spec)
+    )(x)
+
+    # single-device BN over the concatenated batch
+    bn = nn.BatchNorm(use_running_average=False, momentum=0.9, epsilon=2e-5)
+    bn_vars = bn.init(jax.random.PRNGKey(0), x)
+    y_ref, _ = bn.apply(bn_vars, x, mutable=["batch_stats"])
+
+    np.testing.assert_allclose(np.asarray(y_dist), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gradients_match_concatenated(comm):
+    n = comm.size
+    x = np.random.RandomState(1).randn(n * 3, 5).astype(np.float32)
+
+    mnbn = MultiNodeBatchNormalization(comm=comm)
+    variables = mnbn.init(jax.random.PRNGKey(0), x[:2],
+                          use_running_average=False)
+    params = variables["params"]
+    spec = P(comm.axis_names[0])
+
+    def dist_loss(params, x):
+        def f(x):
+            y = mnbn.apply(
+                {"params": params,
+                 "batch_stats": variables["batch_stats"]},
+                x, use_running_average=False, mutable=["batch_stats"],
+            )[0]
+            # per-shard sum; total loss = psum = sum over full batch
+            return y
+
+        y = shard_map(f, mesh=comm.mesh, in_specs=(spec,), out_specs=spec)(x)
+        return jnp.sum(y ** 2)
+
+    g_dist = jax.jit(jax.grad(dist_loss))(params, x)
+
+    bn = nn.BatchNorm(use_running_average=False, momentum=0.9, epsilon=2e-5)
+    bn_vars = bn.init(jax.random.PRNGKey(0), x)
+
+    def ref_loss(p, x):
+        y = bn.apply({"params": p, "batch_stats": bn_vars["batch_stats"]},
+                     x, mutable=["batch_stats"])[0]
+        return jnp.sum(y ** 2)
+
+    g_ref = jax.jit(jax.grad(ref_loss))(bn_vars["params"], x)
+    for a, b in zip(jax.tree_util.tree_leaves(g_dist),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)

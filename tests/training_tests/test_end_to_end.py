@@ -1,0 +1,70 @@
+"""End-to-end integration: the MNIST example shape as a test (the reference
+treats examples/mnist under mpiexec as its de-facto integration suite,
+SURVEY.md §4 item 5)."""
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+import chainermn_tpu
+from chainermn_tpu.datasets.toy import synthetic_mnist
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models import MLP
+from chainermn_tpu.training import StandardUpdater, Trainer
+from chainermn_tpu.training.evaluator import Evaluator
+from chainermn_tpu.training.step import (
+    make_data_parallel_train_step,
+    make_eval_step,
+)
+
+
+def test_mnist_mlp_trains_to_high_accuracy():
+    comm = chainermn_tpu.create_communicator("xla")
+    train = synthetic_mnist(1024, seed=0)
+    test = synthetic_mnist(256, seed=1)
+    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
+
+    model = MLP(n_units=64, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    params = comm.bcast_data(params)
+
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-3), comm)
+    state = (params, opt.init(params))
+
+    step = make_data_parallel_train_step(model, opt, comm)
+    eval_step = make_eval_step(model, comm)
+
+    it = SerialIterator(train, 128, shuffle=True, seed=0)
+    updater = StandardUpdater(it, step, state, comm)
+    trainer = Trainer(updater, stop_trigger=(3, "epoch"))
+    evaluator = Evaluator(
+        lambda: SerialIterator(test, 128, repeat=False, shuffle=False),
+        eval_step, updater,
+    )
+    trainer.extend(lambda t: evaluator(t), trigger=(1, "epoch"))
+    trainer.run()
+
+    assert trainer.observation["main/loss"] < 0.2
+    assert trainer.observation["validation/main/accuracy"] > 0.9
+
+
+def test_trainer_iteration_trigger_counts():
+    comm = chainermn_tpu.create_communicator("xla")
+    train = synthetic_mnist(256, seed=0)
+    model = MLP(n_units=16, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    step = make_data_parallel_train_step(model, opt, comm)
+    it = SerialIterator(train, 64, shuffle=False)
+    updater = StandardUpdater(it, step, (comm.bcast_data(params),
+                                         opt.init(params)), comm)
+    trainer = Trainer(updater, stop_trigger=(8, "iteration"))
+    fires = []
+    trainer.extend(lambda t: fires.append(t.updater.iteration),
+                   trigger=(2, "iteration"))
+    trainer.run()
+    assert fires == [2, 4, 6, 8]
