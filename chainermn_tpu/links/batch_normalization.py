@@ -39,6 +39,8 @@ class MultiNodeBatchNormalization(nn.Module):
     dtype: Optional[Any] = None
     use_running_average: Optional[bool] = None
     communication_backend: str = "auto"  # parity only; XLA is the backend
+    scale_init: Any = nn.initializers.ones_init()
+    bias_init: Any = nn.initializers.zeros_init()
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
@@ -57,4 +59,6 @@ class MultiNodeBatchNormalization(nn.Module):
             epsilon=self.eps,
             dtype=self.dtype,
             axis_name=axis_name,
+            scale_init=self.scale_init,
+            bias_init=self.bias_init,
         )(x)

@@ -1,0 +1,133 @@
+"""Seq2seq encoder-decoder (BASELINE config #4's model family).
+
+Reference: examples/seq2seq/seq2seq.py — an LSTM encoder-decoder for WMT
+En-De with variable-length batches (SURVEY.md §2.6). TPU-first rebuild:
+
+* recurrence via ``flax.linen.RNN`` (``lax.scan`` under the hood — static
+  shapes, compiler-friendly);
+* variable-length sequences become padded + masked batches, with lengths
+  bucketed to multiples (``pad_batch``) so XLA compiles a handful of shapes
+  instead of one per batch — the TPU answer to the reference's per-batch
+  dynamic shapes;
+* bfloat16 compute optional, fp32 softmax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PAD, BOS, EOS = 0, 1, 2
+
+
+from chainermn_tpu.utils import match_vma as _match_vma
+
+
+class LstmStack(nn.Module):
+    n_layers: int
+    n_units: int
+
+    @nn.compact
+    def __call__(self, x, seq_lengths=None, initial_carries=None):
+        """Returns (final_carries, outputs)."""
+        carries = []
+        h = x
+        for i in range(self.n_layers):
+            cell = nn.OptimizedLSTMCell(features=self.n_units)
+            if initial_carries is not None:
+                init = initial_carries[i]
+            else:
+                init = cell.initialize_carry(
+                    jax.random.PRNGKey(0), h.shape[:1] + h.shape[2:]
+                )
+            init = _match_vma(init, h)
+            carry, h = nn.RNN(cell, return_carry=True)(
+                h, seq_lengths=seq_lengths, initial_carry=init
+            )
+            carries.append(carry)
+        return carries, h
+
+
+class Seq2Seq(nn.Module):
+    """LSTM encoder-decoder with teacher forcing.
+
+    ``__call__(src, src_len, tgt_in)`` → logits [B, T_tgt, tgt_vocab].
+    """
+
+    n_layers: int = 2
+    n_units: int = 256
+    src_vocab: int = 40000
+    tgt_vocab: int = 40000
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.src_embed = nn.Embed(self.src_vocab, self.n_units,
+                                  dtype=self.dtype)
+        self.tgt_embed = nn.Embed(self.tgt_vocab, self.n_units,
+                                  dtype=self.dtype)
+        self.encoder = LstmStack(self.n_layers, self.n_units)
+        self.decoder = LstmStack(self.n_layers, self.n_units)
+        self.proj = nn.Dense(self.tgt_vocab, dtype=jnp.float32)
+
+    def __call__(self, src, src_len, tgt_in):
+        carries, _ = self.encoder(self.src_embed(src), seq_lengths=src_len)
+        _, h = self.decoder(self.tgt_embed(tgt_in),
+                            initial_carries=carries)
+        return self.proj(h)
+
+    def encode(self, src, src_len):
+        return self.encoder(self.src_embed(src), seq_lengths=src_len)[0]
+
+    def decode_step(self, carries, token):
+        """One greedy decode step: token [B] → (carries, logits [B, V])."""
+        x = self.tgt_embed(token[:, None])
+        carries, h = self.decoder(x, initial_carries=carries)
+        return carries, self.proj(h[:, 0])
+
+
+def seq2seq_loss(logits, tgt_out, pad=PAD):
+    """Token-level masked cross entropy (mean over non-pad tokens)."""
+    import optax
+
+    mask = (tgt_out != pad).astype(jnp.float32)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt_out)
+    total = jnp.sum(ce * mask)
+    count = jnp.maximum(jnp.sum(mask), 1.0)
+    return total / count, mask
+
+
+def pad_batch(pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
+              length_multiple: int = 16,
+              max_len: int = 512):
+    """Variable-length (src, tgt) pairs → fixed-bucket padded arrays.
+
+    Returns (src [B,Ts], src_len [B], tgt_in [B,Tt], tgt_out [B,Tt]).
+    tgt_in is BOS-shifted, tgt_out EOS-terminated; both PAD-filled. Lengths
+    round up to ``length_multiple`` so XLA sees a small set of shapes.
+    """
+    def bucket(n):
+        return min(max_len, -(-n // length_multiple) * length_multiple)
+
+    srcs = [np.asarray(s) for s, _ in pairs]
+    tgts = [np.asarray(t) for _, t in pairs]
+    ts = bucket(max(len(s) for s in srcs))
+    tt = bucket(max(len(t) for t in tgts) + 1)  # +1 for BOS/EOS shift
+    b = len(pairs)
+    src = np.full((b, ts), PAD, np.int32)
+    src_len = np.zeros((b,), np.int32)
+    tgt_in = np.full((b, tt), PAD, np.int32)
+    tgt_out = np.full((b, tt), PAD, np.int32)
+    for i, (s, t) in enumerate(zip(srcs, tgts)):
+        s = s[:ts]
+        t = t[:tt - 1]
+        src[i, :len(s)] = s
+        src_len[i] = len(s)
+        tgt_in[i, 0] = BOS
+        tgt_in[i, 1:len(t) + 1] = t
+        tgt_out[i, :len(t)] = t
+        tgt_out[i, len(t)] = EOS
+    return src, src_len, tgt_in, tgt_out

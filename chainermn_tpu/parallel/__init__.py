@@ -1,0 +1,17 @@
+from .pipeline import pipeline_apply, stack_stage_params
+from .ring_attention import local_attention_reference, ring_attention
+from .tensor_parallel import (
+    ColumnParallelDense,
+    RowParallelDense,
+    TensorParallelMLP,
+)
+
+__all__ = [
+    "ring_attention",
+    "local_attention_reference",
+    "pipeline_apply",
+    "stack_stage_params",
+    "ColumnParallelDense",
+    "RowParallelDense",
+    "TensorParallelMLP",
+]

@@ -1,0 +1,112 @@
+"""Micro-batched pipeline parallelism over a mesh axis.
+
+The reference's pipeline story is MultiNodeChainList's sequential fill/drain
+(no micro-batch scheduler — SURVEY.md §2.6). This module is the TPU-native
+performance path beyond that: homogeneous stages whose parameters are
+*stacked and sharded* over the ``stage`` mesh axis (true memory scaling) and
+a GPipe-style rotating schedule compiled into one ``lax.fori_loop`` whose
+inter-stage hop is a single neighbor ``ppermute`` — the canonical
+"pipelining with collective_permute" pattern on TPU.
+
+Schedule: with S stages and M micro-batches, the loop runs S+M-1 ticks; at
+tick t, stage s processes micro-batch t-s (when 0 ≤ t-s < M). Each shard
+holds its own stage's parameters only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.utils import match_vma
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params: Any,
+    x_microbatches,
+    axis_name: str,
+):
+    """Run the pipeline forward inside shard_map.
+
+    Args:
+      stage_fn: ``(params, h) -> h`` — one stage's compute. All stages share
+        this structure (homogeneous pipeline); per-stage behavior comes from
+        the sharded ``stage_params``.
+      stage_params: THIS shard's stage parameters (pytree). In the driver,
+        stack per-stage params on a leading axis sharded over ``axis_name``
+        and strip it in-shard (in_specs does this).
+      x_microbatches: [M, mb, ...] micro-batches, replicated; stage 0 feeds
+        them in, the last stage's outputs are collected ([M, mb, ...]).
+      axis_name: the stage mesh axis.
+
+    Returns stacked outputs [M, mb, ...] (valid on every shard).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+
+    ticks = n + m - 1
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # activation dtype/shape comes from the stage itself (homogeneous
+    # pipeline: output shape == input shape, but dtype may be bf16 etc.)
+    out_aval = jax.eval_shape(
+        stage_fn, stage_params,
+        jax.ShapeDtypeStruct(mb_shape, x_microbatches.dtype),
+    )
+    act_dtype = out_aval.dtype
+    if out_aval.shape != mb_shape:
+        raise ValueError(
+            f"pipeline stages must preserve the activation shape "
+            f"(homogeneous pipeline); stage maps {mb_shape} -> "
+            f"{out_aval.shape}"
+        )
+
+    # carry: (current activation, collected outputs) — pcast to varying so
+    # the fori_loop carry matches the per-shard (varying) updates
+    h0 = match_vma(jnp.zeros(mb_shape, act_dtype), my)
+    outs = match_vma(jnp.zeros((m,) + mb_shape, act_dtype), my)
+
+    def tick(t, carry):
+        h, outs = carry
+        # stage 0 ingests micro-batch t (if in range); others use the
+        # activation that arrived over the ring
+        feed = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+        ).astype(act_dtype)
+        h_in = jnp.where(my == 0, feed, h)
+        y = stage_fn(stage_params, h_in)
+        # last stage records micro-batch t-(n-1) when valid
+        mb_idx = t - (n - 1)
+        valid = jnp.logical_and(my == n - 1,
+                                jnp.logical_and(mb_idx >= 0, mb_idx < m))
+        outs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.clip(mb_idx, 0, m - 1), axis=0),
+            lambda o: o,
+            outs,
+        )
+        # rotate activations one hop down the ring
+        h_next = lax.ppermute(y, axis_name, fwd_perm)
+        return h_next, outs
+
+    _, outs = lax.fori_loop(0, ticks, tick, (h0, outs))
+    # make the last stage's collection visible everywhere
+    last = n - 1
+    keep = (my == last)
+    outs = lax.psum(jnp.where(keep, outs, jnp.zeros_like(outs)), axis_name)
+    return outs
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage param pytrees on a leading axis (shard over the
+    stage mesh axis with P('stage') in_specs)."""
+    return jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls), *params_list
+    )

@@ -1,0 +1,114 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+Beyond-reference capability (SURVEY.md §2.6: the reference predates sequence
+parallelism; §5 specifies this as the TPU-native answer). The sequence axis
+is sharded over a mesh axis; each shard holds a query block and rotates the
+K/V blocks around the ring with ``lax.ppermute`` (XLA collective-permute over
+ICI neighbor links), accumulating attention with the online-softmax
+(flash-style) running max/denominator so the full sequence is never
+materialized on one chip. Compute of block t overlaps the transfer of block
+t+1 thanks to XLA's latency-hiding scheduler.
+
+Causal masking works on block indices: a shard skips score positions whose
+global key index exceeds the global query index.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, scale, mask):
+    """Scores for one (q-block, kv-block) pair + unnormalized accumulators.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; mask: [Lq, Lk] or None.
+    Returns (numerator [B, Lq, H, D], rowmax [B, Lq, H], rowsum [B, Lq, H]).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                          # [B, H, Lq] (may be -inf)
+    # exponentiate against a finite shift; fully-masked rows produce zeros
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)                          # [B, H, Lq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # return the TRUE max (-inf where masked) — the merge needs it
+    return o, jnp.transpose(m, (0, 2, 1)), jnp.transpose(l, (0, 2, 1))
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call inside shard_map with the sequence dimension sharded:
+    q, k, v: [B, L_local, H, D] per shard. Returns [B, L_local, H, D].
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    lq = q.shape[1]
+    lk = k.shape[1]
+
+    # running accumulators: numerator, rowsum, rowmax — pcast to varying so
+    # the fori_loop carry type matches the (varying) per-shard updates
+    from chainermn_tpu.utils import match_vma
+
+    acc = match_vma(jnp.zeros(q.shape, jnp.float32), q)
+    lsum = match_vma(jnp.zeros(q.shape[:3], jnp.float32), q)  # [B, Lq, H]
+    mrun = match_vma(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), q)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]      # ring rotation
+
+    def body(t, carry):
+        acc, lsum, mrun, k_cur, v_cur = carry
+        src = (my - t) % n                            # whose KV block this is
+
+        if causal:
+            # global positions: queries my*lq + iq, keys src*lk + ik
+            iq = my * lq + jnp.arange(lq)[:, None]
+            ik = src * lk + jnp.arange(lk)[None, :]
+            mask = ik <= iq
+        else:
+            mask = None
+
+        o_t, m_t, l_t = _block_attend(
+            q.astype(jnp.float32), k_cur.astype(jnp.float32),
+            v_cur.astype(jnp.float32), scale, mask)
+
+        m_new = jnp.maximum(mrun, m_t)
+        # rescale old accumulators; exp(-inf - m) == 0 handles the first step
+        alpha = jnp.where(jnp.isfinite(mrun), jnp.exp(mrun - m_new), 0.0)
+        beta = jnp.where(jnp.isfinite(m_t), jnp.exp(m_t - m_new), 0.0)
+        acc = acc * alpha[..., None] + o_t * beta[..., None]
+        lsum = lsum * alpha + l_t * beta
+        mrun = m_new
+
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return acc, lsum, mrun, k_nxt, v_nxt
+
+    acc, lsum, mrun, _, _ = lax.fori_loop(
+        0, n, body, (acc, lsum, mrun, k, v))
+    out = acc / jnp.maximum(lsum[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def local_attention_reference(q, k, v, causal: bool = False,
+                              scale: Optional[float] = None):
+    """Single-device full attention (the correctness oracle)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        mask = jnp.arange(lk)[None, :] <= jnp.arange(lq)[:, None]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)

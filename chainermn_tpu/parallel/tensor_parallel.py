@@ -1,0 +1,89 @@
+"""Intra-op tensor parallelism helpers.
+
+Beyond-reference capability (SURVEY.md §2.6: the reference's model
+parallelism is graph-partition only; true intra-op TP comes "for free" on a
+mesh). The Megatron-style pair:
+
+* **column-parallel** Dense: weight sharded on the output dim; activations
+  stay sharded, no collective in forward;
+* **row-parallel** Dense: weight sharded on the input dim; forward ends in a
+  ``psum`` over the model axis (backward gets the broadcast automatically).
+
+A column→row pair implements a sharded MLP with exactly one all-reduce, and
+a QKV-column / out-row pair does the same for attention. These are shard_map
+building blocks; under plain ``pjit`` the same layouts fall out of weight
+``PartitionSpec``s — both idioms are supported.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense with output features split over ``axis_name``.
+
+    In-shard features = ``features // axis_size``. Input must be replicated
+    (or identically sharded) across the model axis; output is sharded on the
+    feature dim.
+    """
+
+    features: int
+    axis_name: str
+    use_bias: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        n = lax.axis_size(self.axis_name)
+        assert self.features % n == 0, (
+            f"features {self.features} not divisible by axis {n}")
+        local = self.features // n
+        y = nn.Dense(local, use_bias=self.use_bias, dtype=self.dtype)(x)
+        return y
+
+
+class RowParallelDense(nn.Module):
+    """Dense with input features split over ``axis_name``; forward psums.
+
+    Input is feature-sharded (the column-parallel output); the result is the
+    full matmul, replicated across the model axis.
+    """
+
+    features: int
+    axis_name: str
+    use_bias: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.features, use_bias=False, dtype=self.dtype)(x)
+        y = lax.psum(y, self.axis_name)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (self.features,))
+            y = y + bias
+        return y
+
+
+class TensorParallelMLP(nn.Module):
+    """Column → activation → row: one psum per MLP block."""
+
+    hidden: int
+    out: int
+    axis_name: str
+    act: Callable = nn.gelu
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.hidden, self.axis_name,
+                                dtype=self.dtype)(x)
+        h = self.act(h)
+        return RowParallelDense(self.out, self.axis_name,
+                                dtype=self.dtype)(h)

@@ -19,15 +19,33 @@ from jax import lax, shard_map
 from jax.sharding import PartitionSpec as P
 
 
+def _accepts_train(model) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(type(model).__call__)
+    except (TypeError, ValueError):
+        return False
+    return "train" in sig.parameters
+
+
 def classifier_loss(model, params, x, y, train: bool = True,
                     mutable=None, extra_vars=None, rngs=None):
-    """Softmax cross-entropy + accuracy for an (x, y) classifier."""
+    """Softmax cross-entropy + accuracy for an (x, y) classifier.
+
+    The ``train`` flag is forwarded whenever the model's ``__call__``
+    declares it (dropout/BN models), independent of whether mutable
+    collections exist.
+    """
     variables = {"params": params, **(extra_vars or {})}
-    if mutable:
-        logits, new_vars = model.apply(variables, x, mutable=mutable,
-                                       rngs=rngs)
+    kwargs = {}
+    if _accepts_train(model):
+        kwargs["train"] = train
+    if mutable and train:
+        logits, new_vars = model.apply(variables, x, mutable=list(mutable),
+                                       rngs=rngs, **kwargs)
     else:
-        logits = model.apply(variables, x, rngs=rngs)
+        logits = model.apply(variables, x, rngs=rngs, **kwargs)
         new_vars = {}
     loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
     acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))

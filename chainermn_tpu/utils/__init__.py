@@ -5,6 +5,28 @@ from __future__ import annotations
 import os
 
 
+def match_vma(tree, ref):
+    """Make ``tree``'s leaves vary on the same manual mesh axes as ``ref``.
+
+    Under shard_map's varying-axis tracking, freshly created constants
+    (zeros carries, accumulators) are axis-invariant while scanned/looped
+    data varies — lax.scan/fori_loop then reject the carry type mismatch.
+    pcast-to-varying aligns them; no-op outside shard_map or when tracking
+    is off.
+    """
+    import jax
+
+    ref_vma = getattr(jax.typeof(ref), "vma", None)
+    if not ref_vma:
+        return tree
+
+    def fix(l):
+        need = tuple(ref_vma - jax.typeof(l).vma)
+        return jax.lax.pcast(l, need, to="varying") if need else l
+
+    return jax.tree_util.tree_map(fix, tree)
+
+
 def ensure_platform():
     """Make the JAX_PLATFORMS env var authoritative.
 

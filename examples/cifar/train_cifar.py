@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""CIFAR-100 ResNet with MultiNodeBatchNormalization (BASELINE config #3).
+
+Every BN layer's batch statistics span all replicas — the reference's
+MultiNodeBatchNormalization path — by passing the communicator into the
+model. Useful when the per-replica batch is small enough that local BN
+statistics get noisy (the regime the reference built this link for).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import numpy as np
+import optax
+
+import chainermn_tpu
+from chainermn_tpu.utils import ensure_platform
+
+ensure_platform()
+
+from chainermn_tpu.datasets.toy import synthetic_cifar
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models.resnet import CifarResNet
+from chainermn_tpu.training import LogReport, PrintReport, StandardUpdater, Trainer
+from chainermn_tpu.training.step import make_data_parallel_train_step
+
+
+def main():
+    p = argparse.ArgumentParser(description="ChainerMN-TPU example: CIFAR-100")
+    p.add_argument("--batchsize", "-b", type=int, default=256)
+    p.add_argument("--epoch", "-e", type=int, default=3)
+    p.add_argument("--depth", type=int, default=20)
+    p.add_argument("--communicator", type=str, default="xla")
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--n-train", type=int, default=4096)
+    p.add_argument("--no-multi-node-bn", action="store_true",
+                   help="use per-replica BN statistics instead")
+    p.add_argument("--out", "-o", default="result")
+    args = p.parse_args()
+
+    comm = chainermn_tpu.create_communicator(args.communicator)
+    if comm.is_master:
+        print(f"devices: {comm.size}  multi-node BN: "
+              f"{not args.no_multi_node_bn}")
+
+    train = synthetic_cifar(args.n_train, seed=0)
+    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
+
+    model = CifarResNet(
+        num_classes=100, depth=args.depth,
+        comm=None if args.no_multi_node_bn else comm,
+    )
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((2, 32, 32, 3), np.float32))
+    params = comm.bcast_data(variables["params"])
+    batch_stats = comm.bcast_data(variables["batch_stats"])
+
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(args.lr, momentum=0.9), comm
+    )
+    state = (params, optimizer.init(params), {"batch_stats": batch_stats})
+    step = make_data_parallel_train_step(
+        model, optimizer, comm, mutable=("batch_stats",)
+    )
+
+    it = SerialIterator(train, args.batchsize, shuffle=True, seed=0)
+    updater = StandardUpdater(it, step, state, comm)
+    trainer = Trainer(updater, stop_trigger=(args.epoch, "epoch"),
+                      out=args.out)
+
+    if comm.is_master:
+        trainer.extend(LogReport(os.path.join(args.out, "cifar.jsonl")),
+                       trigger=(1, "epoch"))
+        trainer.extend(PrintReport(
+            ["epoch", "iteration", "main/loss", "main/accuracy",
+             "elapsed_time"]), trigger=(1, "epoch"))
+
+    trainer.run()
+    if comm.is_master:
+        print(f"final: loss={trainer.observation['main/loss']:.4f} "
+              f"acc={trainer.observation['main/accuracy']:.4f}")
+    return trainer
+
+
+if __name__ == "__main__":
+    main()

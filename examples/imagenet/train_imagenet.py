@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Data-parallel ImageNet ResNet-50 (BASELINE config #2 — the throughput
+metric).
+
+Reference flow (SURVEY.md §3.1): per-rank process, pure_nccl communicator,
+allreduce_grad in the hot loop. Here the whole iteration — fwd/bwd, gradient
+all-reduce over the mesh, SGD update, BN-stat sync — is one compiled XLA
+program; bfloat16 compute feeds the MXU, gradients ride a bf16 collective
+(the reference's allreduce_grad_dtype=fp16 analog).
+
+Synthetic ImageNet-shaped data by default (no network egress); point
+--data-dir at real TFRecords/folders by replacing the dataset object.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import chainermn_tpu
+from chainermn_tpu.utils import ensure_platform
+
+ensure_platform()
+
+from chainermn_tpu.datasets.toy import ArrayDataset
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.models.resnet import ResNet50
+from chainermn_tpu.training import LogReport, PrintReport, StandardUpdater, Trainer
+from chainermn_tpu.training.step import make_data_parallel_train_step
+
+
+def synthetic_imagenet(n, image_size, n_classes=1000, seed=0):
+    protos = np.random.RandomState(99).rand(
+        32, image_size, image_size, 3).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, n_classes, size=n).astype(np.int32)
+    xs = protos[ys % 32] + 0.25 * rng.randn(
+        n, image_size, image_size, 3).astype(np.float32)
+    return ArrayDataset(xs.astype(np.float32), ys)
+
+
+def main():
+    p = argparse.ArgumentParser(description="ChainerMN-TPU example: ImageNet")
+    p.add_argument("--batchsize", "-B", type=int, default=None,
+                   help="global batch (default: 64 × n_devices)")
+    p.add_argument("--epoch", "-E", type=int, default=1)
+    p.add_argument("--iterations", type=int, default=None,
+                   help="stop after N iterations instead of epochs")
+    p.add_argument("--communicator", type=str, default="pure_nccl")
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--n-train", type=int, default=2048)
+    p.add_argument("--dtype", choices=["float32", "bfloat16"],
+                   default="bfloat16")
+    p.add_argument("--out", "-o", default="result")
+    args = p.parse_args()
+
+    comm = chainermn_tpu.create_communicator(
+        args.communicator, allreduce_grad_dtype=jnp.bfloat16
+    )
+    global_batch = args.batchsize or 64 * comm.size
+    if comm.is_master:
+        print(f"devices: {comm.size}  global batch: {global_batch}  "
+              f"dtype: {args.dtype}")
+
+    train = synthetic_imagenet(args.n_train, args.image_size)
+    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = ResNet50(num_classes=1000, dtype=dtype)
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        np.zeros((2, args.image_size, args.image_size, 3), np.float32),
+    )
+    params = comm.bcast_data(variables["params"])
+    batch_stats = comm.bcast_data(variables["batch_stats"])
+
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(args.lr, momentum=0.9, nesterov=True), comm
+    )
+    state = (params, optimizer.init(params), {"batch_stats": batch_stats})
+
+    step = make_data_parallel_train_step(
+        model, optimizer, comm, mutable=("batch_stats",)
+    )
+
+    it = SerialIterator(train, global_batch, shuffle=True, seed=0)
+    updater = StandardUpdater(it, step, state, comm)
+    stop = ((args.iterations, "iteration") if args.iterations
+            else (args.epoch, "epoch"))
+    trainer = Trainer(updater, stop_trigger=stop, out=args.out)
+
+    if comm.is_master:
+        trainer.extend(LogReport(os.path.join(args.out, "imagenet.jsonl")),
+                       trigger=(10, "iteration"))
+        trainer.extend(PrintReport(
+            ["epoch", "iteration", "main/loss", "main/accuracy",
+             "elapsed_time"]), trigger=(10, "iteration"))
+
+    trainer.run()
+    if comm.is_master:
+        obs = trainer.observation
+        ips = obs["iteration"] * global_batch / obs["elapsed_time"]
+        print(f"throughput: {ips:.1f} images/sec "
+              f"({ips / comm.size:.1f} /chip)")
+    return trainer
+
+
+if __name__ == "__main__":
+    main()

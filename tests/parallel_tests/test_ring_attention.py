@@ -1,0 +1,85 @@
+"""Ring attention vs full-attention oracle (sequence parallelism)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.parallel import local_attention_reference, ring_attention
+
+
+@pytest.fixture()
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _qkv(n, b=2, l=32, h=2, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    def one():
+        return rng.randn(b, l, h, d).astype(np.float32)
+    return one(), one(), one()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_full_attention(comm, causal):
+    q, k, v = _qkv(comm.size)
+    ax = comm.axis_names[0]
+    spec = P(None, ax)  # shard the sequence dim
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, axis_name=ax, causal=causal)
+
+    out = jax.jit(
+        shard_map(f, mesh=comm.mesh, in_specs=(spec,) * 3, out_specs=spec)
+    )(q, k, v)
+    ref = local_attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_gradients_flow(comm):
+    q, k, v = _qkv(comm.size, l=16)
+    ax = comm.axis_names[0]
+    spec = P(None, ax)
+
+    def loss(q, k, v):
+        f = lambda q, k, v: ring_attention(q, k, v, axis_name=ax)
+        out = shard_map(f, mesh=comm.mesh, in_specs=(spec,) * 3,
+                        out_specs=spec)(q, k, v)
+        return jnp.sum(out ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(local_attention_reference(q, k, v) ** 2)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_ref = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_long_sequence_memory_shape(comm):
+    """The per-shard working set is L_local, not L_global (sanity: runs with
+    a sequence 8x the per-shard block)."""
+    n = comm.size
+    b, l, h, d = 1, 16 * n, 2, 4
+    rng = np.random.RandomState(0)
+    q = rng.randn(b, l, h, d).astype(np.float32)
+    ax = comm.axis_names[0]
+    spec = P(None, ax)
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, axis_name=ax, causal=True)
+
+    out = jax.jit(
+        shard_map(f, mesh=comm.mesh, in_specs=(spec,) * 3, out_specs=spec)
+    )(q, q, q)
+    assert out.shape == q.shape
+    assert np.isfinite(np.asarray(out)).all()
