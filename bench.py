@@ -78,15 +78,18 @@ def main():
     x = jax.device_put(x, dsh)
     y = jax.device_put(y, dsh)
 
-    # warmup (compile) + steady state
+    # warmup (compile) + steady state. Sync by pulling a scalar to host:
+    # block_until_ready has been observed returning early on experimental
+    # platform plugins, which inflates throughput by ~1000x.
     state, m = step(state, x, y)
-    jax.block_until_ready(m)
+    float(m["main/loss"])
     n_iters = 20 if name == "mlp" else 10
     t0 = time.perf_counter()
     for _ in range(n_iters):
         state, m = step(state, x, y)
-    jax.block_until_ready(m)
+    final_loss = float(m["main/loss"])
     dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "loss is NaN"
 
     images_per_sec = n_iters * global_batch / dt
     per_chip = images_per_sec / n_dev

@@ -40,12 +40,22 @@ def _client():
 
 
 class ObjectPlane:
-    """Process-plane object collectives."""
+    """Process-plane object collectives.
+
+    Sequence counters are CLASS-level: every instance in a process shares
+    them, because all instances share the coordinator's one key namespace —
+    per-instance counters would collide (e.g. a user-made plane and the
+    communicator's internal one both starting at seq 0). SPMD discipline
+    (every process runs the same program, hence the same call order) keeps
+    the counters aligned across processes, exactly like MPI collectives.
+    """
+
+    _seq: dict = {}
 
     def __init__(self) -> None:
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
-        self._p2p_seq = {}
+        self._p2p_seq = ObjectPlane._seq
 
     # -- collectives ----------------------------------------------------
 

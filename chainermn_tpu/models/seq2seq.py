@@ -89,6 +89,33 @@ class Seq2Seq(nn.Module):
         return carries, self.proj(h[:, 0])
 
 
+def greedy_translate(model, variables, src, src_len, max_len: int = 64):
+    """Greedy decode: argmax tokens until ``max_len`` (reference:
+    the seq2seq example's translate loop; here a ``lax.scan`` with static
+    length — positions after EOS are PAD-masked).
+
+    Returns [B, max_len] int32 token ids.
+    """
+    import jax
+
+    carries = model.apply(variables, src, src_len, method=Seq2Seq.encode)
+    b = src.shape[0]
+
+    def step(carry, _):
+        carries, token, done = carry
+        carries, logits = model.apply(
+            variables, carries, token, method=Seq2Seq.decode_step)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, PAD, nxt)
+        done = jnp.logical_or(done, nxt == EOS)
+        return (carries, nxt, done), nxt
+
+    init = (carries, jnp.full((b,), BOS, jnp.int32),
+            jnp.zeros((b,), bool))
+    _, toks = jax.lax.scan(step, init, None, length=max_len)
+    return jnp.transpose(toks)  # [B, max_len]
+
+
 def seq2seq_loss(logits, tgt_out, pad=PAD):
     """Token-level masked cross entropy (mean over non-pad tokens)."""
     import optax

@@ -1,0 +1,174 @@
+"""Pallas flash attention — the fused hot-op kernel.
+
+Reference parity note: the reference's only custom device kernels are CuPy
+cast/pack elementwise kernels (SURVEY.md §2.2); XLA already fuses those here.
+The kernel worth hand-writing on TPU is blockwise attention: one pass over
+K/V tiles in VMEM with online softmax, never materializing the [L, L] score
+matrix in HBM. Used standalone or as the per-block compute inside ring
+attention (chainermn_tpu/parallel/ring_attention.py).
+
+Layout: [B, L, H, D] → kernel works on [B*H, L, D]. Grid is
+(batch*heads, q_blocks, kv_blocks) with the kv dimension innermost; VMEM
+scratch (acc, rowmax, rowsum) persists across the kv iteration of one
+(bh, q_block) and is finalized on the last kv step. Causal masking compares
+global row/col indices and skips fully-masked tiles.
+
+Backward runs through a custom VJP that recomputes attention with the XLA
+reference implementation — standard rematerialization (the bwd is
+memory-bound anyway; fwd is where the fusion pays).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30  # finite stand-in: -inf breaks max/exp chains on the VPU
+
+
+def _cdiv(a, b):
+    return -(-a // b)
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, mrow, lrow, *, scale,
+               causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        mrow[:] = jnp.full_like(mrow, _NEG_INF)
+        lrow[:] = jnp.zeros_like(lrow)
+
+    # causal: tile fully above the diagonal contributes nothing
+    run = True
+    if causal:
+        run = qi * bq + bq - 1 >= ki * bk  # last q row sees first k col?
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                  # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = (qi * bq + rows) >= (ki * bk + cols)
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = mrow[:, :1]                       # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        lrow[:, :1] = lrow[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        mrow[:, :1] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc[:] / jnp.maximum(lrow[:, :1], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    """q: [BH, Lq, D]; k, v: [BH, Lk, D] → [BH, Lq, D]."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    bq = min(block_q, lq)
+    bk = min(block_k, lk)
+    assert lq % bq == 0 and lk % bk == 0, (
+        f"sequence lengths ({lq}, {lk}) must be divisible by the block "
+        f"sizes ({bq}, {bk})")
+    nk = lk // bk
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk)
+    grid = (bh, lq // bq, nk)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),     # acc
+            pltpu.VMEM((bq, 128), jnp.float32),   # running max (col 0)
+            pltpu.VMEM((bq, 128), jnp.float32),   # running sum (col 0)
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference(q, k, v, causal, scale):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        mask = jnp.arange(lk)[None, :] <= jnp.arange(lq)[:, None]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused blockwise attention. q, k, v: [B, L, H, D] → [B, Lq, H, D].
+
+    ``interpret=None`` auto-selects: the Pallas interpreter off-TPU (tests),
+    the compiled kernel on TPU.
+    """
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)[0]
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    to3 = lambda x, l: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, x.shape[-1])
+    out3 = _flash_fwd_3d(
+        to3(q, lq), to3(k, lk), to3(v, lk),
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    out = jnp.transpose(out3.reshape(b, h, lq, d), (0, 2, 1, 3))
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    # rematerialized backward through the XLA reference (fwd owns the fusion
+    # win; bwd recompute is the standard flash trade)
+    q, k, v = res
+    sc = scale if scale is not None else q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal, sc), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -1,4 +1,6 @@
 from .trainer import StandardUpdater, Trainer
 from .reports import LogReport, PrintReport
+from .profiling import Profile
 
-__all__ = ["Trainer", "StandardUpdater", "LogReport", "PrintReport"]
+__all__ = ["Trainer", "StandardUpdater", "LogReport", "PrintReport",
+           "Profile"]

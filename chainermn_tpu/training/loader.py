@@ -1,0 +1,130 @@
+"""Prefetching data loader backed by the native runtime.
+
+The reference's hot loop pays host time for iterator.next() + concat +
+to_gpu every step (SURVEY.md §3.1). This loader overlaps batch assembly with
+device compute: a C++ worker thread gathers the next batch's rows into a
+reusable buffer (native/chainermn_native.cpp) while the current step runs,
+and the Python side only wraps the finished buffer as a numpy view. Falls
+back to synchronous numpy assembly without the native lib.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import ctypes
+
+import numpy as np
+
+from chainermn_tpu.ops import native
+
+
+class PrefetchingLoader:
+    """Iterate (x_batch, y_batch) over array data with native prefetch.
+
+    Args:
+      xs, ys: the full data arrays (first axis indexes samples).
+      batch_size: rows per batch.
+      shuffle/seed/epochs: epoch order control (epochs=None → infinite).
+      depth: prefetch depth (buffers in flight).
+    """
+
+    def __init__(self, xs: np.ndarray, ys: np.ndarray, batch_size: int,
+                 shuffle: bool = True, seed: Optional[int] = None,
+                 epochs: Optional[int] = None, depth: int = 2,
+                 n_threads: int = 4):
+        self.xs = np.ascontiguousarray(xs)
+        self.ys = np.ascontiguousarray(ys)
+        self.batch_size = batch_size
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self._epochs = epochs
+        self._depth = depth
+        self._n_threads = n_threads
+        self.epoch = 0
+        self._native = native.get_lib()
+        self._handle = None
+        if self._native is not None:
+            xrow = self.xs.dtype.itemsize * int(
+                np.prod(self.xs.shape[1:], initial=1))
+            yrow = self.ys.dtype.itemsize * int(
+                np.prod(self.ys.shape[1:], initial=1))
+            self._handle = self._native.cmn_loader_create(
+                self.xs.ctypes.data, self.ys.ctypes.data, xrow, yrow,
+                batch_size, depth, n_threads)
+        self._outstanding = 0
+        self._index_iter = self._indices()
+        # pin submitted index arrays until consumed (the C++ side copies at
+        # submit, but keep python-side determinism simple)
+        self._inflight = []
+
+    def _indices(self) -> Iterator[np.ndarray]:
+        n = len(self.xs)
+        while self._epochs is None or self.epoch < self._epochs:
+            order = np.arange(n, dtype=np.int64)
+            if self._shuffle:
+                self._rng.shuffle(order)
+            for at in range(0, n - self.batch_size + 1, self.batch_size):
+                yield order[at:at + self.batch_size]
+            self.epoch += 1
+
+    def _submit_one(self) -> bool:
+        try:
+            idx = next(self._index_iter)
+        except StopIteration:
+            return False
+        idx = np.ascontiguousarray(idx, dtype=np.int64)
+        self._native.cmn_loader_submit(
+            self._handle,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(idx))
+        self._outstanding += 1
+        return True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._handle is None:
+            # numpy fallback: synchronous assembly
+            idx = next(self._index_iter)  # StopIteration ends iteration
+            return (native.gather_rows(self.xs, idx),
+                    native.gather_rows(self.ys, idx))
+        while self._outstanding < self._depth:
+            if not self._submit_one():
+                break
+        if self._outstanding == 0:
+            raise StopIteration
+        xptr = ctypes.c_void_p()
+        yptr = ctypes.c_void_p()
+        buf = self._native.cmn_loader_next(
+            self._handle, ctypes.byref(xptr), ctypes.byref(yptr))
+        self._outstanding -= 1
+        bs = self.batch_size
+        x = np.ctypeslib.as_array(
+            ctypes.cast(xptr, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(bs * self.xs.dtype.itemsize
+                   * int(np.prod(self.xs.shape[1:], initial=1)),),
+        ).view(self.xs.dtype).reshape((bs,) + self.xs.shape[1:])
+        y = np.ctypeslib.as_array(
+            ctypes.cast(yptr, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(bs * self.ys.dtype.itemsize
+                   * int(np.prod(self.ys.shape[1:], initial=1)),),
+        ).view(self.ys.dtype).reshape((bs,) + self.ys.shape[1:])
+        # copy out so the buffer can be recycled immediately; the gather
+        # itself (the expensive part) already happened off-thread
+        x, y = x.copy(), y.copy()
+        self._native.cmn_loader_release(self._handle, buf)
+        return x, y
+
+    next = __next__
+
+    def close(self):
+        if self._handle is not None:
+            self._native.cmn_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,43 @@
+"""Profiler integration.
+
+The reference has no profiler of its own (SURVEY.md §5 — upstream practice
+was Chainer TimerHook + nvprof). Here ``jax.profiler`` gives per-collective
+and per-op device timing natively; this extension captures a trace window
+viewable in TensorBoard/Perfetto/XProf.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class Profile:
+    """Trainer extension: capture a jax.profiler trace for iterations
+    [start, stop). Attach with trigger=(1, 'iteration')::
+
+        trainer.extend(Profile('prof_dir', start=3, stop=8),
+                       trigger=(1, 'iteration'))
+
+    Skips the first iterations so compilation stays out of the trace.
+    """
+
+    def __init__(self, logdir: str, start: int = 3, stop: int = 8):
+        assert stop > start
+        self.logdir = logdir
+        self.start = start
+        self.stop = stop
+        self._active = False
+
+    def __call__(self, trainer=None):
+        it = trainer.updater.iteration
+        if not self._active and it >= self.start and it < self.stop:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and it >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,240 @@
+// chainermn_native — host-side runtime primitives.
+//
+// TPU-native counterpart of the reference's native surface (SURVEY.md §2.2):
+// where the reference ships a Cython NCCL binding plus CuPy pack/unpack
+// kernels (chainermn/nccl/nccl.pyx, communicators/_memory_utility.py), the
+// TPU collectives live in XLA — so the native layer here serves the part XLA
+// does not cover: the host data path. Provides
+//
+//   * flat-buffer pack/unpack (the _memory_utility.pack_params analog) with
+//     a std::thread fan-out — used for checkpoint serialization and
+//     host-staged transports;
+//   * threaded strided row-gather (the hot inner loop of batch assembly:
+//     out[i] = base[indices[i]]) — the data-loader core;
+//   * a double-buffered prefetching batch loader: a worker thread assembles
+//     the next batch into a reusable buffer while the device runs the
+//     current step.
+//
+// Exposed as a plain C ABI for ctypes (pybind11 is not available in this
+// toolchain); see chainermn_tpu/ops/native.py for the Python side.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void parallel_for(int64_t n, int n_threads, void (*fn)(int64_t, int64_t, void*),
+                  void* ctx) {
+  if (n_threads <= 1 || n < 2) {
+    fn(0, n, ctx);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    ts.emplace_back([=] { fn(lo, hi, ctx); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// flat buffer pack / unpack
+// ---------------------------------------------------------------------------
+
+struct PackCtx {
+  const void** srcs;
+  void** dsts;
+  const int64_t* sizes;    // bytes per leaf
+  const int64_t* offsets;  // byte offsets into the flat buffer
+  char* flat;
+  const char* cflat;
+};
+
+static void pack_range(int64_t lo, int64_t hi, void* vctx) {
+  auto* c = static_cast<PackCtx*>(vctx);
+  for (int64_t i = lo; i < hi; ++i)
+    std::memcpy(c->flat + c->offsets[i], c->srcs[i],
+                static_cast<size_t>(c->sizes[i]));
+}
+
+static void unpack_range(int64_t lo, int64_t hi, void* vctx) {
+  auto* c = static_cast<PackCtx*>(vctx);
+  for (int64_t i = lo; i < hi; ++i)
+    std::memcpy(c->dsts[i], c->cflat + c->offsets[i],
+                static_cast<size_t>(c->sizes[i]));
+}
+
+// Pack n buffers into `flat` at `offsets`. Threaded over leaves.
+void cmn_pack(const void** srcs, const int64_t* sizes, const int64_t* offsets,
+              int64_t n, void* flat, int n_threads) {
+  PackCtx c{srcs, nullptr, sizes, offsets, static_cast<char*>(flat), nullptr};
+  parallel_for(n, n_threads, pack_range, &c);
+}
+
+void cmn_unpack(const void* flat, void** dsts, const int64_t* sizes,
+                const int64_t* offsets, int64_t n, int n_threads) {
+  PackCtx c{nullptr, dsts, sizes, offsets, nullptr,
+            static_cast<const char*>(flat)};
+  parallel_for(n, n_threads, unpack_range, &c);
+}
+
+// ---------------------------------------------------------------------------
+// threaded row gather: out[i, :] = base[indices[i], :]
+// ---------------------------------------------------------------------------
+
+struct GatherCtx {
+  const char* base;
+  int64_t row_bytes;
+  const int64_t* indices;
+  char* out;
+};
+
+static void gather_range(int64_t lo, int64_t hi, void* vctx) {
+  auto* c = static_cast<GatherCtx*>(vctx);
+  for (int64_t i = lo; i < hi; ++i)
+    std::memcpy(c->out + i * c->row_bytes,
+                c->base + c->indices[i] * c->row_bytes,
+                static_cast<size_t>(c->row_bytes));
+}
+
+void cmn_gather_rows(const void* base, int64_t row_bytes,
+                     const int64_t* indices, int64_t n, void* out,
+                     int n_threads) {
+  GatherCtx c{static_cast<const char*>(base), row_bytes, indices,
+              static_cast<char*>(out)};
+  parallel_for(n, n_threads, gather_range, &c);
+}
+
+// ---------------------------------------------------------------------------
+// double-buffered prefetching loader
+// ---------------------------------------------------------------------------
+//
+// The loader owns `depth` reusable buffers per stream (x and y). submit()
+// enqueues an index set; a worker thread gathers rows into the next free
+// buffer; next() blocks until the oldest submitted batch is ready and
+// returns its buffer id. The Python side wraps buffer ids as numpy views.
+
+struct Loader {
+  const char* xbase;
+  const char* ybase;
+  int64_t xrow, yrow;  // bytes per row
+  int64_t batch;       // rows per batch
+  int depth;
+  int n_threads;
+  std::vector<std::vector<char>> xbuf, ybuf;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::queue<std::vector<int64_t>> pending;  // submitted index sets
+  std::queue<int> ready;                     // finished buffer ids
+  std::queue<int> freebufs;
+  std::atomic<bool> stop{false};
+  std::thread worker;
+
+  Loader(const void* xb, const void* yb, int64_t xr, int64_t yr, int64_t b,
+         int d, int nt)
+      : xbase(static_cast<const char*>(xb)),
+        ybase(static_cast<const char*>(yb)),
+        xrow(xr), yrow(yr), batch(b), depth(d), n_threads(nt) {
+    xbuf.resize(depth);
+    ybuf.resize(depth);
+    for (int i = 0; i < depth; ++i) {
+      xbuf[i].resize(static_cast<size_t>(xrow * batch));
+      ybuf[i].resize(static_cast<size_t>(yrow * batch));
+      freebufs.push(i);
+    }
+    worker = std::thread([this] { run(); });
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> l(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    worker.join();
+  }
+
+  void run() {
+    for (;;) {
+      std::vector<int64_t> idx;
+      int buf;
+      {
+        std::unique_lock<std::mutex> l(mu);
+        cv.wait(l, [this] {
+          return stop || (!pending.empty() && !freebufs.empty());
+        });
+        if (stop) return;
+        idx = std::move(pending.front());
+        pending.pop();
+        buf = freebufs.front();
+        freebufs.pop();
+      }
+      GatherCtx cx{xbase, xrow, idx.data(), xbuf[buf].data()};
+      parallel_for(static_cast<int64_t>(idx.size()), n_threads, gather_range,
+                   &cx);
+      GatherCtx cy{ybase, yrow, idx.data(), ybuf[buf].data()};
+      parallel_for(static_cast<int64_t>(idx.size()), n_threads, gather_range,
+                   &cy);
+      {
+        std::lock_guard<std::mutex> l(mu);
+        ready.push(buf);
+      }
+      cv.notify_all();
+    }
+  }
+};
+
+void* cmn_loader_create(const void* xbase, const void* ybase, int64_t xrow,
+                        int64_t yrow, int64_t batch, int depth,
+                        int n_threads) {
+  return new Loader(xbase, ybase, xrow, yrow, batch, depth, n_threads);
+}
+
+void cmn_loader_submit(void* h, const int64_t* indices, int64_t n) {
+  auto* l = static_cast<Loader*>(h);
+  {
+    std::lock_guard<std::mutex> lk(l->mu);
+    l->pending.emplace(indices, indices + n);
+  }
+  l->cv.notify_all();
+}
+
+// Blocks until a batch is ready; returns buffer id and writes x/y pointers.
+int cmn_loader_next(void* h, void** xout, void** yout) {
+  auto* l = static_cast<Loader*>(h);
+  std::unique_lock<std::mutex> lk(l->mu);
+  l->cv.wait(lk, [l] { return !l->ready.empty(); });
+  int buf = l->ready.front();
+  l->ready.pop();
+  *xout = l->xbuf[buf].data();
+  *yout = l->ybuf[buf].data();
+  return buf;
+}
+
+// Return a buffer to the free pool once the device owns a copy.
+void cmn_loader_release(void* h, int buf) {
+  auto* l = static_cast<Loader*>(h);
+  {
+    std::lock_guard<std::mutex> lk(l->mu);
+    l->freebufs.push(buf);
+  }
+  l->cv.notify_all();
+}
+
+void cmn_loader_destroy(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,115 @@
+"""Multi-process object plane: the KV-store transport with REAL processes.
+
+The reference tests its MPI object plane by running pytest under
+``mpiexec -n 2`` (SURVEY.md §4). The analog here: spawn two Python
+processes that ``jax.distributed.initialize`` against a local coordinator
+(CPU backend) and drive bcast_obj/allgather_obj/gather_obj/scatter_obj/
+send_obj/recv_obj plus scatter_dataset across them.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+assert jax.process_count() == 2
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from chainermn_tpu.comm.object_plane import ObjectPlane
+
+op = ObjectPlane()
+
+# bcast from 0 and from 1 (the root!=0 relay), twice (sequence numbers)
+for rnd in range(2):
+    got = op.bcast_obj({"round": rnd, "from": 0} if proc_id == 0 else None,
+                       root=0)
+    assert got == {"round": rnd, "from": 0}, got
+    got = op.bcast_obj({"round": rnd, "from": 1} if proc_id == 1 else None,
+                       root=1)
+    assert got == {"round": rnd, "from": 1}, got
+
+# allgather of distinct per-process objects
+out = op.allgather_obj({"rank": proc_id})
+assert out == [{"rank": 0}, {"rank": 1}], out
+
+# gather: only root receives
+g = op.gather_obj(("payload", proc_id), root=1)
+if proc_id == 1:
+    assert g == [("payload", 0), ("payload", 1)], g
+else:
+    assert g is None
+
+# scatter
+sc = op.scatter_obj(["for0", "for1"] if proc_id == 0 else None, root=0)
+assert sc == f"for{proc_id}", sc
+
+# p2p both directions
+if proc_id == 0:
+    op.send_obj([1, 2, 3], dest=1)
+    back = op.recv_obj(src=1)
+    assert back == "pong", back
+else:
+    msg = op.recv_obj(src=0)
+    assert msg == [1, 2, 3], msg
+    op.send_obj("pong", dest=0)
+
+# scatter_dataset across the two processes
+import numpy as np
+from chainermn_tpu.datasets import scatter_dataset
+import chainermn_tpu
+
+comm = chainermn_tpu.create_communicator("xla")
+shard = scatter_dataset(list(range(20)), comm, shuffle=True, seed=1)
+lens = op.allgather_obj(len(shard))
+assert sum(lens) == 20, lens
+all_items = op.allgather_obj([shard[i] for i in range(len(shard))])
+flat = sorted(x for lst in all_items for x in lst)
+assert flat == list(range(20)), flat
+
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(120)
+def test_two_process_object_plane(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["REPO_ROOT"] = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # single device per process is fine
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(i), str(port)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=110)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"WORKER{i} OK" in out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

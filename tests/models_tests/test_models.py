@@ -101,3 +101,21 @@ def test_seq2seq_learns_copy_task():
         if first is None:
             first = float(loss)
     assert float(loss) < 0.5 * first
+
+
+def test_greedy_translate_shapes_and_eos_masking():
+    from chainermn_tpu.models.seq2seq import greedy_translate
+    import jax.numpy as jnp
+
+    m = Seq2Seq(n_layers=1, n_units=32, src_vocab=30, tgt_vocab=30)
+    pairs = [(np.array([5, 6, 7]), np.array([7, 6, 5]))]
+    src, sl, ti, to = pad_batch(pairs, 8)
+    v = m.init(jax.random.PRNGKey(0), src, sl, ti)
+    out = np.asarray(greedy_translate(m, v, jnp.asarray(src),
+                                      jnp.asarray(sl), max_len=12))
+    assert out.shape == (1, 12) and out.dtype == np.int32
+    # everything after the first EOS must be PAD
+    row = out[0]
+    if (row == EOS).any():
+        first = int(np.argmax(row == EOS))
+        assert (row[first + 1:] == PAD).all()

@@ -1,0 +1,74 @@
+"""Pallas flash attention vs reference (interpreter mode off-TPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.ops.flash_attention import _reference, flash_attention
+
+
+def _qkv(b=2, l=128, h=2, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, l, h, d).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_reference(causal):
+    q, k, v = _qkv()
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          causal, None, 64, 64, True)
+    ref = _reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                     causal, q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_multi_block_q_and_k():
+    q, k, v = _qkv(b=1, l=256, h=1, d=16, seed=3)
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          True, None, 64, 64, True)
+    ref = _reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                     True, q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_gradients_match():
+    q, k, v = _qkv(b=1, l=64, h=1, d=16, seed=1)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, None, 32, 32, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, True, q.shape[-1] ** -0.5) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_indivisible_length_raises():
+    q, k, v = _qkv(b=1, l=100, h=1, d=16)
+    with pytest.raises(AssertionError):
+        flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        False, None, 64, 64, True)
+
+
+def test_bfloat16_io():
+    q, k, v = _qkv(b=1, l=64, h=1, d=32, seed=2)
+    qb = jnp.asarray(q, jnp.bfloat16)
+    out = flash_attention(qb, jnp.asarray(k, jnp.bfloat16),
+                          jnp.asarray(v, jnp.bfloat16), False, None,
+                          64, 64, True)
+    assert out.dtype == jnp.bfloat16
+    ref = _reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                     False, q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=3e-2, atol=3e-2)
