@@ -33,9 +33,11 @@ def main():
     try:
         from chainermn_tpu.models.resnet import ResNet50
 
-        model = ResNet50(num_classes=1000)
+        # bf16 compute (fp32 params/BN stats) keeps the MXU fed; batch 128
+        # per chip measured fastest on v5e (2541 im/s vs 1130 at fp32/b32).
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
         image = np.zeros((2, 224, 224, 3), np.float32)
-        per_device_batch = 32
+        per_device_batch = 128
         name = "resnet50"
         mutable = ("batch_stats",)
     except ImportError:
