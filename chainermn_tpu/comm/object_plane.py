@@ -48,13 +48,23 @@ class ObjectPlane:
     communicator's internal one both starting at seq 0). SPMD discipline
     (every process runs the same program, hence the same call order) keeps
     the counters aligned across processes, exactly like MPI collectives.
+    The counters are scoped to the coordinator client: re-initializing
+    jax.distributed gives a fresh KV namespace, so planes created after that
+    must restart at seq 0 or they desync from peers that start fresh.
     """
 
     _seq: dict = {}
+    # strong ref to the coordinator client the counters belong to; `is`
+    # comparison is unambiguous (an id() would be reusable after free)
+    _seq_client: Any = None
 
     def __init__(self) -> None:
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
+        client = _client()
+        if client is not ObjectPlane._seq_client:
+            ObjectPlane._seq_client = client
+            ObjectPlane._seq.clear()
         self._p2p_seq = ObjectPlane._seq
 
     # -- collectives ----------------------------------------------------

@@ -4,8 +4,9 @@ Reference parity note: the reference's only custom device kernels are CuPy
 cast/pack elementwise kernels (SURVEY.md §2.2); XLA already fuses those here.
 The kernel worth hand-writing on TPU is blockwise attention: one pass over
 K/V tiles in VMEM with online softmax, never materializing the [L, L] score
-matrix in HBM. Used standalone or as the per-block compute inside ring
-attention (chainermn_tpu/parallel/ring_attention.py).
+matrix in HBM. Usable standalone; ring attention
+(chainermn_tpu/parallel/ring_attention.py) currently uses its own XLA
+blockwise compute and can adopt this kernel as the per-block inner loop.
 
 Layout: [B, L, H, D] → kernel works on [B*H, L, D]. Grid is
 (batch*heads, q_blocks, kv_blocks) with the kv dimension innermost; VMEM
@@ -25,15 +26,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30  # finite stand-in: -inf breaks max/exp chains on the VPU
-
-
-def _cdiv(a, b):
-    return -(-a // b)
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, mrow, lrow, *, scale,

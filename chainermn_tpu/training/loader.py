@@ -10,6 +10,7 @@ back to synchronous numpy assembly without the native lib.
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Iterator, Optional
 
 import ctypes
@@ -42,6 +43,7 @@ class PrefetchingLoader:
         self._depth = depth
         self._n_threads = n_threads
         self.epoch = 0
+        self.is_new_epoch = False
         self._native = native.get_lib()
         self._handle = None
         if self._native is not None:
@@ -54,29 +56,36 @@ class PrefetchingLoader:
                 batch_size, depth, n_threads)
         self._outstanding = 0
         self._index_iter = self._indices()
-        # pin submitted index arrays until consumed (the C++ side copies at
-        # submit, but keep python-side determinism simple)
-        self._inflight = []
+        # epochs-completed value for each submitted-but-not-yet-returned
+        # batch, FIFO — ``self.epoch`` must track the batch the caller
+        # RECEIVES, not how far ahead the prefetcher has drained the
+        # index generator
+        self._pending_epochs: deque = deque()
 
-    def _indices(self) -> Iterator[np.ndarray]:
+    def _indices(self) -> Iterator[tuple]:
+        """Yields (epochs_completed_after_this_batch, index_array)."""
         n = len(self.xs)
-        while self._epochs is None or self.epoch < self._epochs:
+        ep = 0
+        while self._epochs is None or ep < self._epochs:
             order = np.arange(n, dtype=np.int64)
             if self._shuffle:
                 self._rng.shuffle(order)
-            for at in range(0, n - self.batch_size + 1, self.batch_size):
-                yield order[at:at + self.batch_size]
-            self.epoch += 1
+            starts = list(range(0, n - self.batch_size + 1, self.batch_size))
+            for j, at in enumerate(starts):
+                done = ep + 1 if j == len(starts) - 1 else ep
+                yield done, order[at:at + self.batch_size]
+            ep += 1
 
     def _submit_one(self) -> bool:
         try:
-            idx = next(self._index_iter)
+            ep, idx = next(self._index_iter)
         except StopIteration:
             return False
         idx = np.ascontiguousarray(idx, dtype=np.int64)
         self._native.cmn_loader_submit(
             self._handle,
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(idx))
+        self._pending_epochs.append(ep)
         self._outstanding += 1
         return True
 
@@ -86,9 +95,12 @@ class PrefetchingLoader:
     def __next__(self):
         if self._handle is None:
             # numpy fallback: synchronous assembly
-            idx = next(self._index_iter)  # StopIteration ends iteration
-            return (native.gather_rows(self.xs, idx),
-                    native.gather_rows(self.ys, idx))
+            ep, idx = next(self._index_iter)  # StopIteration ends iteration
+            batch = (native.gather_rows(self.xs, idx),
+                     native.gather_rows(self.ys, idx))
+            self.is_new_epoch = ep > self.epoch
+            self.epoch = ep
+            return batch
         while self._outstanding < self._depth:
             if not self._submit_one():
                 break
@@ -114,6 +126,9 @@ class PrefetchingLoader:
         # itself (the expensive part) already happened off-thread
         x, y = x.copy(), y.copy()
         self._native.cmn_loader_release(self._handle, buf)
+        ep = self._pending_epochs.popleft()
+        self.is_new_epoch = ep > self.epoch
+        self.epoch = ep
         return x, y
 
     next = __next__

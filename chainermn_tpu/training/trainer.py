@@ -137,14 +137,28 @@ class Trainer:
 
     def run(self):
         start = time.time()
-        while not self._stopped():
-            try:
-                self.updater.update()
-            except StopIteration:
-                break  # non-repeating iterator exhausted
-            due = [e for e in self._extensions if e.due(self.updater)]
-            if due:
-                self._materialize_observation(start)
-                for e in due:
-                    e.ext(self)
-        self._materialize_observation(start)
+        try:
+            while not self._stopped():
+                try:
+                    self.updater.update()
+                except StopIteration:
+                    break  # non-repeating iterator exhausted
+                due = [e for e in self._extensions if e.due(self.updater)]
+                if due:
+                    self._materialize_observation(start)
+                    for e in due:
+                        e.ext(self)
+            self._materialize_observation(start)
+        finally:
+            # finalize extensions that hold external resources (an open
+            # jax.profiler trace, checkpoint writers) even when the run ends
+            # before their stop condition or raises
+            for e in self._extensions:
+                close = getattr(e.ext, "close", None)
+                if callable(close):
+                    try:
+                        close()
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()

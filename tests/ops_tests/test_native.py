@@ -71,3 +71,34 @@ def test_loader_infinite_mode():
         next(loader)
     assert loader.epoch >= 2
     loader.close()
+
+
+def test_loader_epoch_tracks_consumed_batches():
+    # loader.epoch must reflect the batch the caller RECEIVED, not how far
+    # ahead the prefetcher drained the index generator
+    n, bs = 64, 16
+    xs = np.zeros((n, 2), np.float32)
+    ys = np.zeros((n,), np.int32)
+    loader = PrefetchingLoader(xs, ys, bs, shuffle=False, epochs=2, depth=4)
+    seen = []
+    for _ in loader:
+        seen.append((loader.epoch, loader.is_new_epoch))
+    per_epoch = n // bs
+    assert len(seen) == 2 * per_epoch
+    # epoch stays 0 through the first epoch's batches, flips to 1 exactly on
+    # its last batch, and to 2 on the final batch
+    assert [e for e, _ in seen] == [0] * (per_epoch - 1) + [1] \
+        + [1] * (per_epoch - 1) + [2]
+    assert [f for _, f in seen] == ([False] * (per_epoch - 1) + [True]) * 2
+    loader.close()
+
+
+def test_loader_epoch_fallback_path_matches_native(monkeypatch):
+    n, bs = 32, 8
+    xs = np.zeros((n, 2), np.float32)
+    ys = np.zeros((n,), np.int32)
+    # force the numpy fallback path without creating a native handle
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    loader = PrefetchingLoader(xs, ys, bs, shuffle=False, epochs=1)
+    epochs = [loader.epoch for _ in loader]
+    assert epochs == [0] * (n // bs - 1) + [1]

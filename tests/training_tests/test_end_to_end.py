@@ -68,3 +68,33 @@ def test_trainer_iteration_trigger_counts():
                    trigger=(2, "iteration"))
     trainer.run()
     assert fires == [2, 4, 6, 8]
+
+
+def test_trainer_closes_extensions_on_exit():
+    # extensions holding external resources (profiler trace, checkpoint
+    # writers) must be finalized when the run ends before their stop
+    # condition — the Profile extension regression
+    comm = chainermn_tpu.create_communicator("xla")
+    train = synthetic_mnist(256, seed=0)
+    model = MLP(n_units=16, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    step = make_data_parallel_train_step(model, opt, comm)
+    it = SerialIterator(train, 64, shuffle=False)
+    updater = StandardUpdater(it, step, (comm.bcast_data(params),
+                                         opt.init(params)), comm)
+    trainer = Trainer(updater, stop_trigger=(2, "iteration"))
+
+    closed = []
+
+    class Ext:
+        def __call__(self, t):
+            pass
+
+        def close(self):
+            closed.append(True)
+
+    trainer.extend(Ext(), trigger=(1, "iteration"))
+    trainer.run()
+    assert closed == [True]
