@@ -1,3 +1,4 @@
+from .expert_parallel import ExpertParallelMLP, switch_dispatch
 from .pipeline import pipeline_apply, stack_stage_params
 from .ring_attention import local_attention_reference, ring_attention
 from .tensor_parallel import (
@@ -14,4 +15,6 @@ __all__ = [
     "ColumnParallelDense",
     "RowParallelDense",
     "TensorParallelMLP",
+    "ExpertParallelMLP",
+    "switch_dispatch",
 ]

@@ -1,0 +1,153 @@
+"""Expert parallelism: Switch-style mixture-of-experts over a mesh axis.
+
+Beyond-reference capability (SURVEY.md §2.6 records EP as absent upstream;
+the rebuild provides it as a first-class parallelism strategy alongside
+dp/tp/pp/sp). TPU-first design constraints drive everything here:
+
+* **Static shapes.** Routing is data-dependent, but XLA needs static
+  shapes, so dispatch uses a fixed per-expert ``capacity`` with overflow
+  tokens dropped (Switch Transformer's discipline) — no dynamic gather.
+* **all_to_all over ICI.** Token exchange is one ``lax.all_to_all`` each
+  way, the bandwidth-optimal expert shuffle; XLA lowers it onto the ICI
+  torus directly.
+* **MXU-shaped expert compute.** Tokens arrive as a dense
+  ``[experts_local, n_dev * capacity, d]`` block so the expert FFN is a
+  plain batched matmul.
+
+The dispatch/combine construction (one-hot + cumsum position bookkeeping)
+is the standard public GShard/Switch formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "switch_dispatch",
+    "ExpertParallelMLP",
+]
+
+
+def switch_dispatch(router_probs, capacity: int):
+    """Top-1 dispatch/combine tensors with a static per-expert capacity.
+
+    Args:
+      router_probs: ``[tokens, experts]`` softmax router output.
+      capacity: max tokens any expert accepts (from this shard).
+
+    Returns:
+      ``(dispatch, combine, aux_loss)`` where ``dispatch`` is a 0/1
+      ``[tokens, experts, capacity]`` routing tensor, ``combine`` is
+      ``dispatch`` scaled by the router gate, and ``aux_loss`` is the
+      Switch load-balancing loss (experts * sum(fraction_routed *
+      mean_prob), minimized at uniform routing).
+    """
+    t, e = router_probs.shape
+    expert_idx = jnp.argmax(router_probs, axis=-1)
+    gate = jnp.take_along_axis(
+        router_probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=router_probs.dtype)
+    # 1-based arrival position of each token within its expert's queue.
+    # Position bookkeeping is exact int32 — a low-precision (bf16) cumsum
+    # would collide positions past 256 tokens and double-book slots.
+    onehot_i = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot_i, axis=0) * onehot_i
+    keep = (pos > 0) & (pos <= capacity)
+    slot = jax.nn.one_hot(
+        jnp.sum(pos, axis=-1) - 1, capacity, dtype=router_probs.dtype)
+    dispatch = (onehot * keep.astype(router_probs.dtype)
+                )[:, :, None] * slot[:, None, :]
+    combine = dispatch * gate[:, None, None]
+
+    fraction_routed = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(router_probs, axis=0)
+    aux_loss = e * jnp.sum(fraction_routed * mean_prob)
+    return dispatch, combine, aux_loss
+
+
+class ExpertParallelMLP(nn.Module):
+    """Mixture-of-experts FFN with experts sharded over ``axis_name``.
+
+    Use under ``shard_map`` with tokens sharded over the expert axis; this
+    shard holds ``experts_per_device`` experts' weights. Total experts =
+    ``axis_size * experts_per_device``.
+
+    Parameter-sync contract: the expert tables (``w1``..``b2``) are
+    per-shard (init with a rank-folded RNG, like the TP modules), but the
+    ``router`` kernel is REPLICATED — give it identical initial values on
+    every shard and ``pmean`` its gradient over ``axis_name`` (it is a
+    data-parallel parameter; each shard's grad sees local tokens only).
+    The tests' ``_stack_expert_params`` shows the layout.
+
+    Returns ``(y, aux_loss)``: the combined expert outputs per local token
+    (overflow tokens get zeros, the Switch convention — pair with a
+    residual connection) and the load-balancing loss term.
+    """
+
+    hidden: int
+    experts_per_device: int = 1
+    axis_name: str = "expert"
+    capacity_factor: float = 1.25
+    act: Callable = nn.gelu
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        n_dev = lax.axis_size(self.axis_name)
+        e_tot = n_dev * self.experts_per_device
+        t, d = x.shape
+        capacity = max(1, int(t * self.capacity_factor / e_tot))
+
+        # Router is logically replicated (same weights every shard).
+        logits = nn.Dense(e_tot, use_bias=False, name="router",
+                          dtype=self.dtype)(x)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        dispatch, combine, aux = switch_dispatch(probs, capacity)
+        dispatch = dispatch.astype(x.dtype)
+        combine = combine.astype(x.dtype)
+
+        # [t, e_tot, c] x [t, d] -> [e_tot, c, d], then shuffle so each
+        # device holds all shards' tokens for ITS local experts.
+        exp_in = jnp.einsum("tec,td->ecd", dispatch, x)
+        exp_in = exp_in.reshape(
+            n_dev, self.experts_per_device, capacity, d)
+        exp_in = lax.all_to_all(
+            exp_in, self.axis_name, split_axis=0, concat_axis=0)
+        # [n_dev(src), experts_local, c, d] -> [experts_local, n_dev*c, d]
+        exp_in = exp_in.transpose(1, 0, 2, 3).reshape(
+            self.experts_per_device, n_dev * capacity, d)
+
+        # This shard's experts: one batched column of weights per expert.
+        w1 = self.param(
+            "w1", nn.initializers.lecun_normal(),
+            (self.experts_per_device, d, self.hidden))
+        b1 = self.param("b1", nn.initializers.zeros_init(),
+                        (self.experts_per_device, self.hidden))
+        w2 = self.param(
+            "w2", nn.initializers.lecun_normal(),
+            (self.experts_per_device, self.hidden, d))
+        b2 = self.param("b2", nn.initializers.zeros_init(),
+                        (self.experts_per_device, d))
+        cdtype = self.dtype or exp_in.dtype
+        h = self.act(jnp.einsum(
+            "end,edh->enh", exp_in.astype(cdtype), w1.astype(cdtype))
+            + b1[:, None, :].astype(cdtype))
+        exp_out = jnp.einsum("enh,ehd->end", h, w2.astype(cdtype)) \
+            + b2[:, None, :].astype(cdtype)
+
+        # Reverse shuffle back to the token-owning shards.
+        exp_out = exp_out.reshape(
+            self.experts_per_device, n_dev, capacity, d).transpose(
+            1, 0, 2, 3)
+        exp_out = lax.all_to_all(
+            exp_out, self.axis_name, split_axis=0, concat_axis=0)
+        exp_out = exp_out.reshape(e_tot, capacity, d)
+
+        y = jnp.einsum("tec,ecd->td", combine, exp_out.astype(x.dtype))
+        return y, aux.astype(jnp.float32)

@@ -36,6 +36,12 @@ class PrefetchingLoader:
                  n_threads: int = 4):
         self.xs = np.ascontiguousarray(xs)
         self.ys = np.ascontiguousarray(ys)
+        if batch_size > len(self.xs):
+            # _indices would otherwise yield nothing and, with
+            # epochs=None, spin forever re-shuffling an empty schedule
+            raise ValueError(
+                f"batch_size {batch_size} exceeds dataset size "
+                f"{len(self.xs)}")
         self.batch_size = batch_size
         self._shuffle = shuffle
         self._rng = np.random.RandomState(seed)

@@ -86,6 +86,7 @@ class _Entry:
         self.n, self.unit = trigger
         self.name = name
         self._last_epoch = 0
+        self.closed = False
 
     def due(self, updater) -> bool:
         if self.unit == "iteration":
@@ -143,7 +144,8 @@ class Trainer:
                     self.updater.update()
                 except StopIteration:
                     break  # non-repeating iterator exhausted
-                due = [e for e in self._extensions if e.due(self.updater)]
+                due = [e for e in self._extensions
+                       if not e.closed and e.due(self.updater)]
                 if due:
                     self._materialize_observation(start)
                     for e in due:
@@ -154,8 +156,11 @@ class Trainer:
             # jax.profiler trace, checkpoint writers) even when the run ends
             # before their stop condition or raises
             for e in self._extensions:
+                if e.closed:
+                    continue  # a prior run() already released it
                 close = getattr(e.ext, "close", None)
                 if callable(close):
+                    e.closed = True
                     try:
                         close()
                     except Exception:
