@@ -1,3 +1,4 @@
 from .mlp import MLP
+from .transformer import TransformerLM
 
-__all__ = ["MLP"]
+__all__ = ["MLP", "TransformerLM"]

@@ -117,6 +117,140 @@ def make_data_parallel_train_step(
     return step
 
 
+def _is_expert_path(path, expert_key: str) -> bool:
+    """True for per-shard expert tables. The router lives under the MoE
+    module too but is data-parallel (replicated; see ExpertParallelMLP's
+    parameter-sync contract), so it is explicitly excluded."""
+    parts = [str(getattr(k, "key", k)) for k in path]
+    return (any(expert_key in p for p in parts)
+            and not any("router" in p for p in parts))
+
+
+def init_expert_parallel_state(model, comm, rng, sample, optimizer,
+                               expert_key: str = "moe"):
+    """Initialize a model containing expert-parallel layers.
+
+    Expert leaves (param path containing ``expert_key``) are per-shard:
+    each mesh shard initializes its own experts (rank-folded RNG) and the
+    global array concatenates them over the comm axis (sharded ``P(ax)``).
+    Every other leaf is replicated — shard 0's init wins.
+
+    Returns ``(state, param_specs)`` where ``state = (params, opt_state)``
+    and ``param_specs`` is the PartitionSpec pytree
+    (make_expert_parallel_train_step needs it).
+    """
+    mesh = comm.mesh
+    ax = comm.axis_names[0]
+
+    def init_fn(toks):
+        r = jax.random.fold_in(rng, lax.axis_index(ax))
+        params = model.init(r, toks)["params"]
+
+        def fix(path, leaf):
+            if _is_expert_path(path, expert_key):
+                return leaf                       # this shard's experts
+            return lax.all_gather(leaf, ax)[0]    # replicate shard 0's init
+
+        return jax.tree_util.tree_map_with_path(fix, params)
+
+    # structure discovery pass (shapes only — out_specs don't matter here)
+    abs_params = jax.eval_shape(
+        shard_map(init_fn, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                  check_vma=False),
+        sample,
+    )
+    param_specs = jax.tree_util.tree_map_with_path(
+        lambda path, _: P(ax) if _is_expert_path(path, expert_key) else P(),
+        abs_params,
+    )
+    params = jax.jit(shard_map(
+        init_fn, mesh=mesh, in_specs=(P(),), out_specs=param_specs,
+        check_vma=False,
+    ))(sample)
+    opt_state = jax.jit(optimizer.init)(params)  # shardings follow params
+    return (params, opt_state), param_specs
+
+
+def make_expert_parallel_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm,
+    param_specs,
+    loss_fn: Optional[Callable] = None,
+    expert_key: str = "moe",
+    donate: bool = True,
+):
+    """Train step for models with expert-parallel (MoE) layers.
+
+    Shared parameters are data-parallel (replicated; their gradients are
+    globally reduced by shard_map's replication typing — do NOT wrap the
+    optimizer in create_multi_node_optimizer here, that would re-reduce).
+    Expert parameters stay sharded over the comm axis: each shard owns and
+    updates its experts; their gradients already aggregate every shard's
+    tokens through the all_to_all transpose, so no collective touches them.
+
+    ``param_specs`` comes from init_expert_parallel_state. ``optimizer`` is
+    a PLAIN optax transformation.
+    """
+    lf = loss_fn or classifier_loss
+    mesh = comm.mesh
+    axes = comm.axis_names
+    dspec = P(axes if len(axes) > 1 else axes[0])
+
+    def local_step(state, x, y):
+        params, opt_state = state
+
+        def f(p):
+            loss, (acc, _) = lf(model, p, x, y, train=True)
+            # global-mean objective; expert grads flow through the
+            # all_to_all transpose, shared grads through replication typing
+            return lax.pmean(loss, axes), acc
+
+        (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {
+            "main/loss": loss,
+            "main/accuracy": lax.pmean(acc, axes),
+        }
+        return (params, opt_state), metrics
+
+    def opt_spec_like(tree):
+        """Specs over an opt-state pytree: leaves on an expert path are
+        sharded, the rest (incl. step counters) replicated."""
+        # same single-axis sharding as param_specs (axes[0]) — a multi-axis
+        # spec here would disagree with the params' local shapes
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: P(axes[0])
+            if _is_expert_path(path, expert_key) and getattr(leaf, "ndim", 0)
+            else P(),
+            tree,
+        )
+
+    def build(state):
+        params, opt_state = state
+        opt_specs = opt_spec_like(opt_state)
+        return jax.jit(
+            shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=((param_specs, opt_specs), dspec, dspec),
+                out_specs=(((param_specs, opt_specs)), P()),
+            ),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    compiled = {}
+
+    def step(state, x, y):
+        key = jax.tree_util.tree_structure(state)
+        if key not in compiled:
+            compiled[key] = build(state)
+        return compiled[key](state, x, y)
+
+    return step
+
+
 def make_eval_step(model, comm, loss_fn: Optional[Callable] = None,
                    extra_vars_in_state: bool = False):
     """Jitted eval step: (state, x, y) -> metrics dict (pmean-reduced)."""

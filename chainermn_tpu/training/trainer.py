@@ -137,6 +137,14 @@ class Trainer:
         self.observation["elapsed_time"] = time.time() - start
 
     def run(self):
+        if any(e.closed for e in self._extensions):
+            # a prior run() finalized extensions holding external
+            # resources; silently skipping (or re-firing) them would lose
+            # data — resuming needs a fresh Trainer
+            raise RuntimeError(
+                "this Trainer already ran and finalized its extensions; "
+                "construct a new Trainer (re-attaching extensions) to "
+                "resume")
         start = time.time()
         try:
             while not self._stopped():
@@ -144,8 +152,7 @@ class Trainer:
                     self.updater.update()
                 except StopIteration:
                     break  # non-repeating iterator exhausted
-                due = [e for e in self._extensions
-                       if not e.closed and e.due(self.updater)]
+                due = [e for e in self._extensions if e.due(self.updater)]
                 if due:
                     self._materialize_observation(start)
                     for e in due:
