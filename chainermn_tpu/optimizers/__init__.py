@@ -24,6 +24,10 @@ import jax
 import optax
 
 from chainermn_tpu.comm.base import CommunicatorBase
+from chainermn_tpu.optimizers.zero import (  # noqa: F401
+    make_zero1_train_step,
+    zero1_params,
+)
 
 
 class _DoubleBufferState(NamedTuple):

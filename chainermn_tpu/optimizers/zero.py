@@ -1,0 +1,143 @@
+"""ZeRO-1 optimizer-state sharding over the data axis.
+
+Beyond the reference's surface (ChainerMN replicates optimizer state on every
+rank — SURVEY.md §2.5's `_MultiNodeOptimizer` wraps a whole local optimizer),
+but the TPU-natural extension of the same design: the gradient all-reduce is
+split into a ``psum_scatter`` (each shard receives the reduced 1/N slice of
+the flat gradient), the optimizer updates only its slice of parameters and
+state, and the updated parameters are re-assembled with ``all_gather``. Same
+total communication volume as one all-reduce (reduce-scatter + all-gather is
+how a ring all-reduce decomposes anyway — the reference's
+TwoDimensionalCommunicator hand-wrote exactly this split), 1/N the optimizer
+memory: Adam's m/v for ResNet-50 drop from 2x model size per chip to 2x/N.
+
+Layout: parameters are flattened to one vector (the reference's
+``_memory_utility`` flat-buffer idea, now load-bearing), padded to a multiple
+of the axis size, and sharded on the leading dim. The step gathers the full
+vector and unravels it; XLA schedules the gather against early-layer compute.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P
+
+
+def make_zero1_train_step(
+    model,
+    optimizer: optax.GradientTransformation,
+    comm,
+    params,
+    loss_fn: Optional[Callable] = None,
+    donate: bool = True,
+) -> Tuple[Callable, Tuple]:
+    """Build a jitted ZeRO-1 data-parallel train step and its initial state.
+
+    Returns ``(step, state)``::
+
+        step, state = make_zero1_train_step(model, optax.adam(1e-3), comm,
+                                            params)
+        state, metrics = step(state, x, y)
+        params = zero1_params(state, params)   # re-assembled pytree
+
+    ``state = (param_shard, opt_state)``: ``param_shard`` is the flat
+    parameter vector sharded over the communicator axis; ``opt_state`` is the
+    optimizer state over that shard (scalar leaves, e.g. step counts, stay
+    replicated).
+
+    Restrictions: the communicator must span a single mesh axis (split a
+    hybrid mesh first); parameter leaves must share one dtype
+    (``ravel_pytree`` concatenates them — fp32 params with bf16 *compute* is
+    fine, the model casts internally); models with mutable collections (BN
+    stats) should use
+    :func:`~chainermn_tpu.training.step.make_data_parallel_train_step`; and
+    ``optimizer`` must be element-wise (sgd/momentum/adam/adamw...). The
+    update runs on the flat parameter vector, so structure-dependent
+    transforms — per-layer trust ratios (LARS/LAMB), masked weight decay,
+    ``multi_transform`` — would silently compute wrong updates.
+
+    The gradient reduction op is ``mean`` (the reference's
+    ``allreduce_grad`` contract); do NOT additionally wrap ``optimizer`` in
+    ``create_multi_node_optimizer``.
+    """
+    from chainermn_tpu.training.step import classifier_loss
+
+    lf = loss_fn or classifier_loss
+    mesh = comm.mesh
+    ax = comm.axis_name  # raises on multi-axis comms (single-axis only)
+    n = comm.size
+    axes = comm.axis_names
+    dspec = P(ax)
+
+    flat, unravel = ravel_pytree(params)
+    total = flat.size
+    padded = total + ((-total) % n)
+    shard_shape = (padded // n,)
+
+    # -- initial state ---------------------------------------------------
+    def init_fn(params):
+        v = ravel_pytree(params)[0]
+        if padded != total:
+            v = jnp.concatenate(
+                [v, jnp.zeros((padded - total,), v.dtype)])
+        i = lax.axis_index(ax)
+        shard = lax.dynamic_slice_in_dim(v, i * shard_shape[0],
+                                         shard_shape[0])
+        return shard, optimizer.init(shard)
+
+    abs_opt = jax.eval_shape(
+        optimizer.init, jax.ShapeDtypeStruct(shard_shape, flat.dtype))
+    opt_specs = jax.tree_util.tree_map(
+        lambda l: P(ax) if l.shape == shard_shape else P(), abs_opt)
+
+    state = jax.jit(shard_map(
+        init_fn, mesh=mesh, in_specs=(P(),),
+        out_specs=(P(ax), opt_specs), check_vma=False,
+    ))(params)
+
+    # -- the step --------------------------------------------------------
+    def local_step(state, x, y):
+        p_shard, opt_state = state
+        full = lax.all_gather(p_shard, ax, tiled=True)
+        p = unravel(full[:total])
+
+        def f(p):
+            loss, (acc, _) = lf(model, p, x, y, train=True)
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(p)
+        g = ravel_pytree(grads)[0]
+        if padded != total:
+            g = jnp.concatenate([g, jnp.zeros((padded - total,), g.dtype)])
+        g_shard = lax.psum_scatter(g, ax, tiled=True) / n
+        updates, opt_state = optimizer.update(g_shard, opt_state, p_shard)
+        p_shard = optax.apply_updates(p_shard, updates)
+        metrics = {
+            "main/loss": lax.pmean(loss, axes),
+            "main/accuracy": lax.pmean(acc, axes),
+        }
+        return (p_shard, opt_state), metrics
+
+    step = jax.jit(
+        shard_map(
+            local_step, mesh=mesh,
+            in_specs=((P(ax), opt_specs), dspec, dspec),
+            out_specs=((P(ax), opt_specs), P()),
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+    return step, state
+
+
+def zero1_params(state, like_params):
+    """Re-assemble the full parameter pytree from a ZeRO-1 state (driver
+    level — for checkpointing, eval, or export)."""
+    flat, unravel = ravel_pytree(like_params)
+    full = jnp.asarray(state[0]).reshape(-1)[: flat.size]
+    return unravel(full)

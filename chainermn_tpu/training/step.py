@@ -59,6 +59,8 @@ def make_data_parallel_train_step(
     loss_fn: Optional[Callable] = None,
     mutable: Optional[Tuple[str, ...]] = None,
     donate: bool = True,
+    grad_accum: int = 1,
+    remat: Any = False,
 ):
     """Build the jitted data-parallel train step.
 
@@ -69,6 +71,14 @@ def make_data_parallel_train_step(
     semantics). The optimizer should already wrap the communicator
     (create_multi_node_optimizer); a plain optax optimizer also works when
     autodiff inserts the psum (default shard_map mode).
+
+    ``grad_accum=N`` splits each shard's batch into N micro-batches and
+    accumulates gradients over a ``lax.scan`` — same optimizer math as the
+    full batch at 1/N the activation memory (micro-batch moments differ for
+    BN, as in every framework). ``remat`` rematerializes the forward during
+    backward (``True`` for full remat, or a ``jax.checkpoint`` policy, e.g.
+    ``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``) — the
+    HBM-for-FLOPs trade the task's hardware notes call for.
     """
     lf = loss_fn or classifier_loss
     mesh = comm.mesh
@@ -82,12 +92,54 @@ def make_data_parallel_train_step(
             params, opt_state = state
             extra = None
 
-        def f(p):
+        def f(p, x, y, extra):
             return lf(model, p, x, y, train=True, mutable=mutable,
                       extra_vars=extra)
 
-        (loss, (acc, new_vars)), grads = jax.value_and_grad(
-            f, has_aux=True)(params)
+        if remat:
+            policy = None if remat is True else remat
+            f = jax.checkpoint(f, policy=policy)
+
+        if grad_accum > 1:
+            b = x.shape[0]
+            assert b % grad_accum == 0, (
+                f"per-shard batch {b} not divisible by grad_accum "
+                f"{grad_accum}")
+            xm = x.reshape((grad_accum, b // grad_accum) + x.shape[1:])
+            ym = y.reshape((grad_accum, b // grad_accum) + y.shape[1:])
+
+            def one(extra_c, xi, yi):
+                (loss, (acc, new_vars)), g = jax.value_and_grad(
+                    f, has_aux=True)(params, xi, yi, extra_c)
+                new_extra = (
+                    {k: new_vars[k] for k in mutable} if mutable else extra_c
+                )
+                return g, loss, acc, new_extra
+
+            def micro(carry, xy):
+                g_acc, loss_acc, acc_acc, extra_c = carry
+                g, loss, acc, new_extra = one(extra_c, *xy)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+                return (g_acc, loss_acc + loss, acc_acc + acc,
+                        new_extra), None
+
+            # The first micro-batch runs outside the scan so the carry is
+            # initialized with each component's TRUE varying-axis type:
+            # grads w.r.t. replicated params arrive already psummed
+            # (axis-invariant) under vma tracking — casting a zeros carry to
+            # varying here would make allreduce_grad re-reduce them (an N x
+            # gradient), while leaving it invariant breaks BN state (varying).
+            g0, l0, a0, e0 = one(extra, xm[0], ym[0])
+            (g_sum, loss_sum, acc_sum, new_extra), _ = lax.scan(
+                micro, (g0, l0, a0, e0), (xm[1:], ym[1:]))
+            grads = jax.tree_util.tree_map(
+                lambda g: g / grad_accum, g_sum)
+            loss = loss_sum / grad_accum
+            acc = acc_sum / grad_accum
+            new_vars = new_extra if mutable else {}
+        else:
+            (loss, (acc, new_vars)), grads = jax.value_and_grad(
+                f, has_aux=True)(params, x, y, extra)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = {

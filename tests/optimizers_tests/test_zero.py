@@ -1,0 +1,113 @@
+"""ZeRO-1 sharded optimizer tests.
+
+Oracle (the reference suite's style, SURVEY.md §4): the sharded-optimizer
+step must match the replicated-optimizer step bit-for-bit-ish (allclose) on
+the same data — sharding the optimizer state is a memory layout choice, not
+a numerics change.
+"""
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.models import MLP
+from chainermn_tpu.optimizers import make_zero1_train_step, zero1_params
+from chainermn_tpu.training.step import make_data_parallel_train_step
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _data(comm, batch_per=4, seed=0):
+    n = comm.size * batch_per
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 28, 28).astype(np.float32)
+    y = rs.randint(0, 10, size=(n,)).astype(np.int32)
+    dsh = NamedSharding(comm.mesh, P(comm.axis_names[0]))
+    return jax.device_put(x, dsh), jax.device_put(y, dsh)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_zero1_matches_replicated(comm, opt_name):
+    model = MLP(n_units=32, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    make_opt = {
+        "sgd": lambda: optax.sgd(0.1, momentum=0.9),
+        "adam": lambda: optax.adam(1e-2),
+    }[opt_name]
+
+    # replicated baseline
+    ropt = chainermn_tpu.create_multi_node_optimizer(make_opt(), comm)
+    rparams = comm.bcast_data(params)
+    rstate = (rparams, jax.jit(ropt.init)(rparams))
+    rstep = make_data_parallel_train_step(model, ropt, comm, donate=False)
+
+    # zero-1
+    zstep, zstate = make_zero1_train_step(model, make_opt(), comm, params,
+                                          donate=False)
+
+    x, y = _data(comm)
+    for i in range(3):
+        rstate, rm = rstep(rstate, x, y)
+        zstate, zm = zstep(zstate, x, y)
+        np.testing.assert_allclose(float(rm["main/loss"]),
+                                   float(zm["main/loss"]), rtol=1e-5)
+
+    got = zero1_params(zstate, params)
+    want = rstate[0]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+        want, got,
+    )
+
+
+def test_zero1_opt_state_is_sharded(comm):
+    model = MLP(n_units=32, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    step, state = make_zero1_train_step(model, optax.adam(1e-2), comm,
+                                        params)
+    p_shard, opt_state = state
+    n = comm.size
+    flat = jax.flatten_util.ravel_pytree(params)[0]
+    padded = flat.size + ((-flat.size) % n)
+    assert p_shard.shape == (padded,)
+    # the vector is sharded over the axis: each device holds padded/n
+    shard_sizes = {
+        s.data.shape[0] for s in p_shard.addressable_shards
+    }
+    assert shard_sizes == {padded // n}
+    # adam's mu/nu follow the shard
+    mu = opt_state[0].mu
+    assert mu.shape == (padded,)
+    assert {s.data.shape[0] for s in mu.addressable_shards} == {padded // n}
+
+
+def test_zero1_padding_path(comm):
+    # a model whose param count is NOT divisible by the axis size
+    model = MLP(n_units=13, n_out=3)
+    params = model.init(jax.random.PRNGKey(1),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    flat = jax.flatten_util.ravel_pytree(params)[0]
+    assert flat.size % comm.size != 0, "want the padding path"
+    step, state = make_zero1_train_step(model, optax.sgd(0.1), comm, params,
+                                        donate=False)
+    n = comm.size * 2
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 28, 28).astype(np.float32)
+    y = rs.randint(0, 3, size=(n,)).astype(np.int32)
+    state, m = step(state, x, y)
+    assert np.isfinite(float(m["main/loss"]))
+    got = zero1_params(state, params)
+    assert jax.tree_util.tree_structure(got) == \
+        jax.tree_util.tree_structure(params)

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Perf sweep for the ResNet-50 bench: batch size, scan-amortized dispatch,
+space-to-depth stem. Prints one JSON line per variant."""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def run_variant(batch, n_scan, s2d, n_iters=10):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import chainermn_tpu
+    from chainermn_tpu.models.resnet import ResNet50
+    from chainermn_tpu.training.step import make_data_parallel_train_step
+
+    comm = chainermn_tpu.create_communicator("xla")
+    n_dev = comm.size
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                     space_to_depth=s2d)
+    image = np.zeros((2, 224, 224, 3), np.float32)
+    mutable = ("batch_stats",)
+
+    global_batch = batch * n_dev
+    variables = model.init(jax.random.PRNGKey(0), image)
+    params = comm.bcast_data(variables["params"])
+    extra = {k: comm.bcast_data(variables[k]) for k in mutable}
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    state = (params, opt.init(params), extra)
+    step = make_data_parallel_train_step(model, opt, comm, mutable=mutable)
+
+    x = np.random.RandomState(0).rand(
+        global_batch, 224, 224, 3).astype(np.float32)
+    y = np.random.RandomState(1).randint(
+        0, 1000, size=(global_batch,)).astype(np.int32)
+    dsh = NamedSharding(comm.mesh, P(comm.axis_names[0]))
+    x = jax.device_put(x, dsh)
+    y = jax.device_put(y, dsh)
+
+    if n_scan > 1:
+        base = step
+
+        def multi(state, x, y):
+            def body(s, _):
+                s, m = base(s, x, y)
+                return s, m
+            return lax.scan(body, state, None, length=n_scan)
+        multi = jax.jit(multi, donate_argnums=(0,))
+        state, m = multi(state, x, y)
+        float(jax.tree_util.tree_leaves(m)[0][-1])
+        t0 = time.perf_counter()
+        reps = max(1, n_iters // n_scan)
+        for _ in range(reps):
+            state, m = multi(state, x, y)
+        float(jax.tree_util.tree_leaves(m)[0][-1])
+        dt = time.perf_counter() - t0
+        total = reps * n_scan * global_batch
+    else:
+        state, m = step(state, x, y)
+        float(m["main/loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_iters):
+            state, m = step(state, x, y)
+        float(m["main/loss"])
+        dt = time.perf_counter() - t0
+        total = n_iters * global_batch
+
+    per_chip = total / dt / n_dev
+    print(json.dumps({
+        "batch": batch, "scan": n_scan, "s2d": s2d,
+        "images_per_sec_per_chip": round(per_chip, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    batch = int(sys.argv[1])
+    n_scan = int(sys.argv[2])
+    s2d = sys.argv[3] == "1"
+    run_variant(batch, n_scan, s2d)
