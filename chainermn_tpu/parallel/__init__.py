@@ -1,5 +1,9 @@
 from .expert_parallel import ExpertParallelMLP, switch_dispatch
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import (
+    pipeline_1f1b_value_and_grad,
+    pipeline_apply,
+    stack_stage_params,
+)
 from .ring_attention import local_attention_reference, ring_attention
 from .tensor_parallel import (
     ColumnParallelDense,
@@ -11,6 +15,7 @@ __all__ = [
     "ring_attention",
     "local_attention_reference",
     "pipeline_apply",
+    "pipeline_1f1b_value_and_grad",
     "stack_stage_params",
     "ColumnParallelDense",
     "RowParallelDense",

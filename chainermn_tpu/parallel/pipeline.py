@@ -24,6 +24,20 @@ from jax import lax
 from chainermn_tpu.utils import match_vma
 
 
+def _stage_act_dtype(stage_fn, stage_params, mb_shape, in_dtype):
+    """Activation dtype of one stage; rejects non-shape-preserving stages
+    (the homogeneous-pipeline contract both schedules rely on)."""
+    out_aval = jax.eval_shape(
+        stage_fn, stage_params, jax.ShapeDtypeStruct(mb_shape, in_dtype))
+    if out_aval.shape != mb_shape:
+        raise ValueError(
+            f"pipeline stages must preserve the activation shape "
+            f"(homogeneous pipeline); stage maps {mb_shape} -> "
+            f"{out_aval.shape}"
+        )
+    return out_aval.dtype
+
+
 def pipeline_apply(
     stage_fn: Callable,
     stage_params: Any,
@@ -55,17 +69,8 @@ def pipeline_apply(
 
     # activation dtype/shape comes from the stage itself (homogeneous
     # pipeline: output shape == input shape, but dtype may be bf16 etc.)
-    out_aval = jax.eval_shape(
-        stage_fn, stage_params,
-        jax.ShapeDtypeStruct(mb_shape, x_microbatches.dtype),
-    )
-    act_dtype = out_aval.dtype
-    if out_aval.shape != mb_shape:
-        raise ValueError(
-            f"pipeline stages must preserve the activation shape "
-            f"(homogeneous pipeline); stage maps {mb_shape} -> "
-            f"{out_aval.shape}"
-        )
+    act_dtype = _stage_act_dtype(stage_fn, stage_params, mb_shape,
+                                 x_microbatches.dtype)
 
     # carry: (current activation, collected outputs) — pcast to varying so
     # the fori_loop carry matches the per-shard (varying) updates
@@ -110,3 +115,118 @@ def stack_stage_params(params_list):
     return jax.tree_util.tree_map(
         lambda *ls: jnp.stack(ls), *params_list
     )
+
+
+def pipeline_1f1b_value_and_grad(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Any,
+    x_microbatches,
+    y_microbatches,
+    axis_name: str,
+):
+    """1F1B-scheduled pipeline training step (loss + per-stage grads).
+
+    ``pipeline_apply`` + autodiff is GPipe: all M micro-batches flow forward
+    before any backward, so every stage holds O(M) live activations. This is
+    the one-forward-one-backward schedule: backward for micro-batch j starts
+    as soon as j leaves the last stage, so stage s only keeps activations for
+    its in-flight window — a circular buffer of 2·(S−1) slots, independent of
+    M. The backward cotangent rides a reverse ``ppermute`` ring one tick
+    behind schedule, and each stage re-runs its forward at backward time
+    (in-stage remat — the standard TPU trade of FLOPs for HBM).
+
+    Schedule (S stages, M micro-batches, T = 2·(S−1)+M ticks): stage s runs
+    forward for micro-batch t−s and backward for micro-batch
+    t−(2·(S−1)−s) when those indices are in [0, M). The last stage's forward
+    and backward for a micro-batch land on the same tick, where the loss
+    cotangent is computed locally from ``loss_fn``.
+
+    Args:
+      stage_fn: ``(params, h) -> h`` — one stage's compute, shape-preserving
+        (homogeneous pipeline, as ``pipeline_apply``).
+      loss_fn: ``(out, target) -> scalar`` — applied to the last stage's
+        output per micro-batch; the objective is its mean over micro-batches.
+      stage_params: THIS shard's stage parameters.
+      x_microbatches: [M, mb, ...] inputs, replicated across shards.
+      y_microbatches: [M, ...] per-micro-batch targets, replicated.
+      axis_name: the stage mesh axis.
+
+    Returns ``(loss, grads)``: the mean loss (replicated) and the gradient
+    of it w.r.t. THIS shard's ``stage_params``.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+
+    act_dtype = _stage_act_dtype(stage_fn, stage_params, mb_shape,
+                                 x_microbatches.dtype)
+
+    depth = max(1, 2 * (n - 1))  # 1F1B live-activation bound per stage
+    ticks = 2 * (n - 1) + m
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+
+    h0 = match_vma(jnp.zeros(mb_shape, act_dtype), my)
+    g0 = match_vma(jnp.zeros(mb_shape, act_dtype), my)
+    buf0 = match_vma(jnp.zeros((depth,) + mb_shape, act_dtype), my)
+    gacc0 = match_vma(
+        jax.tree_util.tree_map(jnp.zeros_like, stage_params), my)
+    lacc0 = match_vma(jnp.zeros((), jnp.float32), my)
+
+    def tick(t, carry):
+        h_ring, g_ring, buf, gacc, lacc = carry
+        mb_f = t - my                       # micro-batch in forward here
+        v_f = jnp.logical_and(mb_f >= 0, mb_f < m)
+        mb_b = t - (2 * (n - 1) - my)       # micro-batch in backward here
+        v_b = jnp.logical_and(mb_b >= 0, mb_b < m)
+
+        feed = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(mb_f, 0, m - 1), axis=0, keepdims=False
+        ).astype(act_dtype)
+        h_in = jnp.where(my == 0, feed, h_ring)
+
+        # read the backward activation BEFORE writing this tick's forward:
+        # at stage 0 the slot being retired is exactly the slot about to be
+        # reused (lifetime == depth there)
+        slot_b = jnp.clip(mb_b, 0, None) % depth
+        h_saved = lax.dynamic_index_in_dim(buf, slot_b, axis=0,
+                                           keepdims=False)
+        # the last stage's backward is same-tick: use the live activation
+        h_bwd_in = jnp.where(my == n - 1, h_in, h_saved)
+
+        slot_f = jnp.clip(mb_f, 0, None) % depth
+        buf = jnp.where(
+            v_f,
+            lax.dynamic_update_index_in_dim(buf, h_in, slot_f, axis=0),
+            buf,
+        )
+
+        # forward step (pipeline progress)
+        y_fwd = stage_fn(stage_params, h_in)
+
+        # loss value + cotangent, meaningful on the last stage only
+        tgt = lax.dynamic_index_in_dim(
+            y_microbatches, jnp.clip(mb_f, 0, m - 1), axis=0, keepdims=False)
+        loss_j, dldy = jax.value_and_grad(loss_fn)(y_fwd, tgt)
+        lacc = lacc + jnp.where(
+            jnp.logical_and(v_f, my == n - 1), loss_j, 0.0)
+
+        # backward step: rematerialize the stage at the saved activation
+        g_in = jnp.where(my == n - 1, dldy.astype(act_dtype), g_ring)
+        _, vjp_fn = jax.vjp(stage_fn, stage_params, h_bwd_in)
+        gp, gh = vjp_fn(g_in)
+        gacc = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(v_b, g, 0), gacc, gp)
+
+        h_next = lax.ppermute(jnp.where(v_f, y_fwd, 0), axis_name, fwd_perm)
+        g_next = lax.ppermute(jnp.where(v_b, gh, 0), axis_name, bwd_perm)
+        return h_next, g_next, buf, gacc, lacc
+
+    _, _, _, gacc, lacc = lax.fori_loop(
+        0, ticks, tick, (h0, g0, buf0, gacc0, lacc0))
+
+    loss = lax.psum(lacc, axis_name) / m
+    grads = jax.tree_util.tree_map(lambda g: g / m, gacc)
+    return loss, grads

@@ -9,7 +9,11 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 import chainermn_tpu
-from chainermn_tpu.parallel import pipeline_apply, stack_stage_params
+from chainermn_tpu.parallel import (
+    pipeline_1f1b_value_and_grad,
+    pipeline_apply,
+    stack_stage_params,
+)
 
 
 @pytest.fixture()
@@ -90,4 +94,98 @@ def test_pipeline_gradients(comm):
     g = jax.jit(jax.grad(loss))(stacked, jnp.asarray(x))
     g_ref = jax.jit(jax.grad(ref_loss))(stacked, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(g["w"]), np.asarray(g_ref["w"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+# m=2 < n exercises the bubble masks; m=18 > 2(n-1) exercises circular
+# activation-buffer slot reuse (depth is 14 on the 8-device mesh)
+@pytest.mark.parametrize("m", [2, 18])
+def test_pipeline_1f1b_matches_sequential(comm, m):
+    n = comm.size
+    feat = 4
+    mb = 3
+    rng = np.random.RandomState(2)
+    params_list = [
+        {"w": rng.randn(feat, feat).astype(np.float32) * 0.5,
+         "b": rng.randn(feat).astype(np.float32) * 0.1}
+        for _ in range(n)
+    ]
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    def loss_fn(out, tgt):
+        return jnp.mean((out - tgt) ** 2)
+
+    x = rng.randn(m, mb, feat).astype(np.float32)
+    tgt = rng.randn(m, mb, feat).astype(np.float32)
+    stacked = stack_stage_params(params_list)
+    ax = comm.axis_names[0]
+
+    def f(stacked, x, tgt):
+        myp = jax.tree_util.tree_map(lambda l: l[0], stacked)
+        loss, grads = pipeline_1f1b_value_and_grad(
+            stage_fn, loss_fn, myp, x, tgt, axis_name=ax)
+        # re-stack this shard's grads so out_specs can shard them
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss, grads = jax.jit(shard_map(
+        f, mesh=comm.mesh,
+        in_specs=(P(ax), P(), P()),
+        out_specs=(P(), P(ax)),
+    ))(stacked, x, tgt)
+
+    def ref_loss(stacked, x, tgt):
+        h = x
+        for s in range(n):
+            h = jnp.tanh(h @ stacked["w"][s] + stacked["b"][s])
+        return jnp.mean((h - tgt) ** 2, axis=(1, 2)).mean()
+
+    ref = jax.jit(jax.value_and_grad(ref_loss))
+    l_ref, g_ref = ref(stacked, jnp.asarray(x), jnp.asarray(tgt))
+    np.testing.assert_allclose(float(loss), float(l_ref),
+                               rtol=1e-5, atol=1e-6)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(g_ref[k]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_1f1b_single_stage_degenerates(comm):
+    """n=1 sub-mesh: 1F1B degenerates to plain gradient accumulation."""
+    feat = 3
+    rng = np.random.RandomState(3)
+    p = {"w": rng.randn(feat, feat).astype(np.float32) * 0.5}
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p["w"])
+
+    def loss_fn(out, tgt):
+        return jnp.mean((out - tgt) ** 2)
+
+    m, mb = 4, 2
+    x = rng.randn(m, mb, feat).astype(np.float32)
+    tgt = rng.randn(m, mb, feat).astype(np.float32)
+
+    import jax.sharding as shd
+    mesh1 = shd.Mesh(np.asarray(jax.devices()[:1]), ("s",))
+
+    def f(x, tgt):
+        loss, grads = pipeline_1f1b_value_and_grad(
+            stage_fn, loss_fn, p, x, tgt, axis_name="s")
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss, grads = jax.jit(shard_map(
+        f, mesh=mesh1, in_specs=(P(), P()), out_specs=(P(), P("s")),
+    ))(x, tgt)
+    grads = jax.tree_util.tree_map(lambda g: g[0], grads)
+
+    def ref(p):
+        h = jnp.tanh(x @ p["w"])
+        return jnp.mean((h - tgt) ** 2, axis=(1, 2)).mean()
+
+    l_ref, g_ref = jax.value_and_grad(ref)(p)
+    np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["w"]),
+                               np.asarray(g_ref["w"]),
                                rtol=1e-4, atol=1e-5)
