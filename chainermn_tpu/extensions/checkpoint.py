@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import re
+import threading
 from typing import Any, List, Optional
 
 import numpy as np
@@ -26,37 +28,141 @@ import jax
 from chainermn_tpu.comm.base import CommunicatorBase
 
 
-def _flatten_state(state) -> dict:
+def _flatten_state(state):
     leaves, treedef = jax.tree_util.tree_flatten(state)
+    # batch the D2H transfers: start every copy before waiting on any
+    for l in leaves:
+        if hasattr(l, "copy_to_host_async"):
+            l.copy_to_host_async()
     arrays = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
     return arrays, treedef
 
 
 class MultiNodeCheckpointer:
-    """Snapshot/restore a training state pytree, one file per process."""
+    """Snapshot/restore a training state pytree, one file per process.
+
+    ``async_write=True`` moves the disk write off the training thread: the
+    device→host pull still happens inside ``save`` (the snapshot must capture
+    the state *now* — the caller's next donating train step reuses those
+    buffers), but serialization + atomic publish + GC run on a background
+    writer thread, the same split the reference's double-buffering applied to
+    communication. ``flush()`` joins outstanding writes; every read-side
+    operation (election, load) flushes first so it only ever sees published
+    files.
+    """
 
     def __init__(self, name: str, comm: CommunicatorBase, path: str = ".",
-                 cp_interval: int = 5):
+                 cp_interval: int = 5, async_write: bool = False):
         self.name = name
         self.comm = comm
         self.path = os.path.join(path, name)
         self.cp_interval = cp_interval  # snapshots kept in the window
+        self.async_write = async_write
+        self._queue: Optional[queue.Queue] = None
+        self._writer: Optional[threading.Thread] = None
+        self._write_error: Optional[BaseException] = None
         # every process writes its own snapshot file and may have its own
         # (non-shared) filesystem — each must create the directory
         os.makedirs(self.path, exist_ok=True)
         if hasattr(comm, "barrier"):
             comm.barrier()
 
+    # -- async writer ---------------------------------------------------
+
+    def _ensure_writer(self):
+        if self._writer is not None and self._writer.is_alive():
+            return
+        # bounded queue = backpressure: a disk slower than the save cadence
+        # stalls save() instead of accumulating host copies of the full
+        # training state until OOM (one in flight + one queued, the same
+        # budget as the reference's double buffering)
+        self._queue = queue.Queue(maxsize=1)
+        self._writer = threading.Thread(
+            target=self._writer_loop, name=f"ckpt-writer-{self.name}",
+            daemon=True,
+        )
+        self._writer.start()
+        # a script that never calls close() must not lose checkpoints
+        # save() already returned a path for at interpreter shutdown; at
+        # that point nothing can catch, so report instead of raising
+        import atexit
+
+        def _close_at_exit():
+            try:
+                self.close()
+            except Exception as e:
+                import warnings
+
+                warnings.warn(f"checkpoint writer at exit: {e}")
+
+        atexit.register(_close_at_exit)
+
+    def _writer_loop(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                arrays, fn = item
+                self._publish(arrays, fn)
+            except BaseException as e:  # surfaced on next save/flush
+                self._write_error = e
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending(self):
+        if self._write_error is not None:
+            e, self._write_error = self._write_error, None
+            raise RuntimeError(
+                f"async checkpoint write failed: {e!r}") from e
+
+    def _drain(self):
+        """Join queued writes WITHOUT raising — the collective read path
+        (election) must reach its allgather even when this process's last
+        write failed, or the other ranks hang in the collective; a failed
+        write was never published, so the election skips it naturally."""
+        if self._queue is not None:
+            self._queue.join()
+        if self._write_error is not None:
+            import warnings
+
+            warnings.warn(
+                f"async checkpoint write failed (election will skip the "
+                f"unpublished snapshot): {self._write_error!r}")
+
+    def flush(self):
+        """Block until every queued snapshot is published."""
+        if self._queue is not None:
+            self._queue.join()
+        self._raise_pending()
+
+    def close(self):
+        """Join the writer thread (trainer finalization hook)."""
+        if self._writer is not None and self._writer.is_alive():
+            self._queue.join()
+            self._queue.put(None)
+            self._writer.join()
+        self._writer = None
+        self._raise_pending()
+
     # -- save -----------------------------------------------------------
 
+    def _publish(self, arrays: dict, fn: str):
+        np.savez(fn + ".npz", **arrays)
+        os.replace(fn + ".npz", fn)  # atomic publish
+        self._gc()
+
     def save(self, state: Any, iteration: int) -> str:
+        self._raise_pending()
         fn = os.path.join(
             self.path, f"snapshot_iter_{iteration}.{self.comm.inter_rank}"
         )
         arrays, treedef = _flatten_state(state)
-        np.savez(fn + ".npz", **arrays)
-        os.replace(fn + ".npz", fn)  # atomic publish
-        self._gc()
+        if self.async_write:
+            self._ensure_writer()
+            self._queue.put((arrays, fn))
+        else:
+            self._publish(arrays, fn)
         return fn
 
     def _iters_on_disk(self) -> List[int]:
@@ -85,6 +191,7 @@ class MultiNodeCheckpointer:
     def latest_common_iteration(self) -> Optional[int]:
         """Consensus election: newest iteration present on ALL processes
         (reference: allgather of per-rank snapshot inventories)."""
+        self._drain()
         mine = self._iters_on_disk()
         all_lists = self.comm.allgather_obj(mine)
         common = set(all_lists[0])
@@ -96,6 +203,7 @@ class MultiNodeCheckpointer:
         """Restore ``state`` from the newest complete snapshot (or the given
         iteration). Returns (state, iteration) — unchanged state and None if
         nothing restorable exists."""
+        self._drain()
         it = iteration if iteration is not None else self.latest_common_iteration()
         if it is None:
             return state, None
@@ -117,6 +225,9 @@ class MultiNodeCheckpointer:
 
 def create_multi_node_checkpointer(name: str, comm: CommunicatorBase,
                                    path: str = ".", cp_interval: int = 5,
+                                   async_write: bool = False,
                                    **kwargs) -> MultiNodeCheckpointer:
     """Factory matching the reference name (chainermn/extensions/checkpoint.py)."""
-    return MultiNodeCheckpointer(name, comm, path=path, cp_interval=cp_interval)
+    return MultiNodeCheckpointer(name, comm, path=path,
+                                 cp_interval=cp_interval,
+                                 async_write=async_write)
