@@ -25,6 +25,9 @@ import optax
 
 from chainermn_tpu.comm.base import CommunicatorBase
 from chainermn_tpu.optimizers.zero import (  # noqa: F401
+    fsdp_gather_params,
+    fsdp_shardings,
+    make_fsdp_train_step,
     make_zero1_train_step,
     zero1_params,
 )

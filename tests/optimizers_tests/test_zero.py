@@ -15,7 +15,12 @@ import pytest
 
 import chainermn_tpu
 from chainermn_tpu.models import MLP
-from chainermn_tpu.optimizers import make_zero1_train_step, zero1_params
+from chainermn_tpu.optimizers import (
+    fsdp_gather_params,
+    make_fsdp_train_step,
+    make_zero1_train_step,
+    zero1_params,
+)
 from chainermn_tpu.training.step import make_data_parallel_train_step
 
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -91,6 +96,68 @@ def test_zero1_opt_state_is_sharded(comm):
     mu = opt_state[0].mu
     assert mu.shape == (padded,)
     assert {s.data.shape[0] for s in mu.addressable_shards} == {padded // n}
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_fsdp_matches_replicated(comm, opt_name):
+    model = MLP(n_units=32, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    make_opt = {
+        "sgd": lambda: optax.sgd(0.1, momentum=0.9),
+        "adam": lambda: optax.adam(1e-2),
+    }[opt_name]
+
+    ropt = chainermn_tpu.create_multi_node_optimizer(make_opt(), comm)
+    rparams = comm.bcast_data(params)
+    rstate = (rparams, jax.jit(ropt.init)(rparams))
+    rstep = make_data_parallel_train_step(model, ropt, comm, donate=False)
+
+    fstep, fstate = make_fsdp_train_step(model, make_opt(), comm, params,
+                                         donate=False)
+
+    x, y = _data(comm)
+    for i in range(3):
+        rstate, rm = rstep(rstate, x, y)
+        fstate, fm = fstep(fstate, x, y)
+        np.testing.assert_allclose(float(rm["main/loss"]),
+                                   float(fm["main/loss"]), rtol=1e-5)
+
+    got = fsdp_gather_params(fstate)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+        rstate[0], got,
+    )
+
+
+def test_fsdp_params_and_opt_state_sharded(comm):
+    model = MLP(n_units=32, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    step, state = make_fsdp_train_step(model, optax.adam(1e-2), comm, params)
+    p, opt_state = state
+    n = comm.size
+    ax = comm.axis_name
+
+    def sharded_leaves(tree):
+        return [l for l in jax.tree_util.tree_leaves(tree)
+                if any(d >= n and d % n == 0 for d in l.shape)]
+
+    big = sharded_leaves(p)
+    assert big, "expected shardable parameter leaves"
+    for l in big:
+        assert ax in tuple(l.sharding.spec), (l.shape, l.sharding)
+        # each device holds 1/n of the leaf
+        full = np.prod(l.shape)
+        assert {int(np.prod(s.data.shape))
+                for s in l.addressable_shards} == {full // n}
+    # adam mu follows the param sharding
+    mu_big = sharded_leaves(opt_state[0].mu)
+    for l in mu_big:
+        full = np.prod(l.shape)
+        assert {int(np.prod(s.data.shape))
+                for s in l.addressable_shards} == {full // n}
 
 
 def test_zero1_padding_path(comm):
