@@ -14,9 +14,11 @@ scratch (acc, rowmax, rowsum) persists across the kv iteration of one
 (bh, q_block) and is finalized on the last kv step. Causal masking compares
 global row/col indices and skips fully-masked tiles.
 
-Backward runs through a custom VJP that recomputes attention with the XLA
-reference implementation — standard rematerialization (the bwd is
-memory-bound anyway; fwd is where the fusion pays).
+Backward is a pair of Pallas kernels (FlashAttention-2 style): the forward
+saves only O and the per-row logsumexp; dq (kv-innermost grid) and dk/dv
+(q-innermost grid) rebuild each P tile as exp(S − lse) and accumulate in
+VMEM scratch, so the [L, L] score matrix never exists in HBM in either
+direction.
 """
 
 from __future__ import annotations
@@ -32,8 +34,50 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30  # finite stand-in: -inf breaks max/exp chains on the VPU
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, mrow, lrow, *, scale,
-               causal, bq, bk, nk):
+def _fit_block(block: int, l: int) -> int:
+    """Largest divisor of ``l`` that is <= ``block``, preferring
+    lane-aligned (multiple-of-128) tiles, then sublane-aligned (8).
+
+    Keeps the tuned defaults usable for any length a caller brings
+    (L=384 → 128, L=768 with block 512 → 384) instead of asserting.
+    """
+    b = min(block, l)
+    for align in (128, 8, 1):
+        cand = (b // align) * align
+        while cand >= align:
+            if l % cand == 0:
+                return cand
+            cand -= align
+    return 1
+
+
+def _causal_live(qi, ki, bq, bk):
+    """Whether tile (qi, ki) intersects the causal triangle: the last q row
+    of the tile must see at least the first k column."""
+    return qi * bq + bq - 1 >= ki * bk
+
+
+def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, bq, bk):
+    """Scaled (and causally masked) score tile S = (Q Kᵀ)·scale, f32.
+
+    Shared by the forward and both backward kernels so masking semantics
+    can never desynchronize between them.
+    """
+    q = q_ref[0].astype(jnp.float32)          # [bq, d]
+    k = k_ref[0].astype(jnp.float32)          # [bk, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                  # [bq, bk]
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where((qi * bq + rows) >= (ki * bk + cols), s, _NEG_INF)
+    return s
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mrow, lrow, *,
+               scale, causal, bq, bk, nk):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -44,25 +88,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, mrow, lrow, *, scale,
         lrow[:] = jnp.zeros_like(lrow)
 
     # causal: tile fully above the diagonal contributes nothing
-    run = True
-    if causal:
-        run = qi * bq + bq - 1 >= ki * bk  # last q row sees first k col?
+    run = _causal_live(qi, ki, bq, bk) if causal else True
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [bq, d]
-        k = k_ref[0].astype(jnp.float32)          # [bk, d]
         v = v_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                  # [bq, bk]
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            mask = (qi * bq + rows) >= (ki * bk + cols)
-            s = jnp.where(mask, s, _NEG_INF)
-
+        s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                         bq=bq, bk=bk)
         m_prev = mrow[:, :1]                       # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -79,23 +111,39 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc, mrow, lrow, *, scale,
     def _finalize():
         o_ref[0] = (acc[:] / jnp.maximum(lrow[:, :1], 1e-30)).astype(
             o_ref.dtype)
+        # logsumexp per row — the backward kernels rebuild P = exp(S - lse)
+        lse_ref[0] = (mrow[:, :1] +
+                      jnp.log(jnp.maximum(lrow[:, :1], 1e-30)))
+
+
+def _sds(ref, shape, dtype, *more):
+    """ShapeDtypeStruct declaring the union of the operands' varying mesh
+    axes — required for pallas_call outputs inside shard_map
+    (check_vma=True)."""
+    vma = frozenset()
+    for x in (ref,) + more:
+        vma = vma | (getattr(jax.typeof(x), "vma", None) or frozenset())
+    return (jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+            if vma else jax.ShapeDtypeStruct(shape, dtype))
 
 
 def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret):
-    """q: [BH, Lq, D]; k, v: [BH, Lk, D] → [BH, Lq, D]."""
+    """q: [BH, Lq, D]; k, v: [BH, Lk, D] → ([BH, Lq, D], lse [BH, Lq, 1]).
+
+    lse rides a trailing dim of 1: TPU block shapes must have last-two dims
+    divisible by (8, 128) OR equal to the array dims, so (1, bq, 1) on a
+    [BH, Lq, 1] array is the minimal legal layout — 4 B/row in HBM (the
+    earlier 128-lane broadcast moved 128x that)."""
     bh, lq, d = q.shape
     lk = k.shape[1]
-    bq = min(block_q, lq)
-    bk = min(block_k, lk)
-    assert lq % bq == 0 and lk % bk == 0, (
-        f"sequence lengths ({lq}, {lk}) must be divisible by the block "
-        f"sizes ({bq}, {bk})")
+    bq = _fit_block(block_q, lq)
+    bk = _fit_block(block_k, lk)
     nk = lk // bk
 
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk)
     grid = (bh, lq // bq, nk)
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -106,9 +154,14 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(_sds(q, (bh, lq, d), q.dtype, k, v),
+                   _sds(q, (bh, lq, 1), jnp.float32, k, v)),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),     # acc
             pltpu.VMEM((bq, 128), jnp.float32),   # running max (col 0)
@@ -116,6 +169,135 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 style): rebuild P from lse per tile,
+# never materializing [L, L] in HBM — the memory bound that lets b=64/L=2048
+# (and far longer L) train on one chip where the materializing backward
+# allocated 8 GB score tensors per block.
+# ---------------------------------------------------------------------------
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dq_ref,
+                      dq_acc, *, scale, causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = _causal_live(qi, ki, bq, bk) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                         bq=bq, bk=bk)
+        p = jnp.exp(s - lse_ref[0])                    # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - dr_ref[0]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                       bq, bk, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = _causal_live(qi, ki, bq, bk) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                         bq=bq, bk=bk)
+        p = jnp.exp(s - lse_ref[0])                    # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - dr_ref[0]) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
+                  interpret):
+    """q/do: [BH, Lq, D]; k/v: [BH, Lk, D]; lse/dr: [BH, Lq] →
+    (dq, dk, dv)."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    lse = lse.reshape(bh, lq, 1)   # minimal legal TPU block layout
+    dr = dr.reshape(bh, lq, 1)
+    bq = _fit_block(block_q, lq)
+    bk = _fit_block(block_k, lk)
+    nq, nk = lq // bq, lk // bk
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0),
+                           memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0),
+                            memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=_sds(q, (bh, lq, d), q.dtype, k, v, do),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, dr)
+
+    # dk/dv iterate q innermost; the same index_maps apply with (b, ki, qi)
+    q_spec2 = pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, 0),
+                           memory_space=pltpu.VMEM)
+    kv_spec2 = pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0),
+                            memory_space=pltpu.VMEM)
+    row_spec2 = pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0),
+                             memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
+                  row_spec2],
+        out_specs=(kv_spec2, kv_spec2),
+        out_shape=(_sds(k, (bh, lk, d), k.dtype, q, v, do),
+                   _sds(v, (bh, lk, d), v.dtype, q, k, do)),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, dr)
+    return dq, dk, dv
 
 
 def _reference(q, k, v, causal, scale):
@@ -133,12 +315,18 @@ def _reference(q, k, v, causal, scale):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 256, block_k: int = 512,
                     interpret: Optional[bool] = None):
     """Fused blockwise attention. q, k, v: [B, L, H, D] → [B, Lq, H, D].
 
     ``interpret=None`` auto-selects: the Pallas interpreter off-TPU (tests),
     the compiled kernel on TPU.
+
+    Default blocks (256, 512) measured fastest on v5e (d=128, causal,
+    bf16): 1.77x over the materializing XLA attention at L=8192, vs 0.86x
+    at the old (128, 128) — see BASELINE.md. Block sizes are clamped to
+    the largest divisor of L (lane-aligned where possible), so any length
+    works; explicit blocks are only a tuning knob.
     """
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)[0]
 
@@ -150,21 +338,34 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     to3 = lambda x, l: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, x.shape[-1])
-    out3 = _flash_fwd_3d(
+    out3, lse3 = _flash_fwd_3d(
         to3(q, lq), to3(k, lk), to3(v, lk),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
         interpret=interpret)
     out = jnp.transpose(out3.reshape(b, h, lq, d), (0, 2, 1, 3))
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse3)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # rematerialized backward through the XLA reference (fwd owns the fusion
-    # win; bwd recompute is the standard flash trade)
-    q, k, v = res
+    # blockwise Pallas backward: P is rebuilt per tile from the forward's
+    # logsumexp; [L, L] never touches HBM (the materializing fallback
+    # allocated 8 GB f32 score tensors at b=64/L=2048/h=8)
+    q, k, v, out, lse3 = res
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     sc = scale if scale is not None else q.shape[-1] ** -0.5
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal, sc), q, k, v)
-    return vjp(g)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    to3 = lambda x, l: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, x.shape[-1])
+    # D_i = Σ_d dO_i · O_i — rowwise, cheap in XLA, f32 for stability
+    dr = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    dr3 = jnp.transpose(dr, (0, 2, 1)).reshape(b * h, lq)
+    dq3, dk3, dv3 = _flash_bwd_3d(
+        to3(q, lq), to3(k, lk), to3(v, lk), to3(g, lq), lse3, dr3,
+        causal=causal, scale=sc, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    back = lambda x3, l: jnp.transpose(x3.reshape(b, h, l, d), (0, 2, 1, 3))
+    return back(dq3, lq), back(dk3, lk), back(dv3, lk)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

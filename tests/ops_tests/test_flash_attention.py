@@ -54,11 +54,111 @@ def test_gradients_match():
                                    rtol=1e-3, atol=1e-4)
 
 
-def test_indivisible_length_raises():
-    q, k, v = _qkv(b=1, l=100, h=1, d=16)
-    with pytest.raises(AssertionError):
-        flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
-                        False, None, 64, 64, True)
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_multi_block(causal):
+    """Backward kernels across several q AND kv tiles (accumulator reuse,
+    causal tile skipping)."""
+    q, k, v = _qkv(b=2, l=256, h=2, d=32, seed=4)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal, None, 64, 64, True)
+        return jnp.sum(out * jnp.cos(out))  # non-symmetric cotangent
+
+    def loss_ref(q, k, v):
+        out = _reference(q, k, v, causal, q.shape[-1] ** -0.5)
+        return jnp.sum(out * jnp.cos(out))
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_cross_length(causal):
+    """lq != lk (encoder-decoder style); causal exercises the backward
+    tile-skip against unequal nq/nk grids and the unconditional finalize."""
+    rng = np.random.RandomState(5)
+    q = rng.randn(1, 128, 2, 16).astype(np.float32)
+    k = rng.randn(1, 192, 2, 16).astype(np.float32)
+    v = rng.randn(1, 192, 2, 16).astype(np.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, None, 64, 64, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, causal, q.shape[-1] ** -0.5) ** 2)
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_inside_shard_map_data_parallel():
+    """Regression: pallas_call outputs must declare vma under shard_map
+    (check_vma=True) — found when the data-parallel transformer step hit the
+    real chip. Forward AND backward run inside the manual-axes context."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import chainermn_tpu
+
+    comm = chainermn_tpu.create_communicator("xla")
+    n = comm.size
+    ax = comm.axis_names[0]
+    q, k, v = _qkv(b=n, l=64, h=1, d=16, seed=6)
+
+    def local(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, None, 32, 32,
+                                           True) ** 2)
+        l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return jax.lax.psum(l, ax), g
+
+    loss, grads = jax.jit(shard_map(
+        local, mesh=comm.mesh,
+        in_specs=(P(ax), P(ax), P(ax)), out_specs=(P(), P(ax)),
+    ))(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+
+    def ref_loss(q, k, v):
+        return jnp.sum(_reference(q, k, v, True, q.shape[-1] ** -0.5) ** 2)
+
+    lr, gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(float(loss), float(lr), rtol=1e-4)
+    for a, b in zip(grads, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("l", [100, 384])
+def test_default_blocks_fit_any_length(l):
+    """Regression: the tuned default blocks (256, 512) must clamp to a
+    divisor of L — TransformerLM calls flash_attention with no block args,
+    so L=384 (etc.) crashed until _fit_block. Forward and backward."""
+    q, k, v = _qkv(b=1, l=l, h=1, d=16, seed=7)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_reference(q, k, v, True, q.shape[-1] ** -0.5) ** 2)
+
+    lf, g = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    lr, gr = jax.value_and_grad(loss_ref, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-4)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
 
 
 def test_bfloat16_io():
