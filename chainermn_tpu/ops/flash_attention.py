@@ -87,10 +87,6 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mrow, lrow, *,
         mrow[:] = jnp.full_like(mrow, _NEG_INF)
         lrow[:] = jnp.zeros_like(lrow)
 
-    # causal: tile fully above the diagonal contributes nothing
-    run = _causal_live(qi, ki, bq, bk) if causal else True
-
-    @pl.when(run)
     def _compute():
         v = v_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
@@ -106,6 +102,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mrow, lrow, *,
             preferred_element_type=jnp.float32,
         )
         mrow[:, :1] = m_new
+
+    # causal: a tile fully above the diagonal contributes nothing. The
+    # predicate must be TRACED even when trivially true: the Pallas
+    # interpreter mishandles varying-axes tracking (shard_map check_vma)
+    # for ref reads outside a traced cond.
+    pl.when(_causal_live(qi, ki, bq, bk) if causal else ki >= 0)(_compute)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -188,9 +190,6 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dq_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    run = _causal_live(qi, ki, bq, bk) if causal else True
-
-    @pl.when(run)
     def _compute():
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
@@ -204,6 +203,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dq_ref,
         dq_acc[:] += jax.lax.dot_general(
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    # traced-predicate gate even when non-causal — see _fa_kernel
+    pl.when(_causal_live(qi, ki, bq, bk) if causal else ki >= 0)(_compute)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -221,9 +223,6 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = _causal_live(qi, ki, bq, bk) if causal else True
-
-    @pl.when(run)
     def _compute():
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
@@ -240,6 +239,9 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref,
         dk_acc[:] += jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bk, d]
+
+    # traced-predicate gate even when non-causal — see _fa_kernel
+    pl.when(_causal_live(qi, ki, bq, bk) if causal else qi >= 0)(_compute)
 
     @pl.when(qi == nq - 1)
     def _finalize():

@@ -4,7 +4,11 @@ from .pipeline import (
     pipeline_apply,
     stack_stage_params,
 )
-from .ring_attention import local_attention_reference, ring_attention
+from .ring_attention import (
+    local_attention_reference,
+    ring_attention,
+    ring_flash_attention,
+)
 from .tensor_parallel import (
     ColumnParallelDense,
     RowParallelDense,
@@ -13,6 +17,7 @@ from .tensor_parallel import (
 
 __all__ = [
     "ring_attention",
+    "ring_flash_attention",
     "local_attention_reference",
     "pipeline_apply",
     "pipeline_1f1b_value_and_grad",
