@@ -126,9 +126,12 @@ class MultiNodeCheckpointer:
         if self._write_error is not None:
             import warnings
 
+            # consume the error: it is surfaced here, once — leaving it set
+            # would re-raise from the atexit close() long after the fact
+            e, self._write_error = self._write_error, None
             warnings.warn(
                 f"async checkpoint write failed (election will skip the "
-                f"unpublished snapshot): {self._write_error!r}")
+                f"unpublished snapshot): {e!r}")
 
     def flush(self):
         """Block until every queued snapshot is published."""
