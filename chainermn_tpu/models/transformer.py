@@ -34,6 +34,7 @@ from chainermn_tpu.parallel.ring_attention import (
     ring_attention,
     ring_flash_attention,
 )
+from chainermn_tpu.parallel.ulysses import ulysses_attention
 
 __all__ = ["TransformerLM", "TransformerBlock", "lm_loss_with_aux"]
 
@@ -45,7 +46,8 @@ class TransformerBlock(nn.Module):
     n_heads: int
     d_ff: int
     dtype: Any = jnp.float32
-    attention: str = "flash"   # 'flash' | 'ring' | 'ring_flash' | 'reference'
+    # 'flash' | 'ring' | 'ring_flash' | 'ulysses' | 'reference'
+    attention: str = "flash"
     seq_axis: Optional[str] = None     # mesh axis for 'ring'
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
@@ -62,13 +64,14 @@ class TransformerBlock(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape4 = (b, l, self.n_heads, dh)
         q, k, v = (t.reshape(shape4) for t in (q, k, v))
-        if self.attention in ("ring", "ring_flash"):
+        if self.attention in ("ring", "ring_flash", "ulysses"):
             if self.seq_axis is None:
                 raise ValueError(
                     f"attention={self.attention!r} requires seq_axis")
-            ring_fn = (ring_flash_attention if self.attention == "ring_flash"
-                       else ring_attention)
-            att = ring_fn(q, k, v, axis_name=self.seq_axis, causal=True)
+            seq_fn = {"ring": ring_attention,
+                      "ring_flash": ring_flash_attention,
+                      "ulysses": ulysses_attention}[self.attention]
+            att = seq_fn(q, k, v, axis_name=self.seq_axis, causal=True)
         elif self.attention == "flash":
             att = flash_attention(q, k, v, causal=True)
         else:

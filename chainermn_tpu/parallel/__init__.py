@@ -9,6 +9,7 @@ from .ring_attention import (
     ring_attention,
     ring_flash_attention,
 )
+from .ulysses import ulysses_attention
 from .tensor_parallel import (
     ColumnParallelDense,
     RowParallelDense,
@@ -18,6 +19,7 @@ from .tensor_parallel import (
 __all__ = [
     "ring_attention",
     "ring_flash_attention",
+    "ulysses_attention",
     "local_attention_reference",
     "pipeline_apply",
     "pipeline_1f1b_value_and_grad",

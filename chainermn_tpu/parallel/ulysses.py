@@ -1,0 +1,60 @@
+"""Ulysses-style sequence parallelism: all_to_all head↔sequence resharding.
+
+Beyond-reference capability (SURVEY.md §2.6: the reference predates sequence
+parallelism). The complement to ring attention
+(chainermn_tpu/parallel/ring_attention.py): instead of rotating KV blocks
+around the ring N times, ONE all_to_all redistributes the sharding from
+"sequence split, all heads" to "full sequence, heads split", each device
+runs ordinary (flash) attention over the whole sequence for its head group,
+and a second all_to_all restores the sequence sharding.
+
+Trade-off vs ring: two all_to_alls of activations total (cheap on ICI's
+all-to-all bandwidth) instead of N ppermutes of K/V, and the inner compute
+is one large flash kernel call (better MXU utilization than N small ones);
+but every device must hold the FULL sequence for H/N heads, so the
+per-device activation memory is the same as unsharded attention divided by
+the axis size only in the head dimension — ring keeps O(L_local) residency
+and scales to longer sequences. Use Ulysses while heads are plentiful and
+L fits; ring past that.
+
+No custom VJP is needed: ``lax.all_to_all`` is linear (its transpose is the
+reverse exchange) and the inner `flash_attention` carries its own VJP.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jax import lax
+
+from chainermn_tpu.ops.flash_attention import flash_attention
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None,
+                      block_q: int = 256, block_k: int = 512,
+                      interpret: Optional[bool] = None):
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call inside shard_map: q, k, v are [B, L_local, H, D] per shard with
+    the heads dimension intact; H must be divisible by the axis size.
+    Returns [B, L_local, H, D] with the same sharding.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use ring_flash_attention for "
+            "few-head long-sequence cases")
+
+    # [B, L/n, H, D] -> [B, L, H/n, D]: split heads, gather sequence.
+    # Device i's shard concatenates in axis order, so the sequence is
+    # globally ordered and causal masking needs no offset.
+    reshard = lambda x: lax.all_to_all(x, axis_name, split_axis=2,
+                                       concat_axis=1, tiled=True)
+    o = flash_attention(reshard(q), reshard(k), reshard(v), causal, scale,
+                        block_q, block_k, interpret)
+    # [B, L, H/n, D] -> [B, L/n, H, D]
+    return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

@@ -1,0 +1,114 @@
+"""Ulysses all_to_all sequence parallelism vs the full-attention oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.parallel import local_attention_reference, ulysses_attention
+
+
+@pytest.fixture()
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _qkv(n, b=2, l=32, h=None, d=8, seed=0):
+    h = h or n  # heads divisible by the axis
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, l, h, d).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_matches_full_attention(comm, causal):
+    q, k, v = _qkv(comm.size)
+    ax = comm.axis_names[0]
+    spec = P(None, ax)
+
+    def f(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=ax, causal=causal)
+
+    out = jax.jit(
+        shard_map(f, mesh=comm.mesh, in_specs=(spec,) * 3, out_specs=spec)
+    )(q, k, v)
+    ref = local_attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients(comm, causal):
+    """all_to_all transposes + the flash VJP compose to oracle gradients."""
+    q, k, v = _qkv(comm.size, h=2 * comm.size, seed=3)
+    ax = comm.axis_names[0]
+    spec = P(None, ax)
+
+    def loss(q, k, v):
+        f = lambda q, k, v: ulysses_attention(q, k, v, axis_name=ax,
+                                              causal=causal)
+        out = shard_map(f, mesh=comm.mesh, in_specs=(spec,) * 3,
+                        out_specs=spec)(q, k, v)
+        return jnp.sum(out * jnp.cos(out))
+
+    def ref_loss(q, k, v):
+        out = local_attention_reference(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_ref = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_indivisible_heads_raises(comm):
+    if comm.size == 1:
+        pytest.skip("needs a real axis")
+    q, k, v = _qkv(comm.size, h=comm.size + 1)
+    ax = comm.axis_names[0]
+    spec = P(None, ax)
+
+    def f(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=ax)
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(shard_map(f, mesh=comm.mesh, in_specs=(spec,) * 3,
+                          out_specs=spec))(q, k, v)
+
+
+def test_transformer_lm_ulysses(comm):
+    """attention='ulysses' end-to-end through the LM with sharded tokens."""
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    n = comm.size
+    ax = comm.axis_names[0]
+    model = TransformerLM(vocab=64, d_model=32, n_heads=n, n_layers=1,
+                          d_ff=32, max_len=64, attention="ulysses",
+                          seq_axis=ax)
+    tok = np.random.RandomState(0).randint(0, 64, (2, 64)).astype(np.int32)
+
+    def fwd(params, tok):
+        l_local = tok.shape[1]
+        off = jax.lax.axis_index(ax) * l_local
+        return model.apply({"params": params}, tok, pos_offset=off)
+
+    # init outside shard_map has no 'r' axis; attention choice doesn't
+    # change the param structure, so init through the flash sibling
+    init_model = TransformerLM(vocab=64, d_model=32, n_heads=n, n_layers=1,
+                               d_ff=32, max_len=64, attention="flash")
+    params = init_model.init(jax.random.PRNGKey(0),
+                             jnp.asarray(tok[:, :8]))["params"]
+    out = jax.jit(shard_map(
+        fwd, mesh=comm.mesh, in_specs=(P(), P(None, ax)),
+        out_specs=P(None, ax),
+    ))(params, jnp.asarray(tok))
+    assert out.shape == (2, 64, 64)
+    assert np.isfinite(np.asarray(out)).all()
