@@ -45,6 +45,7 @@ class TransformerBlock(nn.Module):
     d_model: int
     n_heads: int
     d_ff: int
+    n_kv_heads: Optional[int] = None   # < n_heads → GQA/MQA (flash path)
     dtype: Any = jnp.float32
     # 'flash' | 'ring' | 'ring_flash' | 'ulysses' | 'reference'
     attention: str = "flash"
@@ -59,11 +60,24 @@ class TransformerBlock(nn.Module):
         dh = self.d_model // self.n_heads
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        qkv = nn.Dense(3 * self.d_model, use_bias=False,
-                       dtype=self.dtype, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape4 = (b, l, self.n_heads, dh)
-        q, k, v = (t.reshape(shape4) for t in (q, k, v))
+        hkv = self.n_kv_heads or self.n_heads
+        if hkv == self.n_heads:
+            qkv = nn.Dense(3 * self.d_model, use_bias=False,
+                           dtype=self.dtype, name="qkv")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:  # GQA/MQA: smaller KV projection
+            if self.attention not in ("flash", "reference"):
+                raise ValueError(
+                    "n_kv_heads < n_heads is supported on the 'flash' and "
+                    "'reference' attention paths")
+            q = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                         name="q_proj")(h)
+            kv = nn.Dense(2 * hkv * dh, use_bias=False, dtype=self.dtype,
+                          name="kv_proj")(h)
+            k, v = jnp.split(kv, 2, axis=-1)
+        q = q.reshape(b, l, self.n_heads, dh)
+        k = k.reshape(b, l, hkv, dh)
+        v = v.reshape(b, l, hkv, dh)
         if self.attention in ("ring", "ring_flash", "ulysses"):
             if self.seq_axis is None:
                 raise ValueError(
@@ -73,8 +87,11 @@ class TransformerBlock(nn.Module):
                       "ulysses": ulysses_attention}[self.attention]
             att = seq_fn(q, k, v, axis_name=self.seq_axis, causal=True)
         elif self.attention == "flash":
-            att = flash_attention(q, k, v, causal=True)
+            att = flash_attention(q, k, v, causal=True)  # GQA-native
         else:
+            if hkv != self.n_heads:
+                k = jnp.repeat(k, self.n_heads // hkv, axis=2)
+                v = jnp.repeat(v, self.n_heads // hkv, axis=2)
             att = local_attention_reference(q, k, v, causal=True)
         att = att.reshape(b, l, self.d_model).astype(self.dtype)
         x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
@@ -112,6 +129,7 @@ class TransformerLM(nn.Module):
     vocab: int
     d_model: int = 256
     n_heads: int = 8
+    n_kv_heads: Optional[int] = None   # < n_heads → GQA/MQA
     n_layers: int = 4
     d_ff: int = 1024
     max_len: int = 2048
@@ -135,6 +153,7 @@ class TransformerLM(nn.Module):
         for i in range(self.n_layers):
             x = TransformerBlock(
                 d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
+                n_kv_heads=self.n_kv_heads,
                 dtype=self.dtype, attention=self.attention,
                 seq_axis=self.seq_axis,
                 moe_experts_per_device=self.moe_experts_per_device,

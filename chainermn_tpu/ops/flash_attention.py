@@ -129,8 +129,22 @@ def _sds(ref, shape, dtype, *more):
             if vma else jax.ShapeDtypeStruct(shape, dtype))
 
 
-def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret):
-    """q: [BH, Lq, D]; k, v: [BH, Lk, D] → ([BH, Lq, D], lse [BH, Lq, 1]).
+def _kv_row_map(hq: int, hkv: int):
+    """Grid row (over B*Hq) → KV array row (over B*Hkv).
+
+    GQA/MQA share one KV head among ``hq // hkv`` consecutive query heads
+    (repeat-interleave convention); the sharing happens in the BlockSpec
+    index map, so the repeated KV never exists in HBM."""
+    if hq == hkv:
+        return lambda b, qi, ki: (b, ki, 0)
+    g = hq // hkv
+    return lambda b, qi, ki: ((b // hq) * hkv + (b % hq) // g, ki, 0)
+
+
+def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
+                  hq=1, hkv=1):
+    """q: [B*Hq, Lq, D]; k, v: [B*Hkv, Lk, D] → ([B*Hq, Lq, D],
+    lse [B*Hq, Lq, 1]).
 
     lse rides a trailing dim of 1: TPU block shapes must have last-two dims
     divisible by (8, 128) OR equal to the array dims, so (1, bq, 1) on a
@@ -141,6 +155,7 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret):
     bq = _fit_block(block_q, lq)
     bk = _fit_block(block_k, lk)
     nk = lk // bk
+    kv_map = _kv_row_map(hq, hkv)
 
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk)
@@ -151,10 +166,8 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret):
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
         ],
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
@@ -250,9 +263,10 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref,
 
 
 def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
-                  interpret):
-    """q/do: [BH, Lq, D]; k/v: [BH, Lk, D]; lse/dr: [BH, Lq] →
-    (dq, dk, dv)."""
+                  interpret, hq=1, hkv=1):
+    """q/do: [B*Hq, Lq, D]; k/v: [B*Hkv, Lk, D]; lse/dr: [B*Hq, Lq] →
+    (dq [B*Hq], dk, dv [B*Hq — caller reduces query-head groups when
+    hkv < hq])."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     lse = lse.reshape(bh, lq, 1)   # minimal legal TPU block layout
@@ -260,11 +274,11 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
     bq = _fit_block(block_q, lq)
     bk = _fit_block(block_k, lk)
     nq, nk = lq // bq, lk // bk
+    kv_map = _kv_row_map(hq, hkv)
 
     q_spec = pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
                           memory_space=pltpu.VMEM)
-    kv_spec = pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0),
-                           memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM)
     row_spec = pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0),
                             memory_space=pltpu.VMEM)
 
@@ -279,11 +293,15 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
         interpret=interpret,
     )(q, k, v, do, lse, dr)
 
-    # dk/dv iterate q innermost; the same index_maps apply with (b, ki, qi)
+    # dk/dv iterate q innermost; same index maps with (b, ki, qi). Outputs
+    # stay per-QUERY-head ([B*Hq] rows) — for GQA the caller sums each
+    # query-head group (the transpose of the index-map sharing above).
     q_spec2 = pl.BlockSpec((1, bq, d), lambda b, ki, qi: (b, qi, 0),
                            memory_space=pltpu.VMEM)
-    kv_spec2 = pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0),
-                            memory_space=pltpu.VMEM)
+    kv_map2 = lambda b, ki, qi: kv_map(b, qi, ki)
+    kv_spec2 = pl.BlockSpec((1, bk, d), kv_map2, memory_space=pltpu.VMEM)
+    dkv_spec2 = pl.BlockSpec((1, bk, d), lambda b, ki, qi: (b, ki, 0),
+                             memory_space=pltpu.VMEM)
     row_spec2 = pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0),
                              memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
@@ -292,7 +310,7 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
         grid=(bh, nk, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
                   row_spec2],
-        out_specs=(kv_spec2, kv_spec2),
+        out_specs=(dkv_spec2, dkv_spec2),
         out_shape=(_sds(k, (bh, lk, d), k.dtype, q, v, do),
                    _sds(v, (bh, lk, d), v.dtype, q, k, do)),
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
@@ -319,7 +337,10 @@ def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 256, block_k: int = 512,
                     interpret: Optional[bool] = None):
-    """Fused blockwise attention. q, k, v: [B, L, H, D] → [B, Lq, H, D].
+    """Fused blockwise attention. q: [B, Lq, H, D]; k, v: [B, Lk, Hkv, D]
+    → [B, Lq, H, D]. Hkv < H is GQA/MQA (H % Hkv == 0, repeat-interleave
+    head sharing) — the shared KV is never replicated in HBM; the sharing
+    lives in the kernel's block index maps.
 
     ``interpret=None`` auto-selects: the Pallas interpreter off-TPU (tests),
     the compiled kernel on TPU.
@@ -333,17 +354,24 @@ def flash_attention(q, k, v, causal: bool = False,
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)[0]
 
 
+def _to3(x):
+    b, l, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     b, lq, h, d = q.shape
-    lk = k.shape[1]
-    to3 = lambda x, l: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, x.shape[-1])
+    hk = k.shape[2]
+    if h % hk:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({hk})")
     out3, lse3 = _flash_fwd_3d(
-        to3(q, lq), to3(k, lk), to3(v, lk),
+        _to3(q), _to3(k), _to3(v),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret)
+        interpret=interpret, hq=h, hkv=hk)
     out = jnp.transpose(out3.reshape(b, h, lq, d), (0, 2, 1, 3))
     return out, (q, k, v, out, lse3)
 
@@ -357,17 +385,22 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         interpret = jax.default_backend() != "tpu"
     sc = scale if scale is not None else q.shape[-1] ** -0.5
     b, lq, h, d = q.shape
-    lk = k.shape[1]
-    to3 = lambda x, l: jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, x.shape[-1])
+    lk, hk = k.shape[1], k.shape[2]
     # D_i = Σ_d dO_i · O_i — rowwise, cheap in XLA, f32 for stability
     dr = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     dr3 = jnp.transpose(dr, (0, 2, 1)).reshape(b * h, lq)
     dq3, dk3, dv3 = _flash_bwd_3d(
-        to3(q, lq), to3(k, lk), to3(v, lk), to3(g, lq), lse3, dr3,
+        _to3(q), _to3(k), _to3(v), _to3(g), lse3, dr3,
         causal=causal, scale=sc, block_q=block_q, block_k=block_k,
-        interpret=interpret)
-    back = lambda x3, l: jnp.transpose(x3.reshape(b, h, l, d), (0, 2, 1, 3))
-    return back(dq3, lq), back(dk3, lk), back(dv3, lk)
+        interpret=interpret, hq=h, hkv=hk)
+    if hk < h:
+        # transpose of the index-map head sharing: sum each query-head group
+        grp = h // hk
+        dk3 = dk3.reshape(b * hk, grp, lk, d).sum(1)
+        dv3 = dv3.reshape(b * hk, grp, lk, d).sum(1)
+    back = lambda x3, hh, l: jnp.transpose(
+        x3.reshape(b, hh, l, d), (0, 2, 1, 3))
+    return back(dq3, h, lq), back(dk3, hk, lk), back(dv3, hk, lk)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
