@@ -26,6 +26,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -57,11 +59,13 @@ def _causal_live(qi, ki, bq, bk):
     return qi * bq + bq - 1 >= ki * bk
 
 
-def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, bq, bk):
-    """Scaled (and causally masked) score tile S = (Q Kᵀ)·scale, f32.
+def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, bq, bk,
+                 qs_ref=None, ks_ref=None):
+    """Scaled (causally and/or segment-) masked score tile S = (Q Kᵀ)·scale.
 
     Shared by the forward and both backward kernels so masking semantics
-    can never desynchronize between them.
+    can never desynchronize between them. Segment masking (packed
+    sequences) blanks positions whose query and key segment ids differ.
     """
     q = q_ref[0].astype(jnp.float32)          # [bq, d]
     k = k_ref[0].astype(jnp.float32)          # [bk, d]
@@ -73,11 +77,33 @@ def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, bq, bk):
         rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where((qi * bq + rows) >= (ki * bk + cols), s, _NEG_INF)
+    if qs_ref is not None:
+        s = jnp.where(qs_ref[0] == ks_ref[0], s, _NEG_INF)  # (bq,1)==(1,bk)
     return s
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mrow, lrow, *,
-               scale, causal, bq, bk, nk):
+def _masked_exp(s, shift, has_segs):
+    """exp(s - shift) with masked entries forced to exactly 0.
+
+    Segment masking can fully mask a row (padding) or a whole tile; there
+    ``shift`` (running max or lse) is itself ≈ _NEG_INF and the naive
+    exp(s - shift) = exp(0) = 1 (or overflows). Causal-only masking never
+    produces such rows (column 0 is always visible), so the select is
+    compiled in only when segments are present.
+    """
+    e = jnp.exp(s - shift)
+    if has_segs:
+        e = jnp.where(s <= 0.5 * _NEG_INF, 0.0, e)
+    return e
+
+
+def _fa_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False):
+    if has_segs:
+        (q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
+         acc, mrow, lrow) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mrow, lrow = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -90,11 +116,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mrow, lrow, *,
     def _compute():
         v = v_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                         bq=bq, bk=bk)
+                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref)
         m_prev = mrow[:, :1]                       # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                     # [bq, bk]
+        p = _masked_exp(s, m_new, has_segs)        # [bq, bk]
         alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
         lrow[:, :1] = lrow[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
         acc[:] = acc[:] * alpha + jax.lax.dot_general(
@@ -141,10 +167,25 @@ def _kv_row_map(hq: int, hkv: int):
     return lambda b, qi, ki: ((b // hq) * hkv + (b % hq) // g, ki, 0)
 
 
+def _seg_specs(hq, bq, bk, order_qk=True):
+    """BlockSpecs for segment-id operands: q_seg [B, Lq, 1] tiles
+    (1, bq, 1); kv_seg [B, 1, Lk] tiles (1, 1, bk) — both minimal legal
+    TPU layouts (the block dim of 1 equals the array dim). Grid row b runs
+    over B*Hq; segment ids are per batch, hence the ``b // hq``."""
+    if order_qk:
+        qmap = lambda b, qi, ki: (b // hq, qi, 0)
+        kmap = lambda b, qi, ki: (b // hq, 0, ki)
+    else:  # (b, ki, qi) grids
+        qmap = lambda b, ki, qi: (b // hq, qi, 0)
+        kmap = lambda b, ki, qi: (b // hq, 0, ki)
+    return (pl.BlockSpec((1, bq, 1), qmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bk), kmap, memory_space=pltpu.VMEM))
+
+
 def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
-                  hq=1, hkv=1):
+                  hq=1, hkv=1, segs=None):
     """q: [B*Hq, Lq, D]; k, v: [B*Hkv, Lk, D] → ([B*Hq, Lq, D],
-    lse [B*Hq, Lq, 1]).
+    lse [B*Hq, Lq, 1]). ``segs``: (q_seg [B, Lq, 1], kv_seg [B, 1, Lk]).
 
     lse rides a trailing dim of 1: TPU block shapes must have last-two dims
     divisible by (8, 128) OR equal to the array dims, so (1, bq, 1) on a
@@ -158,17 +199,23 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
     kv_map = _kv_row_map(hq, hkv)
 
     kernel = functools.partial(
-        _fa_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk)
+        _fa_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+        has_segs=segs is not None)
     grid = (bh, lq // bq, nk)
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
+    ]
+    operands = (q, k, v)
+    if segs is not None:
+        in_specs += list(_seg_specs(hq, bq, bk))
+        operands += segs
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), kv_map, memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
                          memory_space=pltpu.VMEM),
@@ -183,7 +230,7 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((bq, 128), jnp.float32),   # running sum (col 0)
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return out, lse
 
 
@@ -194,8 +241,14 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
 # allocated 8 GB score tensors per block.
 # ---------------------------------------------------------------------------
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dq_ref,
-                      dq_acc, *, scale, causal, bq, bk, nk):
+def _fa_bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False):
+    if has_segs:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, qs_ref, ks_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dq_ref,
+         dq_acc) = refs
+        qs_ref = ks_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -207,8 +260,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dq_ref,
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                         bq=bq, bk=bk)
-        p = jnp.exp(s - lse_ref[0])                    # [bq, bk]
+                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref)
+        p = _masked_exp(s, lse_ref[0], has_segs)       # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
@@ -225,9 +278,14 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref,
-                       dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                       bq, bk, nq):
+def _fa_bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_segs=False):
+    if has_segs:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, qs_ref, ks_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs
+        qs_ref = ks_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -240,8 +298,8 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref,
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                         bq=bq, bk=bk)
-        p = jnp.exp(s - lse_ref[0])                    # [bq, bk]
+                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref)
+        p = _masked_exp(s, lse_ref[0], has_segs)       # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bk, d]
@@ -263,10 +321,10 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref,
 
 
 def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
-                  interpret, hq=1, hkv=1):
+                  interpret, hq=1, hkv=1, segs=None):
     """q/do: [B*Hq, Lq, D]; k/v: [B*Hkv, Lk, D]; lse/dr: [B*Hq, Lq] →
     (dq [B*Hq], dk, dv [B*Hq — caller reduces query-head groups when
-    hkv < hq])."""
+    hkv < hq]). ``segs``: (q_seg [B, Lq, 1], kv_seg [B, 1, Lk])."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     lse = lse.reshape(bh, lq, 1)   # minimal legal TPU block layout
@@ -275,6 +333,7 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
     bk = _fit_block(block_k, lk)
     nq, nk = lq // bq, lk // bk
     kv_map = _kv_row_map(hq, hkv)
+    has_segs = segs is not None
 
     q_spec = pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
                           memory_space=pltpu.VMEM)
@@ -282,16 +341,21 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
     row_spec = pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0),
                             memory_space=pltpu.VMEM)
 
+    in_specs = [q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec]
+    operands = (q, k, v, do, lse, dr)
+    if has_segs:
+        in_specs += list(_seg_specs(hq, bq, bk))
+        operands += segs
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, has_segs=has_segs),
         grid=(bh, nq, nk),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         out_shape=_sds(q, (bh, lq, d), q.dtype, k, v, do),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, dr)
+    )(*operands)
 
     # dk/dv iterate q innermost; same index maps with (b, ki, qi). Outputs
     # stay per-QUERY-head ([B*Hq] rows) — for GQA the caller sums each
@@ -304,19 +368,21 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
                              memory_space=pltpu.VMEM)
     row_spec2 = pl.BlockSpec((1, bq, 1), lambda b, ki, qi: (b, qi, 0),
                              memory_space=pltpu.VMEM)
+    in_specs2 = [q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2]
+    if has_segs:
+        in_specs2 += list(_seg_specs(hq, bq, bk, order_qk=False))
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, has_segs=has_segs),
         grid=(bh, nk, nq),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2,
-                  row_spec2],
+        in_specs=in_specs2,
         out_specs=(dkv_spec2, dkv_spec2),
         out_shape=(_sds(k, (bh, lk, d), k.dtype, q, v, do),
                    _sds(v, (bh, lk, d), v.dtype, q, k, do)),
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, dr)
+    )(*operands)
     return dq, dk, dv
 
 
@@ -336,11 +402,20 @@ def _reference(q, k, v, causal, scale):
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 256, block_k: int = 512,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    segment_ids=None):
     """Fused blockwise attention. q: [B, Lq, H, D]; k, v: [B, Lk, Hkv, D]
     → [B, Lq, H, D]. Hkv < H is GQA/MQA (H % Hkv == 0, repeat-interleave
     head sharing) — the shared KV is never replicated in HBM; the sharing
     lives in the kernel's block index maps.
+
+    ``segment_ids`` enables packed-sequence masking (the TPU-native answer
+    to the reference seq2seq's variable-length batching — static shapes,
+    many sequences per row): an int32 [B, L] array (self-attention) or a
+    (q_seg [B, Lq], kv_seg [B, Lk]) pair; positions attend only within
+    their segment (composed with causal). Rows whose segment matches no
+    key (e.g. padding marked -1 vs 0-based ids) produce zero output and
+    zero gradient.
 
     ``interpret=None`` auto-selects: the Pallas interpreter off-TPU (tests),
     the compiled kernel on TPU.
@@ -351,7 +426,8 @@ def flash_attention(q, k, v, causal: bool = False,
     the largest divisor of L (lane-aligned where possible), so any length
     works; explicit blocks are only a tuning knob.
     """
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)[0]
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                      segment_ids)[0]
 
 
 def _to3(x):
@@ -359,7 +435,24 @@ def _to3(x):
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _norm_segs(segment_ids, lq, lk):
+    """→ None or kernel-layout (q_seg [B, Lq, 1], kv_seg [B, 1, Lk])."""
+    if segment_ids is None:
+        return None
+    if isinstance(segment_ids, (tuple, list)):
+        qs, ks = segment_ids
+    else:
+        qs = ks = segment_ids
+        if lq != lk:
+            raise ValueError(
+                "a single segment_ids array needs Lq == Lk; pass a "
+                "(q_seg, kv_seg) pair for cross-attention")
+    return (jnp.asarray(qs, jnp.int32)[:, :, None],
+            jnp.asarray(ks, jnp.int32)[:, None, :])
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               segment_ids=None):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -368,31 +461,33 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     if h % hk:
         raise ValueError(
             f"query heads ({h}) must be a multiple of kv heads ({hk})")
+    segs = _norm_segs(segment_ids, lq, k.shape[1])
     out3, lse3 = _flash_fwd_3d(
         _to3(q), _to3(k), _to3(v),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret, hq=h, hkv=hk)
+        interpret=interpret, hq=h, hkv=hk, segs=segs)
     out = jnp.transpose(out3.reshape(b, h, lq, d), (0, 2, 1, 3))
-    return out, (q, k, v, out, lse3)
+    return out, (q, k, v, out, lse3, segment_ids)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     # blockwise Pallas backward: P is rebuilt per tile from the forward's
     # logsumexp; [L, L] never touches HBM (the materializing fallback
     # allocated 8 GB f32 score tensors at b=64/L=2048/h=8)
-    q, k, v, out, lse3 = res
+    q, k, v, out, lse3, segment_ids = res
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     sc = scale if scale is not None else q.shape[-1] ** -0.5
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
+    segs = _norm_segs(segment_ids, lq, lk)
     # D_i = Σ_d dO_i · O_i — rowwise, cheap in XLA, f32 for stability
     dr = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     dr3 = jnp.transpose(dr, (0, 2, 1)).reshape(b * h, lq)
     dq3, dk3, dv3 = _flash_bwd_3d(
         _to3(q), _to3(k), _to3(v), _to3(g), lse3, dr3,
         causal=causal, scale=sc, block_q=block_q, block_k=block_k,
-        interpret=interpret, hq=h, hkv=hk)
+        interpret=interpret, hq=h, hkv=hk, segs=segs)
     if hk < h:
         # transpose of the index-map head sharing: sum each query-head group
         grp = h // hk
@@ -400,7 +495,9 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         dv3 = dv3.reshape(b * hk, grp, lk, d).sum(1)
     back = lambda x3, hh, l: jnp.transpose(
         x3.reshape(b, hh, l, d), (0, 2, 1, 3))
-    return back(dq3, h, lq), back(dk3, hk, lk), back(dv3, hk, lk)
+    dsegs = jax.tree_util.tree_map(
+        lambda s: np.zeros(s.shape, jax.dtypes.float0), segment_ids)
+    return (back(dq3, h, lq), back(dk3, hk, lk), back(dv3, hk, lk), dsegs)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -202,3 +202,90 @@ def test_gqa_matches_repeated_kv(causal, hkv):
         assert a.shape == r.shape
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=1e-3, atol=1e-4)
+
+
+def _reference_segs(q, k, v, q_seg, kv_seg, causal, scale):
+    """Oracle with explicit segment masking; fully-masked rows → zeros."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = (q_seg[:, :, None] == kv_seg[:, None, :])[:, None]
+    if causal:
+        lq, lk = q.shape[1], k.shape[1]
+        mask = mask & (jnp.arange(lk)[None, :]
+                       <= jnp.arange(lq)[:, None])[None, None]
+    s = jnp.where(mask, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
+    denom = jnp.maximum(jnp.sum(p, -1, keepdims=True), 1e-30)
+    return jnp.einsum("bhqk,bkhd->bqhd", p / denom,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_segment_ids_packed(causal):
+    """Packed sequences: 3 segments + padding (-1) in one row; values and
+    gradients match the masked oracle, padding rows get zero out/grad."""
+    rng = np.random.RandomState(9)
+    b, l, h, d = 2, 128, 2, 16
+    q = rng.randn(b, l, h, d).astype(np.float32)
+    k = rng.randn(b, l, h, d).astype(np.float32)
+    v = rng.randn(b, l, h, d).astype(np.float32)
+    # segments of length 48/40/24, then 16 padding slots. Padding uses
+    # MISMATCHED ids on the two sides (-1 for queries, -2 for keys):
+    # equal ids attend, so -1/-1 would let padding attend to itself.
+    seg = np.concatenate([np.full(48, 0), np.full(40, 1), np.full(24, 2),
+                          np.full(16, -1)]).astype(np.int32)
+    q_seg = np.broadcast_to(seg, (b, l)).copy()
+    kv_seg = np.where(seg < 0, -2, seg).astype(np.int32)
+    kv_seg = np.broadcast_to(kv_seg, (b, l)).copy()
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal, None, 64, 64, True,
+                              (jnp.asarray(q_seg), jnp.asarray(kv_seg)))
+        return jnp.sum(out * jnp.cos(out)), out
+
+    def loss_ref(q, k, v):
+        out = _reference_segs(q, k, v, jnp.asarray(q_seg),
+                              jnp.asarray(kv_seg), causal, d ** -0.5)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    (lf, of), g = jax.value_and_grad(loss_flash, argnums=(0, 1, 2),
+                                     has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    (lr, orf), gr = jax.value_and_grad(loss_ref, argnums=(0, 1, 2),
+                                       has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orf),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(lf), float(lr), rtol=1e-4)
+    for a, r in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
+    # padding rows: zero output and zero dq
+    np.testing.assert_array_equal(np.asarray(of)[:, -16:], 0.0)
+    np.testing.assert_array_equal(np.asarray(g[0])[:, -16:], 0.0)
+
+
+def test_segment_ids_match_separate_calls():
+    """Two sequences packed into one row == the same two sequences run as
+    separate flash_attention calls (the real packing use-case)."""
+    rng = np.random.RandomState(10)
+    h, d = 2, 16
+    l1, l2 = 64, 64
+    mk = lambda l: rng.randn(1, l, h, d).astype(np.float32)
+    q1, k1, v1 = mk(l1), mk(l1), mk(l1)
+    q2, k2, v2 = mk(l2), mk(l2), mk(l2)
+    packed = lambda a, b2: jnp.asarray(np.concatenate([a, b2], axis=1))
+    seg = jnp.asarray(np.concatenate(
+        [np.zeros(l1), np.ones(l2)]).astype(np.int32))[None]
+
+    out = flash_attention(packed(q1, q2), packed(k1, k2), packed(v1, v2),
+                          True, None, 32, 32, True, seg)
+    o1 = flash_attention(jnp.asarray(q1), jnp.asarray(k1), jnp.asarray(v1),
+                         True, None, 32, 32, True)
+    o2 = flash_attention(jnp.asarray(q2), jnp.asarray(k2), jnp.asarray(v2),
+                         True, None, 32, 32, True)
+    np.testing.assert_allclose(np.asarray(out)[:, :l1], np.asarray(o1),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out)[:, l1:], np.asarray(o2),
+                               rtol=2e-4, atol=2e-5)
