@@ -53,6 +53,20 @@ def _fit_block(block: int, l: int) -> int:
     return 1
 
 
+def _padded_len(block: int, l: int) -> int:
+    """Length after padding to make a TPU-legal block exist.
+
+    A block is legal when it divides l AND (is a multiple of 8 OR equals
+    l). Lengths like 2047 (divisors 89/23) or 100 (divisor 50) admit no
+    legal block smaller than l worth using — pad to the next multiple of
+    128 (8 for short rows) and mask the tail via segment ids."""
+    blk = _fit_block(block, l)
+    if blk % 8 == 0 or blk == l:
+        return l
+    step = 128 if l >= 128 else 8
+    return ((l + step - 1) // step) * step
+
+
 def _causal_live(qi, ki, bq, bk):
     """Whether tile (qi, ki) intersects the causal triangle: the last q row
     of the tile must see at least the first k column."""
@@ -451,6 +465,36 @@ def _norm_segs(segment_ids, lq, lk):
             jnp.asarray(ks, jnp.int32)[:, None, :])
 
 
+def _pad_rows(x, n):
+    return jnp.pad(x, ((0, 0), (0, n)) + ((0, 0),) * (x.ndim - 2))
+
+
+def _apply_padding(q, k, v, segment_ids, block_q, block_k):
+    """Pad Lq/Lk to TPU-legal block lengths, masking the tail with
+    segment ids (query pad −1, kv pad −2: matches nothing, including each
+    other). Returns (q, k, v, effective_segment_ids, lq_pad, lk_pad) with
+    the ORIGINAL arrays when no padding is needed."""
+    b, lq = q.shape[0], q.shape[1]
+    lk = k.shape[1]
+    lq_p, lk_p = _padded_len(block_q, lq), _padded_len(block_k, lk)
+    if lq_p == lq and lk_p == lk:
+        return q, k, v, segment_ids, 0, 0
+    if segment_ids is None:
+        qs, ks = jnp.zeros((b, lq), jnp.int32), jnp.zeros((b, lk), jnp.int32)
+    elif isinstance(segment_ids, (tuple, list)):
+        qs, ks = segment_ids
+    else:
+        qs = ks = segment_ids
+    qs = jnp.where(_pad_rows(jnp.ones((b, lq), bool), lq_p - lq),
+                   _pad_rows(jnp.asarray(qs, jnp.int32), lq_p - lq), -1)
+    ks = jnp.where(_pad_rows(jnp.ones((b, lk), bool), lk_p - lk),
+                   _pad_rows(jnp.asarray(ks, jnp.int32), lk_p - lk), -2)
+    q = _pad_rows(q, lq_p - lq)
+    k = _pad_rows(k, lk_p - lk)
+    v = _pad_rows(v, lk_p - lk)
+    return q, k, v, (qs, ks), lq_p - lq, lk_p - lk
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
                segment_ids=None):
     if interpret is None:
@@ -461,12 +505,15 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
     if h % hk:
         raise ValueError(
             f"query heads ({h}) must be a multiple of kv heads ({hk})")
-    segs = _norm_segs(segment_ids, lq, k.shape[1])
+    qp, kp, vp, segs_eff, _, _ = _apply_padding(
+        q, k, v, segment_ids, block_q, block_k)
+    segs = _norm_segs(segs_eff, qp.shape[1], kp.shape[1])
     out3, lse3 = _flash_fwd_3d(
-        _to3(q), _to3(k), _to3(v),
+        _to3(qp), _to3(kp), _to3(vp),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
         interpret=interpret, hq=h, hkv=hk, segs=segs)
-    out = jnp.transpose(out3.reshape(b, h, lq, d), (0, 2, 1, 3))
+    out = jnp.transpose(out3.reshape(b, h, qp.shape[1], d),
+                        (0, 2, 1, 3))[:, :lq]
     return out, (q, k, v, out, lse3, segment_ids)
 
 
@@ -480,24 +527,30 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     sc = scale if scale is not None else q.shape[-1] ** -0.5
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
-    segs = _norm_segs(segment_ids, lq, lk)
+    qp, kp, vp, segs_eff, pq, pk = _apply_padding(
+        q, k, v, segment_ids, block_q, block_k)
+    lq_p, lk_p = lq + pq, lk + pk
+    segs = _norm_segs(segs_eff, lq_p, lk_p)
+    gp = _pad_rows(g, pq) if pq else g
     # D_i = Σ_d dO_i · O_i — rowwise, cheap in XLA, f32 for stability
     dr = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    dr3 = jnp.transpose(dr, (0, 2, 1)).reshape(b * h, lq)
+    dr3 = jnp.pad(jnp.transpose(dr, (0, 2, 1)).reshape(b * h, lq),
+                  ((0, 0), (0, pq)))
     dq3, dk3, dv3 = _flash_bwd_3d(
-        _to3(q), _to3(k), _to3(v), _to3(g), lse3, dr3,
+        _to3(qp), _to3(kp), _to3(vp), _to3(gp), lse3, dr3,
         causal=causal, scale=sc, block_q=block_q, block_k=block_k,
         interpret=interpret, hq=h, hkv=hk, segs=segs)
     if hk < h:
         # transpose of the index-map head sharing: sum each query-head group
         grp = h // hk
-        dk3 = dk3.reshape(b * hk, grp, lk, d).sum(1)
-        dv3 = dv3.reshape(b * hk, grp, lk, d).sum(1)
-    back = lambda x3, hh, l: jnp.transpose(
-        x3.reshape(b, hh, l, d), (0, 2, 1, 3))
+        dk3 = dk3.reshape(b * hk, grp, lk_p, d).sum(1)
+        dv3 = dv3.reshape(b * hk, grp, lk_p, d).sum(1)
+    back = lambda x3, hh, lp, l: jnp.transpose(
+        x3.reshape(b, hh, lp, d), (0, 2, 1, 3))[:, :l]
     dsegs = jax.tree_util.tree_map(
         lambda s: np.zeros(s.shape, jax.dtypes.float0), segment_ids)
-    return (back(dq3, h, lq), back(dk3, hk, lk), back(dv3, hk, lk), dsegs)
+    return (back(dq3, h, lq_p, lq), back(dk3, hk, lk_p, lk),
+            back(dv3, hk, lk_p, lk), dsegs)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -289,3 +289,54 @@ def test_segment_ids_match_separate_calls():
                                rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(np.asarray(out)[:, l1:], np.asarray(o2),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("l", [2047, 1009])
+def test_tpu_illegal_lengths_pad_and_mask(l):
+    """L=2047 (divisors 89/23) and prime 1009 admit no TPU-legal block;
+    the wrapper pads to the next lane multiple and masks the tail with
+    synthesized segment ids. Values and grads must match the unpadded
+    oracle (this is the TransformerLM tok[:, :-1] length)."""
+    q, k, v = _qkv(b=1, l=l, h=2, d=16, seed=11)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, True, None, 256, 512, True)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    def loss_ref(q, k, v):
+        out = _reference(q, k, v, True, q.shape[-1] ** -0.5)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    (lf, of), g = jax.value_and_grad(loss_flash, argnums=(0, 1, 2),
+                                     has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    (lr, orf), gr = jax.value_and_grad(loss_ref, argnums=(0, 1, 2),
+                                       has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    assert of.shape == q.shape
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orf),
+                               rtol=2e-4, atol=2e-5)
+    for a, r in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_padding_composes_with_user_segments():
+    """Odd length AND user packing: the wrapper's pad segs must extend the
+    user's, not replace them."""
+    rng = np.random.RandomState(12)
+    b, l, h, d = 1, 120, 2, 16  # 120: fit_block gives 120 (==l, legal)... use 118
+    l = 118                      # divisors 59/2 → illegal → pads to 128
+    q = rng.randn(b, l, h, d).astype(np.float32)
+    k = rng.randn(b, l, h, d).astype(np.float32)
+    v = rng.randn(b, l, h, d).astype(np.float32)
+    seg = np.concatenate([np.zeros(70), np.ones(48)]).astype(np.int32)[None]
+
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          True, None, 256, 512, True, jnp.asarray(seg))
+    ref = _reference_segs(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          jnp.asarray(seg), jnp.asarray(seg), True,
+                          d ** -0.5)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
