@@ -35,6 +35,7 @@ from chainermn_tpu.parallel.ring_attention import (
     ring_flash_attention,
 )
 from chainermn_tpu.parallel.ulysses import ulysses_attention
+from chainermn_tpu.ops.rotary import apply_rope
 
 __all__ = ["TransformerLM", "TransformerBlock", "lm_loss_with_aux"]
 
@@ -49,13 +50,16 @@ class TransformerBlock(nn.Module):
     dtype: Any = jnp.float32
     # 'flash' | 'ring' | 'ring_flash' | 'ulysses' | 'reference'
     attention: str = "flash"
+    attention_window: Optional[int] = None  # sliding window (flash path)
+    pos_emb: str = "learned"           # 'learned' (handled by the LM) | 'rope'
+    rope_theta: float = 10000.0
     seq_axis: Optional[str] = None     # mesh axis for 'ring'
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pos_offset=0):
         b, l, d = x.shape
         dh = self.d_model // self.n_heads
 
@@ -78,6 +82,13 @@ class TransformerBlock(nn.Module):
         q = q.reshape(b, l, self.n_heads, dh)
         k = k.reshape(b, l, hkv, dh)
         v = v.reshape(b, l, hkv, dh)
+        if self.pos_emb == "rope":
+            pos = pos_offset + jnp.arange(l)
+            q = apply_rope(q, pos, self.rope_theta)
+            k = apply_rope(k, pos, self.rope_theta)
+        if self.attention_window is not None and self.attention != "flash":
+            raise ValueError(
+                "attention_window is supported on the 'flash' path")
         if self.attention in ("ring", "ring_flash", "ulysses"):
             if self.seq_axis is None:
                 raise ValueError(
@@ -87,7 +98,8 @@ class TransformerBlock(nn.Module):
                       "ulysses": ulysses_attention}[self.attention]
             att = seq_fn(q, k, v, axis_name=self.seq_axis, causal=True)
         elif self.attention == "flash":
-            att = flash_attention(q, k, v, causal=True)  # GQA-native
+            att = flash_attention(q, k, v, causal=True,
+                                  window=self.attention_window)
         else:
             if hkv != self.n_heads:
                 k = jnp.repeat(k, self.n_heads // hkv, axis=2)
@@ -133,6 +145,9 @@ class TransformerLM(nn.Module):
     n_layers: int = 4
     d_ff: int = 1024
     max_len: int = 2048
+    pos_emb: str = "learned"           # 'learned' | 'rope'
+    rope_theta: float = 10000.0
+    attention_window: Optional[int] = None
     dtype: Any = jnp.float32
     attention: str = "flash"
     seq_axis: Optional[str] = None
@@ -145,21 +160,26 @@ class TransformerLM(nn.Module):
         b, l = tokens.shape
         emb = nn.Embed(self.vocab, self.d_model,
                        dtype=self.dtype, name="tok_emb")(tokens)
-        pos = self.param(
-            "pos_emb", nn.initializers.normal(0.02),
-            (self.max_len, self.d_model))
-        idx = pos_offset + jnp.arange(l)
-        x = emb + jnp.take(pos, idx, axis=0).astype(self.dtype)[None]
+        if self.pos_emb == "learned":
+            pos = self.param(
+                "pos_emb", nn.initializers.normal(0.02),
+                (self.max_len, self.d_model))
+            idx = pos_offset + jnp.arange(l)
+            x = emb + jnp.take(pos, idx, axis=0).astype(self.dtype)[None]
+        else:  # 'rope': positions enter inside each block's attention
+            x = emb
         for i in range(self.n_layers):
             x = TransformerBlock(
                 d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
                 n_kv_heads=self.n_kv_heads,
                 dtype=self.dtype, attention=self.attention,
+                attention_window=self.attention_window,
+                pos_emb=self.pos_emb, rope_theta=self.rope_theta,
                 seq_axis=self.seq_axis,
                 moe_experts_per_device=self.moe_experts_per_device,
                 expert_axis=self.expert_axis,
                 capacity_factor=self.capacity_factor,
-                name=f"block_{i}")(x)
+                name=f"block_{i}")(x, pos_offset=pos_offset)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x)

@@ -4,9 +4,11 @@ Reference parity note: the reference's only custom device kernels are CuPy
 cast/pack elementwise kernels (SURVEY.md §2.2); XLA already fuses those here.
 The kernel worth hand-writing on TPU is blockwise attention: one pass over
 K/V tiles in VMEM with online softmax, never materializing the [L, L] score
-matrix in HBM. Usable standalone; ring attention
-(chainermn_tpu/parallel/ring_attention.py) currently uses its own XLA
-blockwise compute and can adopt this kernel as the per-block inner loop.
+matrix in HBM. Supports GQA/MQA (index-map KV-head sharing), segment-id
+packing, sliding windows, and automatic padding for TPU-illegal lengths.
+Usable standalone; `ring_flash_attention`
+(chainermn_tpu/parallel/ring_attention.py) runs these kernels as the
+per-block inner loop of the sequence-parallel ring.
 
 Layout: [B, L, H, D] → kernel works on [B*H, L, D]. Grid is
 (batch*heads, q_blocks, kv_blocks) with the kv dimension innermost; VMEM
@@ -67,19 +69,25 @@ def _padded_len(block: int, l: int) -> int:
     return ((l + step - 1) // step) * step
 
 
-def _causal_live(qi, ki, bq, bk):
-    """Whether tile (qi, ki) intersects the causal triangle: the last q row
-    of the tile must see at least the first k column."""
-    return qi * bq + bq - 1 >= ki * bk
+def _causal_live(qi, ki, bq, bk, window=None):
+    """Whether tile (qi, ki) intersects the visible band: below the causal
+    diagonal and, with a sliding window, within ``window`` positions of
+    it (the first q row of the tile must still see the last k column)."""
+    live = qi * bq + bq - 1 >= ki * bk
+    if window is not None:
+        live = jnp.logical_and(live, ki * bk + bk - 1 > qi * bq - window)
+    return live
 
 
 def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, bq, bk,
-                 qs_ref=None, ks_ref=None):
-    """Scaled (causally and/or segment-) masked score tile S = (Q Kᵀ)·scale.
+                 qs_ref=None, ks_ref=None, window=None):
+    """Scaled and masked score tile S = (Q Kᵀ)·scale (causal, sliding
+    window, and/or segment masking).
 
     Shared by the forward and both backward kernels so masking semantics
     can never desynchronize between them. Segment masking (packed
-    sequences) blanks positions whose query and key segment ids differ.
+    sequences) blanks positions whose query and key segment ids differ;
+    a sliding window keeps only the last ``window`` positions (causal).
     """
     q = q_ref[0].astype(jnp.float32)          # [bq, d]
     k = k_ref[0].astype(jnp.float32)          # [bk, d]
@@ -88,9 +96,12 @@ def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, bq, bk,
         preferred_element_type=jnp.float32,
     ) * scale                                  # [bq, bk]
     if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        s = jnp.where((qi * bq + rows) >= (ki * bk + cols), s, _NEG_INF)
+        rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        keep = rows >= cols
+        if window is not None:
+            keep = jnp.logical_and(keep, rows - cols < window)
+        s = jnp.where(keep, s, _NEG_INF)
     if qs_ref is not None:
         s = jnp.where(qs_ref[0] == ks_ref[0], s, _NEG_INF)  # (bq,1)==(1,bk)
     return s
@@ -111,7 +122,8 @@ def _masked_exp(s, shift, has_segs):
     return e
 
 
-def _fa_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False):
+def _fa_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False,
+               window=None):
     if has_segs:
         (q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref,
          acc, mrow, lrow) = refs
@@ -130,7 +142,8 @@ def _fa_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False):
     def _compute():
         v = v_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref)
+                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref,
+                         window=window)
         m_prev = mrow[:, :1]                       # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -147,7 +160,8 @@ def _fa_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False):
     # predicate must be TRACED even when trivially true: the Pallas
     # interpreter mishandles varying-axes tracking (shard_map check_vma)
     # for ref reads outside a traced cond.
-    pl.when(_causal_live(qi, ki, bq, bk) if causal else ki >= 0)(_compute)
+    pl.when(_causal_live(qi, ki, bq, bk, window) if causal
+            else ki >= 0)(_compute)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -197,7 +211,7 @@ def _seg_specs(hq, bq, bk, order_qk=True):
 
 
 def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
-                  hq=1, hkv=1, segs=None):
+                  hq=1, hkv=1, segs=None, window=None):
     """q: [B*Hq, Lq, D]; k, v: [B*Hkv, Lk, D] → ([B*Hq, Lq, D],
     lse [B*Hq, Lq, 1]). ``segs``: (q_seg [B, Lq, 1], kv_seg [B, 1, Lk]).
 
@@ -214,7 +228,7 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
 
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
-        has_segs=segs is not None)
+        has_segs=segs is not None, window=window)
     grid = (bh, lq // bq, nk)
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0),
@@ -255,7 +269,8 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
 # allocated 8 GB score tensors per block.
 # ---------------------------------------------------------------------------
 
-def _fa_bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False):
+def _fa_bwd_dq_kernel(*refs, scale, causal, bq, bk, nk,
+                      has_segs=False, window=None):
     if has_segs:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, qs_ref, ks_ref,
          dq_ref, dq_acc) = refs
@@ -274,7 +289,8 @@ def _fa_bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False):
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref)
+                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref,
+                         window=window)
         p = _masked_exp(s, lse_ref[0], has_segs)       # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -285,14 +301,16 @@ def _fa_bwd_dq_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False):
             preferred_element_type=jnp.float32)
 
     # traced-predicate gate even when non-causal — see _fa_kernel
-    pl.when(_causal_live(qi, ki, bq, bk) if causal else ki >= 0)(_compute)
+    pl.when(_causal_live(qi, ki, bq, bk, window) if causal
+            else ki >= 0)(_compute)
 
     @pl.when(ki == nk - 1)
     def _finalize():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _fa_bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_segs=False):
+def _fa_bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq,
+                       has_segs=False, window=None):
     if has_segs:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, qs_ref, ks_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -312,7 +330,8 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_segs=False):
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref)
+                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref,
+                         window=window)
         p = _masked_exp(s, lse_ref[0], has_segs)       # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -326,7 +345,8 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_segs=False):
             preferred_element_type=jnp.float32)        # [bk, d]
 
     # traced-predicate gate even when non-causal — see _fa_kernel
-    pl.when(_causal_live(qi, ki, bq, bk) if causal else qi >= 0)(_compute)
+    pl.when(_causal_live(qi, ki, bq, bk, window) if causal
+            else qi >= 0)(_compute)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -335,7 +355,7 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, has_segs=False):
 
 
 def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
-                  interpret, hq=1, hkv=1, segs=None):
+                  interpret, hq=1, hkv=1, segs=None, window=None):
     """q/do: [B*Hq, Lq, D]; k/v: [B*Hkv, Lk, D]; lse/dr: [B*Hq, Lq] →
     (dq [B*Hq], dk, dv [B*Hq — caller reduces query-head groups when
     hkv < hq]). ``segs``: (q_seg [B, Lq, 1], kv_seg [B, 1, Lk])."""
@@ -362,7 +382,8 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
         operands += segs
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, has_segs=has_segs),
+                          bq=bq, bk=bk, nk=nk, has_segs=has_segs,
+                          window=window),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -387,7 +408,8 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
         in_specs2 += list(_seg_specs(hq, bq, bk, order_qk=False))
     dk, dv = pl.pallas_call(
         functools.partial(_fa_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, has_segs=has_segs),
+                          bq=bq, bk=bk, nq=nq, has_segs=has_segs,
+                          window=window),
         grid=(bh, nk, nq),
         in_specs=in_specs2,
         out_specs=(dkv_spec2, dkv_spec2),
@@ -412,12 +434,12 @@ def _reference(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 9))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = 256, block_k: int = 512,
                     interpret: Optional[bool] = None,
-                    segment_ids=None):
+                    segment_ids=None, window: Optional[int] = None):
     """Fused blockwise attention. q: [B, Lq, H, D]; k, v: [B, Lk, Hkv, D]
     → [B, Lq, H, D]. Hkv < H is GQA/MQA (H % Hkv == 0, repeat-interleave
     head sharing) — the shared KV is never replicated in HBM; the sharing
@@ -431,6 +453,10 @@ def flash_attention(q, k, v, causal: bool = False,
     key (e.g. padding marked -1 vs 0-based ids) produce zero output and
     zero gradient.
 
+    ``window`` (requires causal) is sliding-window attention: each query
+    attends to its last ``window`` positions only; tiles fully outside
+    the band are skipped, so compute scales with L·window instead of L².
+
     ``interpret=None`` auto-selects: the Pallas interpreter off-TPU (tests),
     the compiled kernel on TPU.
 
@@ -441,7 +467,7 @@ def flash_attention(q, k, v, causal: bool = False,
     works; explicit blocks are only a tuning knob.
     """
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                      segment_ids)[0]
+                      segment_ids, window)[0]
 
 
 def _to3(x):
@@ -496,7 +522,10 @@ def _apply_padding(q, k, v, segment_ids, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               segment_ids=None):
+               segment_ids=None, window=None):
+    if window is not None and not causal:
+        raise ValueError("window (sliding-window attention) requires "
+                         "causal=True")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -511,13 +540,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
     out3, lse3 = _flash_fwd_3d(
         _to3(qp), _to3(kp), _to3(vp),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
-        interpret=interpret, hq=h, hkv=hk, segs=segs)
+        interpret=interpret, hq=h, hkv=hk, segs=segs, window=window)
     out = jnp.transpose(out3.reshape(b, h, qp.shape[1], d),
                         (0, 2, 1, 3))[:, :lq]
     return out, (q, k, v, out, lse3, segment_ids)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
+               g):
     # blockwise Pallas backward: P is rebuilt per tile from the forward's
     # logsumexp; [L, L] never touches HBM (the materializing fallback
     # allocated 8 GB f32 score tensors at b=64/L=2048/h=8)
@@ -539,7 +569,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     dq3, dk3, dv3 = _flash_bwd_3d(
         _to3(qp), _to3(kp), _to3(vp), _to3(gp), lse3, dr3,
         causal=causal, scale=sc, block_q=block_q, block_k=block_k,
-        interpret=interpret, hq=h, hkv=hk, segs=segs)
+        interpret=interpret, hq=h, hkv=hk, segs=segs, window=window)
     if hk < h:
         # transpose of the index-map head sharing: sum each query-head group
         grp = h // hk

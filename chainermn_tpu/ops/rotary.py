@@ -1,0 +1,37 @@
+"""Rotary position embeddings (RoPE).
+
+Pure elementwise XLA op — fuses into the surrounding projections, no
+kernel needed. Split-half convention: the head dim is split into two
+halves rotated against each other (the convention used by most open
+models; equivalent to interleaved up to a fixed permutation of the head
+dim, which the attention dot products cancel).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions, dim: int, theta: float = 10000.0):
+    """positions [...]: int/float → (cos, sin) of shape [..., dim // 2]."""
+    half = dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """x: [B, L, H, D] (D even); positions: [L] or [B, L] global indices.
+
+    Returns x with each head's (first-half, second-half) pairs rotated by
+    the position angle — computed in f32, cast back to x.dtype.
+    """
+    d = x.shape[-1]
+    cos, sin = rope_angles(jnp.asarray(positions), d, theta)
+    while cos.ndim < x.ndim - 1:  # broadcast over batch and/or heads
+        cos, sin = cos[None], sin[None]
+    cos = jnp.expand_dims(cos, -2)  # head axis
+    sin = jnp.expand_dims(sin, -2)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)

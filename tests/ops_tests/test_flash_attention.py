@@ -340,3 +340,49 @@ def test_padding_composes_with_user_segments():
     assert out.shape == q.shape
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("window", [32, 100])
+def test_sliding_window(window):
+    """Sliding-window attention vs a banded-mask oracle, fwd + grads; the
+    band spans several tiles so the tile-skip predicate is exercised on
+    both backward grids."""
+    rng = np.random.RandomState(13)
+    b, l, h, d = 1, 256, 2, 16
+    q = rng.randn(b, l, h, d).astype(np.float32)
+    k = rng.randn(b, l, h, d).astype(np.float32)
+    v = rng.randn(b, l, h, d).astype(np.float32)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, True, None, 64, 64, True, None,
+                              window)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    def ref_banded(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * d ** -0.5
+        i = jnp.arange(l)[:, None]
+        j = jnp.arange(l)[None, :]
+        keep = (j <= i) & (i - j < window)
+        s = jnp.where(keep[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    (lf, of), g = jax.value_and_grad(loss_flash, argnums=(0, 1, 2),
+                                     has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    (lr, orf), gr = jax.value_and_grad(ref_banded, argnums=(0, 1, 2),
+                                       has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orf),
+                               rtol=2e-4, atol=2e-5)
+    for a, r in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_window_requires_causal():
+    q, k, v = _qkv(b=1, l=64, h=1, d=16)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        False, None, 64, 64, True, None, 32)
