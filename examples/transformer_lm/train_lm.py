@@ -61,6 +61,7 @@ def main():
     p.add_argument("--seq-len", type=int, default=128)
     p.add_argument("--vocab", type=int, default=64)
     p.add_argument("--d-model", type=int, default=128)
+    p.add_argument("--n-heads", type=int, default=4)
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--communicator", type=str, default="xla")
     p.add_argument("--lr", type=float, default=3e-3)
@@ -68,8 +69,18 @@ def main():
     p.add_argument("--moe", type=int, default=0, metavar="N",
                    help="experts per device (0 = dense FFN)")
     p.add_argument("--ring", action="store_true",
-                   help="sequence-parallel ring attention demo after "
-                        "training")
+                   help="sequence-parallel attention demo after "
+                        "training (implementation: --seq-impl)")
+    p.add_argument("--seq-impl", choices=["ring", "ring_flash",
+                                          "ulysses"], default="ring",
+                   help="sequence-parallel attention used by --ring")
+    p.add_argument("--n-kv-heads", type=int, default=0, metavar="K",
+                   help="KV heads < query heads = GQA/MQA (0 = all)")
+    p.add_argument("--window", type=int, default=0, metavar="W",
+                   help="sliding-window attention span (0 = full)")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings instead of a "
+                        "learned table")
     p.add_argument("--out", "-o", default="result_lm")
     args = p.parse_args()
 
@@ -82,6 +93,13 @@ def main():
 
     attention = ("flash" if jax.default_backend() == "tpu"
                  else "reference")
+    if args.window or (args.n_kv_heads and attention == "reference"):
+        attention = "flash"  # interpreted off-TPU; required for window
+    lm_kw = dict(
+        n_kv_heads=args.n_kv_heads or None,
+        attention_window=args.window or None,
+        pos_emb="rope" if args.rope else "learned",
+    )
     sample = np.zeros((1, args.seq_len), np.int32)
     if args.moe > 0:
         from chainermn_tpu.training.step import (
@@ -90,9 +108,9 @@ def main():
         )
 
         model = TransformerLM(
-            vocab=args.vocab, d_model=args.d_model, n_heads=4,
+            vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, d_ff=4 * args.d_model,
-            max_len=args.seq_len, attention=attention,
+            max_len=args.seq_len, attention=attention, **lm_kw,
             moe_experts_per_device=args.moe,
             expert_axis=comm.axis_names[0], capacity_factor=2.0)
         optimizer = optax.adam(args.lr)  # plain: expert grads stay local
@@ -102,9 +120,9 @@ def main():
             model, optimizer, comm, param_specs, loss_fn=lm_loss_with_aux)
     else:
         model = TransformerLM(
-            vocab=args.vocab, d_model=args.d_model, n_heads=4,
+            vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, d_ff=4 * args.d_model,
-            max_len=args.seq_len, attention=attention)
+            max_len=args.seq_len, attention=attention, **lm_kw)
         params = model.init(jax.random.PRNGKey(0), sample)["params"]
         params = comm.bcast_data(params)
         optimizer = chainermn_tpu.create_multi_node_optimizer(
@@ -131,10 +149,16 @@ def main():
         print(f"final: loss={final.get('main/loss'):.4f} "
               f"acc={final.get('main/accuracy'):.4f}")
 
-    if args.ring and args.moe > 0:
+    if args.ring and (args.moe > 0 or args.n_kv_heads):
         if comm.is_master:
-            print("--ring demo skipped: it reuses the trained dense "
-                  "params, which a MoE run does not produce")
+            print("--ring demo skipped: it reuses the trained params, and "
+                  "a MoE/GQA run produces a different param structure than "
+                  "the sequence-parallel model expects")
+    elif args.ring and args.seq_impl == "ulysses" and (
+            args.n_heads % comm.size):
+        if comm.is_master:
+            print(f"--ring demo skipped: ulysses needs --n-heads "
+                  f"divisible by the {comm.size}-device axis")
     elif args.ring:
         # sequence-parallel inference: shard the sequence over the mesh,
         # positions stay global via pos_offset
@@ -143,9 +167,10 @@ def main():
 
         ax = comm.axis_names[0]
         ring = TransformerLM(
-            vocab=args.vocab, d_model=args.d_model, n_heads=4,
+            vocab=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
             n_layers=args.n_layers, d_ff=4 * args.d_model,
-            max_len=args.seq_len, attention="ring", seq_axis=ax)
+            max_len=args.seq_len, attention=args.seq_impl, seq_axis=ax,
+            pos_emb="rope" if args.rope else "learned")
         l_local = args.seq_len // comm.size
         toks = np.asarray(train[0][0])[None]
 
@@ -161,8 +186,8 @@ def main():
         pred = np.asarray(logits).argmax(-1)
         acc = float((pred[0] == np.asarray(train[0][1])).mean())
         if comm.is_master:
-            print(f"ring-attention (seq sharded over {comm.size} devices) "
-                  f"next-token acc: {acc:.4f}")
+            print(f"{args.seq_impl}-attention (seq sharded over "
+                  f"{comm.size} devices) next-token acc: {acc:.4f}")
     return trainer
 
 
