@@ -37,7 +37,8 @@ from chainermn_tpu.parallel.ring_attention import (
 from chainermn_tpu.parallel.ulysses import ulysses_attention
 from chainermn_tpu.ops.rotary import apply_rope
 
-__all__ = ["TransformerLM", "TransformerBlock", "lm_loss_with_aux"]
+__all__ = ["TransformerLM", "TransformerBlock", "generate",
+           "lm_loss_with_aux"]
 
 
 class TransformerBlock(nn.Module):
@@ -57,6 +58,8 @@ class TransformerBlock(nn.Module):
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
+    decode: bool = False               # single-token KV-cache decoding
+    max_len: int = 2048                # cache capacity when decode=True
 
     @nn.compact
     def __call__(self, x, pos_offset=0):
@@ -82,6 +85,50 @@ class TransformerBlock(nn.Module):
         q = q.reshape(b, l, self.n_heads, dh)
         k = k.reshape(b, l, hkv, dh)
         v = v.reshape(b, l, hkv, dh)
+        if self.decode:
+            # KV-cache step: x is ONE new token; its position is the cache
+            # fill level. Attention is a [1, cached] product — memory-bound,
+            # no flash kernel needed.
+            if l != 1:
+                raise ValueError("decode=True processes one token at a time")
+            if self.moe_experts_per_device > 0:
+                raise ValueError("decode does not support the MoE FFN")
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (b, self.max_len, hkv, dh), self.dtype)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (b, self.max_len, hkv, dh), self.dtype)
+            idx = self.variable("cache", "idx",
+                                lambda: jnp.zeros((), jnp.int32))
+            pos = idx.value
+            if self.pos_emb == "rope":
+                q = apply_rope(q, pos[None], self.rope_theta)
+                k = apply_rope(k, pos[None], self.rope_theta)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(self.dtype), (0, pos, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(self.dtype), (0, pos, 0, 0))
+            idx.value = pos + 1
+            kc = ck.value.astype(jnp.float32)
+            vc = cv.value.astype(jnp.float32)
+            if hkv != self.n_heads:
+                kc = jnp.repeat(kc, self.n_heads // hkv, axis=2)
+                vc = jnp.repeat(vc, self.n_heads // hkv, axis=2)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                           kc) * dh ** -0.5
+            keys = jnp.arange(self.max_len)
+            visible = keys <= pos
+            if self.attention_window is not None:
+                visible &= keys > pos - self.attention_window
+            s = jnp.where(visible[None, None, None], s, -jnp.inf)
+            att = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), vc)
+            att = att.reshape(b, 1, self.d_model).astype(self.dtype)
+            x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                             name="attn_out")(att)
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            y = nn.Dense(self.d_ff, dtype=self.dtype, name="ffn_in")(h)
+            y = nn.gelu(y)
+            return x + nn.Dense(self.d_model, dtype=self.dtype,
+                                name="ffn_out")(y)
         if self.pos_emb == "rope":
             pos = pos_offset + jnp.arange(l)
             q = apply_rope(q, pos, self.rope_theta)
@@ -154,6 +201,7 @@ class TransformerLM(nn.Module):
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
+    decode: bool = False               # single-token KV-cache decoding
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
@@ -179,11 +227,66 @@ class TransformerLM(nn.Module):
                 moe_experts_per_device=self.moe_experts_per_device,
                 expert_axis=self.expert_axis,
                 capacity_factor=self.capacity_factor,
+                decode=self.decode, max_len=self.max_len,
                 name=f"block_{i}")(x, pos_offset=pos_offset)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+
+def generate(model, params, prompt, max_new_tokens: int,
+             rng=None, temperature: float = 1.0, top_k: Optional[int] = None):
+    """Autoregressive sampling with a per-layer KV cache.
+
+    model: the TRAINING TransformerLM (decode twin derived internally);
+    prompt: int32 [B, Lp]; returns int32 [B, Lp + max_new_tokens].
+    ``rng=None`` → greedy argmax; else categorical at ``temperature``
+    (optionally truncated to the ``top_k`` highest logits).
+
+    One compiled lax.scan step per position (prompt teacher-forced, then
+    sampled): decode is memory-bound, so the cache path uses plain XLA
+    attention over the cached keys rather than the flash kernel.
+    """
+    dm = model.clone(decode=True, moe_experts_per_device=0)
+    b, lp = prompt.shape
+    total = lp + max_new_tokens
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt + max_new_tokens ({total}) exceeds max_len "
+            f"({model.max_len})")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    # init RUNS a forward, leaving one garbage token in the cache (written
+    # with the throwaway init params) and idx=1 — zero everything
+    cache0 = jax.tree_util.tree_map(
+        jnp.zeros_like, dm.init(jax.random.PRNGKey(0),
+                                prompt[:, :1])["cache"])
+    padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+    greedy = rng is None
+    rng = jax.random.PRNGKey(0) if greedy else rng
+
+    def step(carry, t):
+        cache, tok, rng = carry
+        logits, upd = dm.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            pos_offset=t, mutable=["cache"])
+        logits = logits[:, 0]
+        if greedy:
+            sampled = jnp.argmax(logits, -1)
+        else:
+            scaled = logits / jnp.maximum(temperature, 1e-6)
+            if top_k is not None:
+                kth = jnp.sort(scaled, -1)[:, -top_k][:, None]
+                scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+            rng, sub = jax.random.split(rng)
+            sampled = jax.random.categorical(sub, scaled)
+        nxt = jnp.where(t + 1 < lp, jnp.take(padded, t + 1, axis=1),
+                        sampled.astype(jnp.int32))
+        return (upd["cache"], nxt, rng), nxt
+
+    (_, _, _), toks = jax.lax.scan(
+        step, (cache0, prompt[:, 0], rng), jnp.arange(total - 1))
+    return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
 
 
 def lm_loss_with_aux(model, params, x, y, train=True, mutable=None,
