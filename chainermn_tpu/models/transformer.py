@@ -121,22 +121,19 @@ class TransformerBlock(nn.Module):
                 visible &= keys > pos - self.attention_window
             s = jnp.where(visible[None, None, None], s, -jnp.inf)
             att = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), vc)
-            att = att.reshape(b, 1, self.d_model).astype(self.dtype)
-            x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
-                             name="attn_out")(att)
-            h = nn.LayerNorm(dtype=self.dtype)(x)
-            y = nn.Dense(self.d_ff, dtype=self.dtype, name="ffn_in")(h)
-            y = nn.gelu(y)
-            return x + nn.Dense(self.d_model, dtype=self.dtype,
-                                name="ffn_out")(y)
-        if self.pos_emb == "rope":
+            # falls through to the SHARED projection/FFN tail below — the
+            # decode path must never duplicate training-path math
+        elif self.pos_emb == "rope":
             pos = pos_offset + jnp.arange(l)
             q = apply_rope(q, pos, self.rope_theta)
             k = apply_rope(k, pos, self.rope_theta)
-        if self.attention_window is not None and self.attention != "flash":
+        if self.decode:
+            pass  # att computed above from the KV cache
+        elif (self.attention_window is not None
+              and self.attention != "flash"):
             raise ValueError(
                 "attention_window is supported on the 'flash' path")
-        if self.attention in ("ring", "ring_flash", "ulysses"):
+        elif self.attention in ("ring", "ring_flash", "ulysses"):
             if self.seq_axis is None:
                 raise ValueError(
                     f"attention={self.attention!r} requires seq_axis")
@@ -248,7 +245,10 @@ def generate(model, params, prompt, max_new_tokens: int,
     sampled): decode is memory-bound, so the cache path uses plain XLA
     attention over the cached keys rather than the flash kernel.
     """
-    dm = model.clone(decode=True, moe_experts_per_device=0)
+    if model.moe_experts_per_device > 0:
+        raise ValueError("generate() does not support MoE models: the "
+                         "decode path has no expert dispatch")
+    dm = model.clone(decode=True)
     b, lp = prompt.shape
     total = lp + max_new_tokens
     if total > model.max_len:
@@ -256,11 +256,13 @@ def generate(model, params, prompt, max_new_tokens: int,
             f"prompt + max_new_tokens ({total}) exceeds max_len "
             f"({model.max_len})")
     prompt = jnp.asarray(prompt, jnp.int32)
-    # init RUNS a forward, leaving one garbage token in the cache (written
-    # with the throwaway init params) and idx=1 — zero everything
+    # abstract init: cache shapes without materializing throwaway params
+    # (init also RUNS a forward, which would leave one garbage token in a
+    # concrete cache)
+    cache_shapes = jax.eval_shape(
+        lambda t: dm.init(jax.random.PRNGKey(0), t), prompt[:, :1])["cache"]
     cache0 = jax.tree_util.tree_map(
-        jnp.zeros_like, dm.init(jax.random.PRNGKey(0),
-                                prompt[:, :1])["cache"])
+        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
     padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
     greedy = rng is None
     rng = jax.random.PRNGKey(0) if greedy else rng
@@ -276,7 +278,7 @@ def generate(model, params, prompt, max_new_tokens: int,
         else:
             scaled = logits / jnp.maximum(temperature, 1e-6)
             if top_k is not None:
-                kth = jnp.sort(scaled, -1)[:, -top_k][:, None]
+                kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
                 scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
             rng, sub = jax.random.split(rng)
             sampled = jax.random.categorical(sub, scaled)

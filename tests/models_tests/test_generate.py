@@ -71,3 +71,9 @@ def test_capacity_check():
                         jnp.asarray(prompt))["params"]
     with pytest.raises(ValueError, match="max_len"):
         generate(model, params, prompt, 5)
+
+
+def test_moe_generate_raises_clearly():
+    model = _model(moe_experts_per_device=1)
+    with pytest.raises(ValueError, match="MoE"):
+        generate(model, {}, np.zeros((1, 4), np.int32), 2)
