@@ -14,6 +14,7 @@ from .tensor_parallel import (
     ColumnParallelDense,
     RowParallelDense,
     TensorParallelMLP,
+    vocab_parallel_cross_entropy,
 )
 
 __all__ = [
@@ -27,6 +28,7 @@ __all__ = [
     "ColumnParallelDense",
     "RowParallelDense",
     "TensorParallelMLP",
+    "vocab_parallel_cross_entropy",
     "ExpertParallelMLP",
     "switch_dispatch",
 ]

@@ -17,6 +17,8 @@ building blocks; under plain ``pjit`` the same layouts fall out of weight
 
 from __future__ import annotations
 
+import functools
+
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -87,3 +89,49 @@ class TensorParallelMLP(nn.Module):
         h = self.act(h)
         return RowParallelDense(self.out, self.axis_name,
                                 dtype=self.dtype)(h)
+
+
+def vocab_parallel_cross_entropy(logits, targets, axis_name: str):
+    """Cross-entropy over VOCAB-SHARDED logits — the loss-parallel epilogue
+    of a column-parallel LM head.
+
+    The full [B, L, V] logits never exist on any device: each shard holds a
+    contiguous vocab slice ``[i*Vl, (i+1)*Vl)`` (the layout
+    `ColumnParallelDense` produces) and the softmax normalizer, max shift,
+    and target logit are assembled with one pmax and two psums of [B, L]
+    arrays — communication is O(B·L), not O(B·L·V).
+
+    logits: [..., V_local] (sharded on ``axis_name``); targets: [...] int
+    GLOBAL vocab ids (replicated). Returns per-token loss [...], replicated.
+    """
+    vl = logits.shape[-1]
+    lo = lax.axis_index(axis_name) * vl
+    logits = logits.astype(jnp.float32)
+    # the max shift is gradient-neutral (it cancels in softmax); pmax has
+    # no differentiation rule, so route it through a zero-cotangent VJP
+    m = _pmax_stop_gradient(jnp.max(logits, -1), axis_name)
+    z = lax.psum(jnp.sum(jnp.exp(logits - m[..., None]), -1), axis_name)
+    local_t = targets - lo
+    in_shard = (local_t >= 0) & (local_t < vl)
+    safe_t = jnp.clip(local_t, 0, vl - 1)
+    tlogit = jnp.take_along_axis(logits, safe_t[..., None], -1)[..., 0]
+    tlogit = lax.psum(jnp.where(in_shard, tlogit, 0.0), axis_name)
+    return m + jnp.log(z) - tlogit
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _pmax_stop_gradient(x, axis_name):
+    """lax.pmax treated as a constant by differentiation (no pmax VJP
+    exists in JAX; the logsumexp max shift needs none)."""
+    return lax.pmax(x, axis_name)
+
+
+def _pmax_sg_fwd(x, axis_name):
+    return lax.pmax(x, axis_name), None
+
+
+def _pmax_sg_bwd(axis_name, _, g):
+    return (jnp.zeros_like(g),)
+
+
+_pmax_stop_gradient.defvjp(_pmax_sg_fwd, _pmax_sg_bwd)
