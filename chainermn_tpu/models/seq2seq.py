@@ -116,6 +116,64 @@ def greedy_translate(model, variables, src, src_len, max_len: int = 64):
     return jnp.transpose(toks)  # [B, max_len]
 
 
+def beam_translate(model, variables, src, src_len, beam: int = 4,
+                   max_len: int = 64, length_penalty: float = 0.6):
+    """Beam-search decode (beyond the reference's greedy translate loop).
+
+    K beams ride a folded [B*K] batch through ``decode_step``; each step
+    expands to [B, K, V] continuations, keeps the global top-K by
+    accumulated log-prob, and gathers LSTM carries by source beam.
+    Finished beams (emitted EOS) may only extend with PAD at zero cost, so
+    their scores freeze. Returns the best beam per batch row, [B, max_len]
+    int32, chosen by GNMT length-normalized score
+    ``score / ((5 + len) / 6) ** length_penalty``.
+    """
+    b = src.shape[0]
+    k = beam
+    carries = model.apply(variables, src, src_len, method=Seq2Seq.encode)
+    carries = jax.tree_util.tree_map(
+        lambda a: jnp.repeat(a, k, axis=0), carries)        # [B*K, ...]
+
+    neg = -1e9
+    # only beam 0 live at t=0, else the K beams duplicate
+    scores0 = jnp.tile(jnp.array([0.0] + [neg] * (k - 1), jnp.float32),
+                       (b, 1))
+    out0 = jnp.full((b * k, max_len), PAD, jnp.int32)
+
+    def step(carry, t):
+        carries, tokens, scores, done, out = carry
+        carries, logits = model.apply(
+            variables, carries, tokens, method=Seq2Seq.decode_step)
+        v = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        logp = logp.reshape(b, k, v)
+        done_bk = done.reshape(b, k)
+        # finished beams: every continuation except free PAD is -inf
+        pad_only = jnp.full((v,), neg, jnp.float32).at[PAD].set(0.0)
+        logp = jnp.where(done_bk[..., None], pad_only[None, None], logp)
+        new_scores, idx = jax.lax.top_k(
+            (scores[..., None] + logp).reshape(b, k * v), k)  # [B, K]
+        src_beam = idx // v
+        token = (idx % v).astype(jnp.int32).reshape(-1)       # [B*K]
+        gidx = (jnp.arange(b)[:, None] * k + src_beam).reshape(-1)
+        carries = jax.tree_util.tree_map(lambda a: a[gidx], carries)
+        out = out[gidx].at[:, t].set(token)
+        done = done.reshape(-1)[gidx] | (token == EOS)
+        return (carries, token, new_scores, done, out), None
+
+    init = (carries, jnp.full((b * k,), BOS, jnp.int32), scores0,
+            jnp.zeros((b * k,), bool), out0)
+    (_, _, scores, _, out), _ = jax.lax.scan(
+        step, init, jnp.arange(max_len))
+
+    out = out.reshape(b, k, max_len)
+    lengths = jnp.sum(out != PAD, axis=-1).astype(jnp.float32)  # [B, K]
+    norm = ((5.0 + lengths) / 6.0) ** length_penalty
+    best = jnp.argmax(scores / norm, axis=-1)                   # [B]
+    return jnp.take_along_axis(
+        out, best[:, None, None], axis=1)[:, 0]
+
+
 def seq2seq_loss(logits, tgt_out, pad=PAD):
     """Token-level masked cross entropy (mean over non-pad tokens)."""
     import optax
