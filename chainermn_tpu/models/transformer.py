@@ -199,6 +199,9 @@ class TransformerLM(nn.Module):
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
     decode: bool = False               # single-token KV-cache decoding
+    remat: bool = False                # rematerialize each block's
+    #                                    activations in backward (trade
+    #                                    FLOPs for HBM at long L)
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
@@ -213,8 +216,10 @@ class TransformerLM(nn.Module):
             x = emb + jnp.take(pos, idx, axis=0).astype(self.dtype)[None]
         else:  # 'rope': positions enter inside each block's attention
             x = emb
+        block_cls = (nn.remat(TransformerBlock)
+                     if self.remat and not self.decode else TransformerBlock)
         for i in range(self.n_layers):
-            x = TransformerBlock(
+            x = block_cls(
                 d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
                 n_kv_heads=self.n_kv_heads,
                 dtype=self.dtype, attention=self.attention,

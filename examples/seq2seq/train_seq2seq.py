@@ -44,6 +44,9 @@ def main():
     p.add_argument("--communicator", type=str, default="xla")
     p.add_argument("--vocab", type=int, default=1000)
     p.add_argument("--n-train", type=int, default=1024)
+    p.add_argument("--beam", type=int, default=0, metavar="K",
+                   help="post-training translate demo: beam width "
+                        "(0 = greedy)")
     p.add_argument("--bucket", type=int, default=32,
                    help="pad lengths to multiples of this")
     args = p.parse_args()
@@ -115,6 +118,26 @@ def main():
                   f"({time.time() - t0:.1f}s)", flush=True)
     if comm.is_master:
         print(f"final loss: {float(metrics['main/loss']):.4f}")
+
+    # translate a few training pairs back (reference: the seq2seq example's
+    # post-epoch translate check); --beam K switches greedy → beam search
+    from chainermn_tpu.models.seq2seq import beam_translate, greedy_translate
+
+    params = state[0]
+    srcs, src_len, _, tgt_out = pad_batch(train[:4], args.bucket)
+    if args.beam > 0:
+        hyp = beam_translate(model, {"params": params}, srcs, src_len,
+                             beam=args.beam, max_len=args.bucket)
+    else:
+        hyp = greedy_translate(model, {"params": params}, srcs, src_len,
+                               max_len=args.bucket)
+    hyp = np.asarray(hyp)
+    if comm.is_master:
+        match = float((hyp[:, :tgt_out.shape[1]] == tgt_out).mean())
+        mode = f"beam={args.beam}" if args.beam else "greedy"
+        print(f"translate demo ({mode}): token match {match:.3f}")
+        for i in range(2):
+            print(f"  src {srcs[i][:8]}... -> hyp {hyp[i][:8]}...")
     return float(metrics["main/loss"])
 
 

@@ -175,3 +175,28 @@ def test_gqa_lm_trains():
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_remat_same_values_and_grads():
+    """remat=True must change memory, not math: identical loss and grads."""
+    tok = np.random.RandomState(0).randint(0, 17, (2, 32)).astype(np.int32)
+
+    def run(remat):
+        model = _tiny(attention="reference", remat=remat)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.asarray(tok[:, :-1]))["params"]
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tok[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tok[:, 1:]).mean()
+
+        return jax.jit(jax.value_and_grad(loss_fn))(params)
+
+    l0, g0 = run(False)
+    l1, g1 = run(True)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
