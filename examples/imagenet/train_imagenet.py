@@ -54,6 +54,12 @@ def main():
                    help="stop after N iterations instead of epochs")
     p.add_argument("--communicator", type=str, default="pure_nccl")
     p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--optimizer", choices=["sgd", "lars", "lamb"],
+                   default="sgd",
+                   help="lars/lamb: large-batch recipes (batch-32K "
+                        "ResNet needs layerwise trust ratios)")
+    p.add_argument("--warmup-epochs", type=float, default=0.0,
+                   help="linear LR warmup epochs (then cosine decay)")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--n-train", type=int, default=2048)
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
@@ -81,9 +87,21 @@ def main():
     params = comm.bcast_data(variables["params"])
     batch_stats = comm.bcast_data(variables["batch_stats"])
 
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(args.lr, momentum=0.9, nesterov=True), comm
-    )
+    steps_per_epoch = max(1, len(train) * comm.size // global_batch)
+    if args.warmup_epochs > 0:
+        total = steps_per_epoch * args.epoch
+        lr = optax.warmup_cosine_decay_schedule(
+            0.0, args.lr, int(steps_per_epoch * args.warmup_epochs),
+            max(total, 1))
+    else:
+        lr = args.lr
+    base_opt = {
+        "sgd": lambda: optax.sgd(lr, momentum=0.9, nesterov=True),
+        # layerwise trust ratios — the large-batch ImageNet recipes
+        "lars": lambda: optax.lars(lr, weight_decay=1e-4, momentum=0.9),
+        "lamb": lambda: optax.lamb(lr, weight_decay=1e-4),
+    }[args.optimizer]()
+    optimizer = chainermn_tpu.create_multi_node_optimizer(base_opt, comm)
     state = (params, optimizer.init(params), {"batch_stats": batch_stats})
 
     step = make_data_parallel_train_step(
