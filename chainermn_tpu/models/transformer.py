@@ -58,6 +58,7 @@ class TransformerBlock(nn.Module):
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
+    moe_top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
     decode: bool = False               # single-token KV-cache decoding
     max_len: int = 2048                # cache capacity when decode=True
 
@@ -160,6 +161,7 @@ class TransformerBlock(nn.Module):
                 experts_per_device=self.moe_experts_per_device,
                 axis_name=self.expert_axis,
                 capacity_factor=self.capacity_factor,
+                top_k=self.moe_top_k,
                 dtype=self.dtype, name="moe",
             )(h.reshape(b * l, d))
             # surfaced through the 'losses' collection; see lm_loss_with_aux
@@ -198,6 +200,7 @@ class TransformerLM(nn.Module):
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
+    moe_top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
     decode: bool = False               # single-token KV-cache decoding
     remat: bool = False                # rematerialize each block's
     #                                    activations in backward (trade
@@ -229,6 +232,7 @@ class TransformerLM(nn.Module):
                 moe_experts_per_device=self.moe_experts_per_device,
                 expert_axis=self.expert_axis,
                 capacity_factor=self.capacity_factor,
+                moe_top_k=self.moe_top_k,
                 decode=self.decode, max_len=self.max_len,
                 name=f"block_{i}")(x, pos_offset=pos_offset)
         x = nn.LayerNorm(dtype=self.dtype)(x)

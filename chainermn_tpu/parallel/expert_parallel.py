@@ -29,6 +29,7 @@ from jax import lax
 
 __all__ = [
     "switch_dispatch",
+    "topk_dispatch",
     "ExpertParallelMLP",
 ]
 
@@ -71,6 +72,50 @@ def switch_dispatch(router_probs, capacity: int):
     return dispatch, combine, aux_loss
 
 
+def topk_dispatch(router_probs, capacity: int, k: int = 2):
+    """GShard-style top-k dispatch/combine with static capacity.
+
+    ``k=1`` delegates to `switch_dispatch` (raw-gate scaling, the Switch
+    convention). For ``k>=2``, each token is routed to its k highest
+    experts; rank-0 bookings fill expert queues before rank-1 considers
+    them (GShard priority), and combine weights are the selected probs
+    normalized over the kept ranks. aux is the Switch load-balancing loss
+    on rank-0 assignments.
+    """
+    if k == 1:
+        return switch_dispatch(router_probs, capacity)
+    t, e = router_probs.shape
+    probs_left = router_probs
+    masks, gates = [], []
+    for _ in range(k):
+        idx = jnp.argmax(probs_left, -1)
+        m = jax.nn.one_hot(idx, e, dtype=jnp.int32)
+        gates.append(jnp.take_along_axis(
+            router_probs, idx[:, None], -1)[:, 0])
+        masks.append(m)
+        probs_left = probs_left * (1 - m.astype(probs_left.dtype))
+
+    dtype = router_probs.dtype
+    dispatch = jnp.zeros((t, e, capacity), dtype)
+    combine = jnp.zeros_like(dispatch)
+    denom = sum(gates) + 1e-9
+    offset = jnp.zeros((e,), jnp.int32)   # queue fill from earlier ranks
+    for r in range(k):
+        m = masks[r]
+        pos = (jnp.cumsum(m, axis=0) + offset[None, :]) * m   # 1-based
+        offset = offset + jnp.sum(m, axis=0)
+        keep = ((pos > 0) & (pos <= capacity)).astype(dtype)
+        slot = jax.nn.one_hot(jnp.sum(pos, -1) - 1, capacity, dtype=dtype)
+        d_r = (m.astype(dtype) * keep)[:, :, None] * slot[:, None, :]
+        dispatch = dispatch + d_r
+        combine = combine + d_r * (gates[r] / denom)[:, None, None]
+
+    fraction_routed = jnp.mean(masks[0].astype(dtype), axis=0)
+    mean_prob = jnp.mean(router_probs, axis=0)
+    aux = e * jnp.sum(fraction_routed * mean_prob)
+    return dispatch, combine, aux
+
+
 class ExpertParallelMLP(nn.Module):
     """Mixture-of-experts FFN with experts sharded over ``axis_name``.
 
@@ -94,6 +139,7 @@ class ExpertParallelMLP(nn.Module):
     experts_per_device: int = 1
     axis_name: str = "expert"
     capacity_factor: float = 1.25
+    top_k: int = 1                     # 1 = Switch; 2 = GShard top-2
     act: Callable = nn.gelu
     dtype: Any = None
 
@@ -102,13 +148,15 @@ class ExpertParallelMLP(nn.Module):
         n_dev = lax.axis_size(self.axis_name)
         e_tot = n_dev * self.experts_per_device
         t, d = x.shape
-        capacity = max(1, int(t * self.capacity_factor / e_tot))
+        capacity = max(1, int(
+            t * self.capacity_factor * self.top_k / e_tot))
 
         # Router is logically replicated (same weights every shard).
         logits = nn.Dense(e_tot, use_bias=False, name="router",
                           dtype=self.dtype)(x)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        dispatch, combine, aux = switch_dispatch(probs, capacity)
+        dispatch, combine, aux = topk_dispatch(probs, capacity,
+                                               self.top_k)
         dispatch = dispatch.astype(x.dtype)
         combine = combine.astype(x.dtype)
 

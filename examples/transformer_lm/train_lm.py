@@ -68,6 +68,8 @@ def main():
     p.add_argument("--n-train", type=int, default=2048)
     p.add_argument("--moe", type=int, default=0, metavar="N",
                    help="experts per device (0 = dense FFN)")
+    p.add_argument("--moe-top-k", type=int, default=1,
+                   help="experts per token (1 = Switch, 2 = GShard)")
     p.add_argument("--ring", action="store_true",
                    help="sequence-parallel attention demo after "
                         "training (implementation: --seq-impl)")
@@ -112,7 +114,8 @@ def main():
             n_layers=args.n_layers, d_ff=4 * args.d_model,
             max_len=args.seq_len, attention=attention, **lm_kw,
             moe_experts_per_device=args.moe,
-            expert_axis=comm.axis_names[0], capacity_factor=2.0)
+            expert_axis=comm.axis_names[0], capacity_factor=2.0,
+            moe_top_k=args.moe_top_k)
         optimizer = optax.adam(args.lr)  # plain: expert grads stay local
         state, param_specs = init_expert_parallel_state(
             model, comm, jax.random.PRNGKey(0), sample, optimizer)

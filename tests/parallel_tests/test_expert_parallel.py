@@ -159,3 +159,71 @@ def test_switch_dispatch_positions_and_mass():
     # no slot is double-booked
     assert (d.sum(0) <= 1.0 + 1e-6).all()
     assert float(aux) > 0
+
+
+def test_topk_dispatch_matches_dense_mixture():
+    """top-2 with ample capacity == dense weighted mixture of each token's
+    two best experts (normalized gates)."""
+    from chainermn_tpu.parallel.expert_parallel import topk_dispatch
+
+    rng = np.random.RandomState(0)
+    t, e, c = 16, 4, 16  # capacity ample: nothing dropped
+    probs = jax.nn.softmax(jnp.asarray(rng.randn(t, e).astype(np.float32)))
+    dispatch, combine, aux = topk_dispatch(probs, c, k=2)
+
+    # each token booked exactly twice, one slot each
+    np.testing.assert_array_equal(np.asarray(dispatch.sum((1, 2))), 2.0)
+    # no slot double-booked
+    assert float(jnp.max(dispatch.sum(0))) <= 1.0 + 1e-6
+    # combine weights per token = normalized top-2 probs (sum to 1)
+    np.testing.assert_allclose(np.asarray(combine.sum((1, 2))), 1.0,
+                               rtol=1e-5)
+    # expert outputs: y = sum_slots combine * expert_value
+    vals = rng.randn(e, 1).astype(np.float32)  # scalar "FFN" per expert
+    y = np.einsum("tec,ed->td", np.asarray(combine),
+                  vals)[:, 0]
+    p = np.asarray(probs)
+    top2 = np.argsort(-p, axis=1)[:, :2]
+    g = np.take_along_axis(p, top2, 1)
+    g = g / g.sum(1, keepdims=True)
+    y_ref = (g * vals[top2, 0]).sum(1)
+    np.testing.assert_allclose(y, y_ref, rtol=1e-5)
+
+
+def test_topk_capacity_priority():
+    """rank-0 bookings fill queues before rank-1: with capacity 1 and all
+    tokens agreeing on the same best expert, only the first token's rank-0
+    choice lands there."""
+    from chainermn_tpu.parallel.expert_parallel import topk_dispatch
+
+    t, e = 4, 3
+    probs = jnp.tile(jnp.asarray([[0.6, 0.3, 0.1]]), (t, 1))
+    dispatch, _, _ = topk_dispatch(probs, capacity=1, k=2)
+    d = np.asarray(dispatch)
+    assert d[:, 0].sum() == 1.0          # expert 0: one booking (token 0)
+    assert d[0, 0].sum() == 1.0
+    assert d[:, 1].sum() == 1.0          # expert 1: rank-1 of token 0
+
+
+def test_expert_parallel_mlp_top2(comm):
+    """top_k=2 under shard_map: finite outputs/grads, aux near uniform."""
+    from chainermn_tpu.parallel import ExpertParallelMLP
+
+    n = comm.size
+    ax = comm.axis_names[0]
+    moe = ExpertParallelMLP(hidden=8, experts_per_device=1, axis_name=ax,
+                            capacity_factor=2.0, top_k=2)
+    xt = np.random.RandomState(0).randn(4 * n, 4).astype(np.float32)
+
+    def loss(xt):
+        def f(xs):
+            rng = jax.random.fold_in(jax.random.PRNGKey(0),
+                                     jax.lax.axis_index(ax))
+            vars_ = moe.init(rng, xs)
+            y, aux = moe.apply(vars_, xs)
+            return jax.lax.pmean(jnp.sum(y ** 2) + 0.01 * aux, ax)
+        return shard_map(f, mesh=comm.mesh, in_specs=(P(ax),),
+                         out_specs=P(), check_vma=False)(xt)
+
+    g = jax.jit(jax.grad(loss))(xt)
+    assert np.isfinite(np.asarray(g)).all()
