@@ -29,6 +29,7 @@ from chainermn_tpu.optimizers.zero import (  # noqa: F401
     fsdp_shardings,
     make_fsdp_train_step,
     make_zero1_train_step,
+    make_zero2_train_step,
     zero1_params,
 )
 

@@ -178,3 +178,45 @@ def test_zero1_padding_path(comm):
     got = zero1_params(state, params)
     assert jax.tree_util.tree_structure(got) == \
         jax.tree_util.tree_structure(params)
+
+
+def test_zero2_matches_zero1(comm):
+    """One ZeRO-2 step (2 microbatches) == one ZeRO-1 step on the same
+    global batch: grad-of-mean equals mean-of-microbatch-grads, so the
+    updated parameters must agree to fp tolerance; state stays sharded."""
+    import optax
+
+    from chainermn_tpu.models import MLP
+    from chainermn_tpu.optimizers.zero import (
+        make_zero1_train_step,
+        make_zero2_train_step,
+        zero1_params,
+    )
+
+    n = comm.size
+    model = MLP(n_units=16, n_out=4)
+    rng = np.random.RandomState(0)
+    x = rng.rand(4 * n, 28, 28).astype(np.float32)
+    y = rng.randint(0, 4, (4 * n,)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), x[:2])["params"]
+
+    s1, st1 = make_zero1_train_step(model, optax.adam(1e-2), comm, params)
+    s2, st2 = make_zero2_train_step(model, optax.adam(1e-2), comm, params,
+                                    n_microbatches=2)
+    dsh = NamedSharding(comm.mesh, P(comm.axis_names[0]))
+    xg, yg = jax.device_put(x, dsh), jax.device_put(y, dsh)
+
+    st1, m1 = s1(st1, xg, yg)
+    st2, m2 = s2(st2, xg, yg)
+    np.testing.assert_allclose(float(m1["main/loss"]),
+                               float(m2["main/loss"]), rtol=1e-5)
+    p1 = zero1_params(st1, params)
+    p2 = zero1_params(st2, params)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
+    # accumulator/optimizer memory is sharded: leading dim of the m/v
+    # leaves is padded_total/n per device
+    shard = st2[0]
+    assert shard.sharding.spec == P(comm.axis_names[0])
