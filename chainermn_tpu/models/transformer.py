@@ -52,6 +52,7 @@ class TransformerBlock(nn.Module):
     # 'flash' | 'ring' | 'ring_flash' | 'ulysses' | 'reference'
     attention: str = "flash"
     attention_window: Optional[int] = None  # sliding window (flash path)
+    attention_blocks: Optional[tuple] = None  # (block_q, block_k) tune
     pos_emb: str = "learned"           # 'learned' (handled by the LM) | 'rope'
     rope_theta: float = 10000.0
     seq_axis: Optional[str] = None     # mesh axis for 'ring'
@@ -143,8 +144,9 @@ class TransformerBlock(nn.Module):
                       "ulysses": ulysses_attention}[self.attention]
             att = seq_fn(q, k, v, axis_name=self.seq_axis, causal=True)
         elif self.attention == "flash":
-            att = flash_attention(q, k, v, causal=True,
-                                  window=self.attention_window)
+            bq, bk = self.attention_blocks or (256, 512)
+            att = flash_attention(q, k, v, causal=True, block_q=bq,
+                                  block_k=bk, window=self.attention_window)
         else:
             if hkv != self.n_heads:
                 k = jnp.repeat(k, self.n_heads // hkv, axis=2)
@@ -194,6 +196,7 @@ class TransformerLM(nn.Module):
     pos_emb: str = "learned"           # 'learned' | 'rope'
     rope_theta: float = 10000.0
     attention_window: Optional[int] = None
+    attention_blocks: Optional[tuple] = None
     dtype: Any = jnp.float32
     attention: str = "flash"
     seq_axis: Optional[str] = None
@@ -227,6 +230,7 @@ class TransformerLM(nn.Module):
                 n_kv_heads=self.n_kv_heads,
                 dtype=self.dtype, attention=self.attention,
                 attention_window=self.attention_window,
+                attention_blocks=self.attention_blocks,
                 pos_emb=self.pos_emb, rope_theta=self.rope_theta,
                 seq_axis=self.seq_axis,
                 moe_experts_per_device=self.moe_experts_per_device,

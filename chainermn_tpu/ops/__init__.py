@@ -1,0 +1,10 @@
+from chainermn_tpu.ops.autotune import tune_flash_blocks
+from chainermn_tpu.ops.flash_attention import flash_attention
+from chainermn_tpu.ops.rotary import apply_rope, rope_angles
+
+__all__ = [
+    "flash_attention",
+    "tune_flash_blocks",
+    "apply_rope",
+    "rope_angles",
+]

@@ -1,0 +1,86 @@
+"""On-device block-size autotuning for the flash attention kernels.
+
+The tuned defaults in `ops/flash_attention.py` (256, 512) were measured
+on v5e at d=128; other head dims, sequence lengths, or TPU generations
+can prefer different tiles (BASELINE.md's sweep saw 2x spread). This
+sweeps candidate (block_q, block_k) pairs with the REAL kernels on the
+current default device and returns the fastest — profile-and-iterate as
+a one-call utility.
+
+Results are memoized per (shape, dtype, causal, window) key for the
+process lifetime; tuning cost is a few hundred ms per new shape on TPU.
+Off-TPU (interpreter) the defaults are returned untimed — interpreter
+timings would be meaningless.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_CACHE: dict = {}
+
+_CANDIDATES = ((128, 128), (128, 256), (256, 256), (256, 512),
+               (512, 512), (512, 1024))
+
+
+def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
+                      kv_heads: Optional[int] = None,
+                      dtype=jnp.bfloat16, causal: bool = True,
+                      window: Optional[int] = None,
+                      include_backward: bool = True,
+                      candidates=_CANDIDATES,
+                      iters: int = 3) -> Tuple[int, int]:
+    """Return the fastest (block_q, block_k) for this attention shape.
+
+    Times `flash_attention` (forward, or full value-and-grad when
+    ``include_backward``) for each candidate on the default backend and
+    memoizes. Use the result as the ``block_q``/``block_k`` arguments or
+    `TransformerBlock`'s ``attention_blocks``.
+    """
+    from chainermn_tpu.ops.flash_attention import flash_attention
+
+    key = (batch, seq_len, heads, head_dim, kv_heads, str(dtype), causal,
+           window, include_backward)
+    if key in _CACHE:
+        return _CACHE[key]
+    if jax.default_backend() != "tpu":
+        _CACHE[key] = (256, 512)  # defaults; interpreter timing is noise
+        return _CACHE[key]
+
+    hkv = kv_heads or heads
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (batch, seq_len, heads, head_dim), dtype)
+    k = jax.random.normal(ks[1], (batch, seq_len, hkv, head_dim), dtype)
+    v = jax.random.normal(ks[2], (batch, seq_len, hkv, head_dim), dtype)
+
+    best, best_dt = (256, 512), float("inf")
+    for bq, bk in candidates:
+        def loss(q, k, v, bq=bq, bk=bk):
+            out = flash_attention(q, k, v, causal, None, bq, bk, None,
+                                  None, window)
+            return jnp.sum(out.astype(jnp.float32)) * 1e-3
+
+        fn = (jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+              if include_backward else jax.jit(loss))
+        try:
+            out = fn(q, k, v)
+            # sync via value fetch: block_until_ready can return early on
+            # tunneled platform plugins (see bench.py)
+            leaf = out[0] if isinstance(out, tuple) else out
+            float(jnp.sum(leaf.astype(jnp.float32) * 0) + 1)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(q, k, v)
+            leaf = out[0] if isinstance(out, tuple) else out
+            float(jnp.sum(leaf.astype(jnp.float32) * 0) + 1)
+            dt = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue  # candidate illegal for this shape (VMEM, layout)
+        if dt < best_dt:
+            best, best_dt = (bq, bk), dt
+    _CACHE[key] = best
+    return best
