@@ -24,6 +24,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from chainermn_tpu.comm.base import CommunicatorBase
 
@@ -189,6 +190,28 @@ class MultiNodeCheckpointer:
             except OSError:
                 pass
 
+    # -- trainer integration --------------------------------------------
+
+    def __call__(self, trainer):
+        """Trainer-extension protocol (reference idiom:
+        ``trainer.extend(checkpointer)``): snapshot the updater's state at
+        each trigger point."""
+        self.save(trainer.updater.state, trainer.updater.iteration)
+
+    def resume(self, updater) -> Optional[int]:
+        """Restore the updater from the newest complete snapshot, if any.
+
+        Sets ``updater.state`` and ``updater.iteration`` and returns the
+        restored iteration (None when nothing restorable exists). The data
+        iterator restarts from its beginning — same contract as the
+        reference's restart-based recovery, where resumed epochs reshuffle.
+        """
+        state, it = self.maybe_load(updater.state)
+        if it is not None:
+            updater.state = state
+            updater.iteration = it
+        return it
+
     # -- resume ---------------------------------------------------------
 
     def latest_common_iteration(self) -> Optional[int]:
@@ -218,10 +241,14 @@ class MultiNodeCheckpointer:
         new_leaves = []
         for i, ref in enumerate(leaves):
             arr = loaded[f"leaf_{i}"]
-            if hasattr(ref, "sharding"):
+            # honor the reference leaf's sharding only when it was actually
+            # committed — device_put on an uncommitted default-device array
+            # would PIN the restored leaf to one device and clash with
+            # replicated/sharded leaves inside the next jitted step
+            if hasattr(ref, "sharding") and getattr(ref, "committed", False):
                 arr = jax.device_put(arr, ref.sharding)
             elif hasattr(ref, "dtype"):
-                arr = arr.astype(ref.dtype)
+                arr = jnp.asarray(arr, ref.dtype)
             new_leaves.append(arr)
         return jax.tree_util.tree_unflatten(treedef, new_leaves), it
 

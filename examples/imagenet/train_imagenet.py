@@ -64,6 +64,11 @@ def main():
     p.add_argument("--n-train", type=int, default=2048)
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   metavar="ITERS",
+                   help="checkpoint every N iterations (0 = off)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest complete snapshot")
     p.add_argument("--out", "-o", default="result")
     args = p.parse_args()
 
@@ -110,10 +115,22 @@ def main():
 
     it = SerialIterator(train, global_batch, shuffle=True, seed=0)
     updater = StandardUpdater(it, step, state, comm)
+
+    checkpointer = None
+    if args.snapshot_every or args.resume:
+        checkpointer = chainermn_tpu.create_multi_node_checkpointer(
+            "imagenet", comm, path=args.out, async_write=True)
+    if args.resume and checkpointer is not None:
+        restored = checkpointer.resume(updater)
+        if comm.is_master and restored is not None:
+            print(f"resumed from iteration {restored}")
     stop = ((args.iterations, "iteration") if args.iterations
             else (args.epoch, "epoch"))
     trainer = Trainer(updater, stop_trigger=stop, out=args.out)
 
+    if checkpointer is not None and args.snapshot_every:
+        trainer.extend(checkpointer, trigger=(args.snapshot_every,
+                                              "iteration"))
     if comm.is_master:
         trainer.extend(LogReport(os.path.join(args.out, "imagenet.jsonl")),
                        trigger=(10, "iteration"))
