@@ -210,6 +210,17 @@ class MultiNodeCheckpointer:
         if it is not None:
             updater.state = state
             updater.iteration = it
+            # fast-forward the iterator's epoch counter, or an epoch-based
+            # stop trigger would re-run every completed epoch on the
+            # restored state (the position WITHIN the epoch restarts —
+            # the reference's restart semantics)
+            iterator = getattr(updater, "iterator", None)
+            if (iterator is not None and hasattr(iterator, "epoch")
+                    and hasattr(iterator, "batch_size")
+                    and hasattr(iterator, "dataset")):
+                n = len(iterator.dataset)
+                if n:
+                    iterator.epoch = it * iterator.batch_size // n
         return it
 
     # -- resume ---------------------------------------------------------

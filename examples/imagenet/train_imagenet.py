@@ -117,10 +117,11 @@ def main():
     updater = StandardUpdater(it, step, state, comm)
 
     checkpointer = None
+    restored = None
     if args.snapshot_every or args.resume:
         checkpointer = chainermn_tpu.create_multi_node_checkpointer(
             "imagenet", comm, path=args.out, async_write=True)
-    if args.resume and checkpointer is not None:
+    if args.resume:
         restored = checkpointer.resume(updater)
         if comm.is_master and restored is not None:
             print(f"resumed from iteration {restored}")
@@ -141,7 +142,10 @@ def main():
     trainer.run()
     if comm.is_master:
         obs = trainer.observation
-        ips = obs["iteration"] * global_batch / obs["elapsed_time"]
+        # count only THIS run's iterations — the counter includes the
+        # restored ones after --resume
+        done = obs["iteration"] - (restored or 0)
+        ips = done * global_batch / obs["elapsed_time"]
         print(f"throughput: {ips:.1f} images/sec "
               f"({ips / comm.size:.1f} /chip)")
     return trainer

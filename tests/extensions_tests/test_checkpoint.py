@@ -201,3 +201,37 @@ def test_trainer_snapshot_and_resume(comm, tmp_path):
 
     for a, b in zip(leaves(ref.state), leaves(up2.state)):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_resume_fast_forwards_epoch(comm, tmp_path):
+    """Epoch-based stop triggers must not re-run completed epochs after
+    resume: the iterator's epoch counter is restored from the iteration."""
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.models import MLP
+    from chainermn_tpu.training import StandardUpdater
+    from chainermn_tpu.training.step import make_data_parallel_train_step
+
+    n = comm.size
+    rng = np.random.RandomState(0)
+    data = [(rng.rand(28, 28).astype(np.float32), np.int32(0))
+            for _ in range(2 * n)]
+    model = MLP(n_units=8, n_out=4)
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-2), comm)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    state = (comm.bcast_data(params), opt.init(params))
+    step = make_data_parallel_train_step(model, opt, comm)
+    # batch == dataset: one iteration per epoch
+    up = StandardUpdater(SerialIterator(data, 2 * n, shuffle=False),
+                         step, state, comm)
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+    cp.save(up.state, iteration=3)
+
+    up2 = StandardUpdater(SerialIterator(data, 2 * n, shuffle=False),
+                          step, state, comm)
+    assert cp.resume(up2) == 3
+    assert up2.iteration == 3
+    assert up2.epoch == 3  # 3 iterations x full-dataset batches
