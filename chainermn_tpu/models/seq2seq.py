@@ -216,3 +216,50 @@ def pad_batch(pairs: Sequence[Tuple[np.ndarray, np.ndarray]],
         tgt_out[i, :len(t)] = t
         tgt_out[i, len(t)] = EOS
     return src, src_len, tgt_in, tgt_out
+
+
+def corpus_bleu(references, hypotheses, max_n: int = 4):
+    """Corpus-level BLEU-4 with brevity penalty (the reference seq2seq
+    example's reported metric; self-contained reimplementation of the
+    standard formula — no nltk dependency).
+
+    references/hypotheses: sequences of int token lists/arrays. PAD/BOS/EOS
+    should already be stripped (``strip_special``). Returns a float in
+    [0, 1]; 0 when any n-gram order has zero matches (standard smoothing-
+    free corpus BLEU).
+    """
+    import collections
+    import math
+
+    clipped = [0] * max_n
+    totals = [0] * max_n
+    ref_len = hyp_len = 0
+    for ref, hyp in zip(references, hypotheses):
+        ref = [int(t) for t in ref]
+        hyp = [int(t) for t in hyp]
+        ref_len += len(ref)
+        hyp_len += len(hyp)
+        for n in range(1, max_n + 1):
+            rc = collections.Counter(
+                tuple(ref[i:i + n]) for i in range(len(ref) - n + 1))
+            hc = collections.Counter(
+                tuple(hyp[i:i + n]) for i in range(len(hyp) - n + 1))
+            totals[n - 1] += max(sum(hc.values()), 0)
+            clipped[n - 1] += sum(min(c, rc[g]) for g, c in hc.items())
+    if hyp_len == 0 or any(t == 0 for t in totals) \
+            or any(c == 0 for c in clipped):
+        return 0.0
+    log_p = sum(math.log(c / t) for c, t in zip(clipped, totals)) / max_n
+    bp = 1.0 if hyp_len > ref_len else math.exp(1.0 - ref_len / hyp_len)
+    return bp * math.exp(log_p)
+
+
+def strip_special(tokens, specials=(PAD, BOS, EOS)):
+    """Cut a decoded row at EOS and drop PAD/BOS (BLEU pre-processing)."""
+    out = []
+    for t in np.asarray(tokens).tolist():
+        if t == EOS:
+            break
+        if t not in specials:
+            out.append(int(t))
+    return out

@@ -121,7 +121,12 @@ def main():
 
     # translate a few training pairs back (reference: the seq2seq example's
     # post-epoch translate check); --beam K switches greedy → beam search
-    from chainermn_tpu.models.seq2seq import beam_translate, greedy_translate
+    from chainermn_tpu.models.seq2seq import (
+        beam_translate,
+        corpus_bleu,
+        greedy_translate,
+        strip_special,
+    )
 
     params = state[0]
     srcs, src_len, _, tgt_out = pad_batch(train[:4], args.bucket)
@@ -133,9 +138,11 @@ def main():
                                max_len=args.bucket)
     hyp = np.asarray(hyp)
     if comm.is_master:
-        match = float((hyp[:, :tgt_out.shape[1]] == tgt_out).mean())
+        refs = [strip_special(r) for r in tgt_out]
+        hyps = [strip_special(h) for h in hyp]
+        bleu = corpus_bleu(refs, hyps)
         mode = f"beam={args.beam}" if args.beam else "greedy"
-        print(f"translate demo ({mode}): token match {match:.3f}")
+        print(f"translate demo ({mode}): BLEU {bleu:.4f}")
         for i in range(2):
             print(f"  src {srcs[i][:8]}... -> hyp {hyp[i][:8]}...")
     return float(metrics["main/loss"])

@@ -72,3 +72,36 @@ def test_beam_finds_exhaustive_optimum():
     assert mask.any(), bm
     got = objective(int(np.where(mask)[0][0]), bm)
     np.testing.assert_allclose(got, brute, rtol=1e-5)
+
+
+def test_corpus_bleu_known_values():
+    from chainermn_tpu.models.seq2seq import corpus_bleu
+
+    # perfect match -> 1.0
+    refs = [[5, 6, 7, 8, 9], [4, 5, 6, 7]]
+    assert corpus_bleu(refs, refs) == 1.0
+    # no overlap -> 0.0
+    assert corpus_bleu([[5, 6, 7, 8]], [[10, 11, 12, 13]]) == 0.0
+    # hand-computed: hyp shares 4/5 unigrams, 3/4 bigrams, 2/3 trigrams,
+    # 1/2 4-grams with ref, equal length -> bp=1
+    ref = [[3, 4, 5, 6, 7]]
+    hyp = [[3, 4, 5, 6, 9]]
+    import math
+    expect = math.exp((math.log(4/5) + math.log(3/4) + math.log(2/3)
+                       + math.log(1/2)) / 4)
+    np.testing.assert_allclose(corpus_bleu(ref, hyp), expect, rtol=1e-9)
+    # brevity penalty: hyp shorter than ref
+    ref = [[3, 4, 5, 6, 7, 8, 9, 10]]
+    hyp = [[3, 4, 5, 6, 7]]
+    got = corpus_bleu(ref, hyp)
+    assert 0 < got < 1
+    assert abs(got / math.exp(1 - 8/5)
+               - math.exp((math.log(1.0) * 4) / 4)) < 1e-9
+
+
+def test_strip_special():
+    from chainermn_tpu.models.seq2seq import strip_special
+
+    assert strip_special([1, 5, 6, 2, 7, 0]) == [5, 6]   # BOS..EOS cut
+    assert strip_special([5, 0, 0]) == [5]
+    assert strip_special([2]) == []
