@@ -29,6 +29,13 @@ import jax.numpy as jnp
 from chainermn_tpu.comm.base import CommunicatorBase
 
 
+def _leaf_dict(state):
+    """Pytree → flat {leaf_i: array} dict (orbax-friendly: a dict of
+    arrays restores against any pytree with the same leaf order)."""
+    leaves = jax.tree_util.tree_flatten(state)[0]
+    return {f"leaf_{i}": l for i, l in enumerate(leaves)}
+
+
 def _flatten_state(state):
     leaves, treedef = jax.tree_util.tree_flatten(state)
     # batch the D2H transfers: start every copy before waiting on any
@@ -53,12 +60,17 @@ class MultiNodeCheckpointer:
     """
 
     def __init__(self, name: str, comm: CommunicatorBase, path: str = ".",
-                 cp_interval: int = 5, async_write: bool = False):
+                 cp_interval: int = 5, async_write: bool = False,
+                 backend: str = "npz"):
         self.name = name
         self.comm = comm
         self.path = os.path.join(path, name)
         self.cp_interval = cp_interval  # snapshots kept in the window
         self.async_write = async_write
+        if backend not in ("npz", "orbax"):
+            raise ValueError(f"unknown checkpoint backend {backend!r}")
+        self.backend = backend
+        self._orbax = None  # lazy StandardCheckpointer (tensorstore/zarr)
         self._queue: Optional[queue.Queue] = None
         self._writer: Optional[threading.Thread] = None
         self._write_error: Optional[BaseException] = None
@@ -122,6 +134,15 @@ class MultiNodeCheckpointer:
         (election) must reach its allgather even when this process's last
         write failed, or the other ranks hang in the collective; a failed
         write was never published, so the election skips it naturally."""
+        if self._orbax is not None:
+            try:
+                self._orbax.wait_until_finished()
+                self._gc()
+            except Exception as e:
+                import warnings
+
+                warnings.warn(f"async checkpoint write failed (election "
+                              f"will skip the unpublished snapshot): {e!r}")
         if self._queue is not None:
             self._queue.join()
         if self._write_error is not None:
@@ -136,6 +157,9 @@ class MultiNodeCheckpointer:
 
     def flush(self):
         """Block until every queued snapshot is published."""
+        if self._orbax is not None:
+            self._orbax.wait_until_finished()
+            self._gc()
         if self._queue is not None:
             self._queue.join()
         self._raise_pending()
@@ -156,11 +180,29 @@ class MultiNodeCheckpointer:
         os.replace(fn + ".npz", fn)  # atomic publish
         self._gc()
 
+    def _orbax_ck(self):
+        if self._orbax is None:
+            import orbax.checkpoint as ocp
+
+            self._orbax = ocp.StandardCheckpointer()
+        return self._orbax
+
     def save(self, state: Any, iteration: int) -> str:
         self._raise_pending()
         fn = os.path.join(
             self.path, f"snapshot_iter_{iteration}.{self.comm.inter_rank}"
         )
+        if self.backend == "orbax":
+            # orbax is natively async (tensorstore writers) and atomic
+            # (tmp-dir + rename); our thread/queue machinery is redundant
+            ck = self._orbax_ck()
+            if not self.async_write:
+                ck.wait_until_finished()
+            ck.save(os.path.abspath(fn), _leaf_dict(state), force=True)
+            if not self.async_write:
+                ck.wait_until_finished()
+                self._gc()
+            return fn
         arrays, treedef = _flatten_state(state)
         if self.async_write:
             self._ensure_writer()
@@ -182,11 +224,17 @@ class MultiNodeCheckpointer:
         return sorted(out)
 
     def _gc(self):
+        import shutil
+
         iters = self._iters_on_disk()
         for it in iters[:-self.cp_interval]:
+            fn = os.path.join(
+                self.path, f"snapshot_iter_{it}.{self.comm.inter_rank}")
             try:
-                os.remove(os.path.join(
-                    self.path, f"snapshot_iter_{it}.{self.comm.inter_rank}"))
+                if os.path.isdir(fn):   # orbax snapshots are directories
+                    shutil.rmtree(fn, ignore_errors=True)
+                else:
+                    os.remove(fn)
             except OSError:
                 pass
 
@@ -247,7 +295,11 @@ class MultiNodeCheckpointer:
         fn = os.path.join(
             self.path, f"snapshot_iter_{it}.{self.comm.inter_rank}"
         )
-        loaded = np.load(fn, allow_pickle=False)
+        if self.backend == "orbax":
+            loaded = self._orbax_ck().restore(
+                os.path.abspath(fn), _leaf_dict(state))
+        else:
+            loaded = np.load(fn, allow_pickle=False)
         leaves, treedef = jax.tree_util.tree_flatten(state)
         new_leaves = []
         for i, ref in enumerate(leaves):
@@ -271,4 +323,4 @@ def create_multi_node_checkpointer(name: str, comm: CommunicatorBase,
     """Factory matching the reference name (chainermn/extensions/checkpoint.py)."""
     return MultiNodeCheckpointer(name, comm, path=path,
                                  cp_interval=cp_interval,
-                                 async_write=async_write)
+                                 async_write=async_write, **kwargs)

@@ -235,3 +235,25 @@ def test_resume_fast_forwards_epoch(comm, tmp_path):
     assert cp.resume(up2) == 3
     assert up2.iteration == 3
     assert up2.epoch == 3  # 3 iterations x full-dataset batches
+
+
+@pytest.mark.parametrize("async_write", [False, True])
+def test_orbax_backend_round_trip(comm, tmp_path, async_write):
+    """backend='orbax' (tensorstore/zarr directories): save/elect/restore
+    round-trip, GC of directory snapshots, resume interop."""
+    cp = create_multi_node_checkpointer(
+        "job", comm, path=str(tmp_path), cp_interval=2,
+        async_write=async_write, backend="orbax")
+    state = {"w": jnp.arange(8.0).reshape(2, 4), "n": jnp.int32(7)}
+    for it in range(1, 5):
+        cp.save(jax.tree_util.tree_map(lambda a: a + it, state), it)
+    cp.flush()
+    assert cp.latest_common_iteration() == 4
+    restored, it = cp.maybe_load(state)
+    assert it == 4
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               np.arange(8.0).reshape(2, 4) + 4)
+    assert int(restored["n"]) == 11
+    # GC kept only the rolling window of directory snapshots
+    kept = sorted(cp._iters_on_disk())
+    assert kept == [3, 4]
