@@ -95,9 +95,15 @@ class MultiNodeCheckpointer:
             daemon=True,
         )
         self._writer.start()
-        # a script that never calls close() must not lose checkpoints
-        # save() already returned a path for at interpreter shutdown; at
-        # that point nothing can catch, so report instead of raising
+        self._register_atexit()
+
+    def _register_atexit(self):
+        # a script that never calls close() must not lose checkpoints:
+        # at interpreter shutdown nothing can catch, so report instead of
+        # raising. Registered once per checkpointer (both backends).
+        if getattr(self, "_atexit_done", False):
+            return
+        self._atexit_done = True
         import atexit
 
         def _close_at_exit():
@@ -165,7 +171,10 @@ class MultiNodeCheckpointer:
         self._raise_pending()
 
     def close(self):
-        """Join the writer thread (trainer finalization hook)."""
+        """Join outstanding writes (trainer finalization hook)."""
+        if self._orbax is not None:
+            self._orbax.wait_until_finished()
+            self._gc()
         if self._writer is not None and self._writer.is_alive():
             self._queue.join()
             self._queue.put(None)
@@ -199,7 +208,13 @@ class MultiNodeCheckpointer:
             if not self.async_write:
                 ck.wait_until_finished()
             ck.save(os.path.abspath(fn), _leaf_dict(state), force=True)
-            if not self.async_write:
+            if self.async_write:
+                # the in-flight snapshot is invisible to _gc (tmp-dir name
+                # doesn't match); prune completed ones so directories don't
+                # accumulate across a long run
+                self._register_atexit()
+                self._gc()
+            else:
                 ck.wait_until_finished()
                 self._gc()
             return fn

@@ -241,6 +241,7 @@ def test_resume_fast_forwards_epoch(comm, tmp_path):
 def test_orbax_backend_round_trip(comm, tmp_path, async_write):
     """backend='orbax' (tensorstore/zarr directories): save/elect/restore
     round-trip, GC of directory snapshots, resume interop."""
+    pytest.importorskip("orbax.checkpoint")
     cp = create_multi_node_checkpointer(
         "job", comm, path=str(tmp_path), cp_interval=2,
         async_write=async_write, backend="orbax")
