@@ -1,0 +1,101 @@
+"""Feature-matrix fuzz: flash attention vs a general masked oracle.
+
+Random combinations of GQA, causal, sliding window, segment packing, odd
+lengths (auto-padding), and dtypes — the pairwise tests cover each
+feature alone; this catches interactions between them.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.ops.flash_attention import flash_attention
+
+
+def _oracle(q, k, v, q_seg, kv_seg, causal, window, scale):
+    """Dense attention with every mask composed; fully-masked rows → 0."""
+    h, hk = q.shape[2], k.shape[2]
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    lq, lk = q.shape[1], k.shape[1]
+    mask = jnp.ones((lq, lk), bool)
+    if causal:
+        i = jnp.arange(lq)[:, None]
+        j = jnp.arange(lk)[None, :]
+        mask &= j <= i
+        if window is not None:
+            mask &= (i - j) < window
+    mask = mask[None] & (q_seg[:, :, None] == kv_seg[:, None, :])
+    mask = mask[:, None]
+    s = jnp.where(mask, s, -1e30)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
+    denom = jnp.maximum(jnp.sum(p, -1, keepdims=True), 1e-30)
+    return jnp.einsum("bhqk,bkhd->bqhd", p / denom, v.astype(jnp.float32))
+
+
+CASES = []
+_r = np.random.RandomState(2026)
+for i in range(12):
+    causal = bool(_r.randint(2))
+    h = int(_r.choice([1, 2, 4]))
+    hkv = int(_r.choice([g for g in (1, 2, 4) if h % g == 0 and g <= h]))
+    lq = int(_r.choice([64, 96, 128, 100, 118]))
+    lk = lq if causal else int(_r.choice([lq, 64, 192]))
+    window = (int(_r.choice([16, 40])) if causal and _r.randint(2) else None)
+    segs = bool(_r.randint(2))
+    CASES.append((i, causal, h, hkv, lq, lk, window, segs))
+
+
+@pytest.mark.parametrize("i,causal,h,hkv,lq,lk,window,segs", CASES)
+def test_fuzz_matches_oracle(i, causal, h, hkv, lq, lk, window, segs):
+    rng = np.random.RandomState(100 + i)
+    b, d = 2, 16
+    q = rng.randn(b, lq, h, d).astype(np.float32)
+    k = rng.randn(b, lk, hkv, d).astype(np.float32)
+    v = rng.randn(b, lk, hkv, d).astype(np.float32)
+    if segs:
+        # random segment boundaries; a PAD tail on the kv side
+        cuts = sorted(rng.choice(np.arange(1, lq), 2, replace=False))
+        q_seg = np.zeros((b, lq), np.int32)
+        q_seg[:, cuts[0]:] = 1
+        q_seg[:, cuts[1]:] = 2
+        kv_seg = np.zeros((b, lk), np.int32)
+        kv_cuts = [min(c, lk - 1) for c in cuts]
+        kv_seg[:, kv_cuts[0]:] = 1
+        kv_seg[:, kv_cuts[1]:] = 2
+        kv_seg[:, lk - lk // 8:] = -2   # padding: matches nothing
+        seg_arg = (jnp.asarray(q_seg), jnp.asarray(kv_seg))
+    else:
+        q_seg = np.zeros((b, lq), np.int32)
+        kv_seg = np.zeros((b, lk), np.int32)
+        seg_arg = None
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal, None, 64, 64, True,
+                              seg_arg, window)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    def loss_ref(q, k, v):
+        out = _oracle(q, k, v, jnp.asarray(q_seg), jnp.asarray(kv_seg),
+                      causal, window, d ** -0.5)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    (lf, of), g = jax.value_and_grad(loss_flash, argnums=(0, 1, 2),
+                                     has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    (lr, orf), gr = jax.value_and_grad(loss_ref, argnums=(0, 1, 2),
+                                       has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orf),
+                               rtol=3e-4, atol=3e-5,
+                               err_msg=f"fwd case {i}")
+    for a, r, nm in zip(g, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=2e-3, atol=2e-4,
+                                   err_msg=f"d{nm} case {i}")
