@@ -1,0 +1,55 @@
+"""Drop-in import alias: ``import chainermn`` → ``chainermn_tpu``.
+
+The reference's user-facing promise is a ~3-line diff to any training script
+(create a communicator, wrap the optimizer, scatter the dataset — SURVEY.md
+§0). This package keeps those scripts' *import lines* working against the
+TPU-native rebuild: every top-level factory, plus the documented submodules
+(``chainermn.functions``, ``chainermn.links``, ``chainermn.communicators``,
+``chainermn.datasets``, ``chainermn.iterators``, ``chainermn.extensions``,
+``chainermn.optimizers``, mirroring the reference package layout per
+SURVEY.md §1), resolves to the ``chainermn_tpu`` implementation.
+
+What cannot carry over: Chainer itself. Models are flax modules and
+optimizers are optax transformations here, so a real migration still touches
+model code — see MIGRATION.md for the mapping. This shim makes the
+*distributed* surface (the part ChainerMN owned) line-compatible.
+"""
+
+import importlib as _importlib
+import pkgutil as _pkgutil
+import sys as _sys
+
+from chainermn_tpu import *  # noqa: F401,F403 — re-export the public API
+from chainermn_tpu import __all__ as _all
+from chainermn_tpu import __version__  # noqa: F401
+
+# Reference submodule layout → rebuild modules. `chainermn.communicators`
+# maps to the comm package (communicator classes + factory live there).
+_SUBMODULES = {
+    "communicators": "chainermn_tpu.comm",
+    "functions": "chainermn_tpu.functions",
+    "links": "chainermn_tpu.links",
+    "datasets": "chainermn_tpu.datasets",
+    "iterators": "chainermn_tpu.iterators",
+    "extensions": "chainermn_tpu.extensions",
+    "optimizers": "chainermn_tpu.optimizers",
+}
+
+
+def _alias_tree(alias_name: str, target_name: str) -> None:
+    """Alias the WHOLE subtree, not just the top module: a plain top-level
+    sys.modules entry would let `import chainermn.communicators.base`
+    re-execute base.py under the alias name — a duplicate module with
+    distinct class objects (isinstance across the two copies fails)."""
+    mod = _importlib.import_module(target_name)
+    _sys.modules[alias_name] = mod
+    for info in _pkgutil.iter_modules(getattr(mod, "__path__", [])):
+        _alias_tree(f"{alias_name}.{info.name}",
+                    f"{target_name}.{info.name}")
+
+
+for _name, _target in _SUBMODULES.items():
+    _alias_tree(f"{__name__}.{_name}", _target)
+    globals()[_name] = _sys.modules[f"{__name__}.{_name}"]
+
+__all__ = list(_all) + list(_SUBMODULES)

@@ -1,0 +1,39 @@
+"""Shared dense-attention oracle for the flash kernel tests.
+
+One masked reference implementation composing every kernel feature —
+segment ids, causal, sliding window, GQA/MQA head repeat — so the pairwise
+tests (test_flash_attention) and the feature-matrix fuzz (test_flash_fuzz)
+assert against the same semantics.
+"""
+
+import jax.numpy as jnp
+
+
+def masked_attention_oracle(q, k, v, q_seg, kv_seg, causal, window, scale):
+    """Dense attention with every mask composed; fully-masked rows → 0.
+
+    q: [b, lq, h, d]; k/v: [b, lk, hkv, d] with h % hkv == 0 (GQA repeat).
+    q_seg/kv_seg: [b, l] int segment ids (equal ids attend). ``window``
+    (causal only) keeps i-j < window. Returns float32 [b, lq, h, d].
+    """
+    h, hk = q.shape[2], k.shape[2]
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    lq, lk = q.shape[1], k.shape[1]
+    mask = jnp.ones((lq, lk), bool)
+    if causal:
+        i = jnp.arange(lq)[:, None]
+        j = jnp.arange(lk)[None, :]
+        mask &= j <= i
+        if window is not None:
+            mask &= (i - j) < window
+    mask = mask[None] & (q_seg[:, :, None] == kv_seg[:, None, :])
+    mask = mask[:, None]
+    s = jnp.where(mask, s, -1e30)
+    m = jnp.max(s, -1, keepdims=True)
+    p = jnp.where(mask, jnp.exp(s - m), 0.0)
+    denom = jnp.maximum(jnp.sum(p, -1, keepdims=True), 1e-30)
+    return jnp.einsum("bhqk,bkhd->bqhd", p / denom, v.astype(jnp.float32))

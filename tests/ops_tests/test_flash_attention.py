@@ -205,20 +205,12 @@ def test_gqa_matches_repeated_kv(causal, hkv):
 
 
 def _reference_segs(q, k, v, q_seg, kv_seg, causal, scale):
-    """Oracle with explicit segment masking; fully-masked rows → zeros."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    mask = (q_seg[:, :, None] == kv_seg[:, None, :])[:, None]
-    if causal:
-        lq, lk = q.shape[1], k.shape[1]
-        mask = mask & (jnp.arange(lk)[None, :]
-                       <= jnp.arange(lq)[:, None])[None, None]
-    s = jnp.where(mask, s, -1e30)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.where(mask, jnp.exp(s - m), 0.0)
-    denom = jnp.maximum(jnp.sum(p, -1, keepdims=True), 1e-30)
-    return jnp.einsum("bhqk,bkhd->bqhd", p / denom,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    """Oracle with explicit segment masking; fully-masked rows → zeros.
+    Shared implementation: tests/ops_tests/attention_oracle.py."""
+    from tests.ops_tests.attention_oracle import masked_attention_oracle
+
+    return masked_attention_oracle(
+        q, k, v, q_seg, kv_seg, causal, None, scale).astype(q.dtype)
 
 
 @pytest.mark.parametrize("causal", [False, True])

@@ -1,8 +1,9 @@
 """Feature-matrix fuzz: flash attention vs a general masked oracle.
 
-Random combinations of GQA, causal, sliding window, segment packing, odd
-lengths (auto-padding), and dtypes — the pairwise tests cover each
-feature alone; this catches interactions between them.
+Random combinations of GQA, causal, sliding window, segment packing, and
+odd lengths (auto-padding) — the pairwise tests cover each feature alone;
+this catches interactions between them. All cases run in float32 (the
+oracle's comparison dtype).
 """
 
 import numpy as np
@@ -12,31 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from chainermn_tpu.ops.flash_attention import flash_attention
-
-
-def _oracle(q, k, v, q_seg, kv_seg, causal, window, scale):
-    """Dense attention with every mask composed; fully-masked rows → 0."""
-    h, hk = q.shape[2], k.shape[2]
-    if hk != h:
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    lq, lk = q.shape[1], k.shape[1]
-    mask = jnp.ones((lq, lk), bool)
-    if causal:
-        i = jnp.arange(lq)[:, None]
-        j = jnp.arange(lk)[None, :]
-        mask &= j <= i
-        if window is not None:
-            mask &= (i - j) < window
-    mask = mask[None] & (q_seg[:, :, None] == kv_seg[:, None, :])
-    mask = mask[:, None]
-    s = jnp.where(mask, s, -1e30)
-    m = jnp.max(s, -1, keepdims=True)
-    p = jnp.where(mask, jnp.exp(s - m), 0.0)
-    denom = jnp.maximum(jnp.sum(p, -1, keepdims=True), 1e-30)
-    return jnp.einsum("bhqk,bkhd->bqhd", p / denom, v.astype(jnp.float32))
+from tests.ops_tests.attention_oracle import masked_attention_oracle as _oracle
 
 
 CASES = []
