@@ -1,4 +1,5 @@
 from .mlp import MLP
 from .transformer import TransformerLM
+from .vit import ViT
 
-__all__ = ["MLP", "TransformerLM"]
+__all__ = ["MLP", "TransformerLM", "ViT"]

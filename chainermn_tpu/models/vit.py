@@ -1,0 +1,134 @@
+"""Vision Transformer image classifier.
+
+Beyond-reference model family (the reference's vision models are the MNIST
+MLP and ImageNet ResNet-50 — upstream `examples/{mnist,imagenet}`, SURVEY.md
+§2.6): a ViT built from the same fused attention the Transformer LM uses,
+giving the vision path an MXU-dominated alternative to convolutions.
+
+TPU-first choices:
+* patchify is a stride-`patch` conv (one big matmul per image — MXU work,
+  not a gather);
+* encoder attention is the Pallas flash kernel with ``causal=False``;
+* bf16 compute / fp32 params via ``dtype`` like the other model families;
+* static token count (no CLS-vs-sequence dynamic shapes; pooling is either
+  a learned CLS token or global average, both shape-static).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chainermn_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["ViT", "ViTEncoderBlock"]
+
+
+class ViTEncoderBlock(nn.Module):
+    """Pre-LN encoder block: bidirectional attention + GELU MLP.
+
+    ``train`` is a construction attribute, not a call argument, so
+    ``nn.remat`` never traces it (a traced bool would crash the
+    ``deterministic=not train`` branch)."""
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+    attention_blocks: Optional[tuple] = None
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        train = self.train
+        b, l, d = x.shape
+        dh = self.d_model // self.n_heads
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, l, self.n_heads, dh)
+        k = k.reshape(b, l, self.n_heads, dh)
+        v = v.reshape(b, l, self.n_heads, dh)
+        bq, bk = self.attention_blocks or (256, 512)
+        att = flash_attention(q, k, v, causal=False, block_q=bq, block_k=bk)
+        att = att.reshape(b, l, self.d_model).astype(self.dtype)
+        att = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                       name="attn_out")(att)
+        if self.dropout_rate > 0.0:
+            att = nn.Dropout(self.dropout_rate, deterministic=not train)(att)
+        x = x + att
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        y = nn.Dense(self.d_ff, dtype=self.dtype, name="ffn_in")(h)
+        y = nn.gelu(y)
+        y = nn.Dense(self.d_model, dtype=self.dtype, name="ffn_out")(y)
+        if self.dropout_rate > 0.0:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """images [B, H, W, C] → logits [B, num_classes] (fp32).
+
+    ``pool='cls'`` prepends a learned class token; ``pool='gap'`` mean-pools
+    the patch tokens (both static-shape). Defaults are ViT-S/16-ish scaled
+    down; pass ``dtype=jnp.bfloat16`` for MXU-fed training (params stay
+    fp32, logits are fp32 — same mixed-precision contract as ResNet50).
+    """
+
+    num_classes: int
+    patch: int = 16
+    d_model: int = 384
+    n_layers: int = 12
+    n_heads: int = 6
+    d_ff: int = 1536
+    pool: str = "gap"                  # 'gap' | 'cls'
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+    attention_blocks: Optional[tuple] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.pool not in ("gap", "cls"):
+            raise ValueError(f"pool must be 'gap' or 'cls', got {self.pool!r}")
+        b, hh, ww, c = x.shape
+        if hh % self.patch or ww % self.patch:
+            raise ValueError(
+                f"image {hh}x{ww} not divisible by patch {self.patch}")
+        x = nn.Conv(self.d_model, (self.patch, self.patch),
+                    strides=(self.patch, self.patch), padding="VALID",
+                    dtype=self.dtype, name="patchify")(x.astype(self.dtype))
+        n_tok = (hh // self.patch) * (ww // self.patch)
+        x = x.reshape(b, n_tok, self.d_model)
+
+        if self.pool == "cls":
+            cls = self.param("cls_token", nn.initializers.zeros,
+                             (1, 1, self.d_model))
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (b, 1, self.d_model)).astype(
+                    self.dtype), x], axis=1)
+            n_tok += 1
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (n_tok, self.d_model))
+        x = x + pos.astype(self.dtype)[None]
+        if self.dropout_rate > 0.0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        block_cls = nn.remat(ViTEncoderBlock) if self.remat \
+            else ViTEncoderBlock
+        for i in range(self.n_layers):
+            x = block_cls(
+                d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
+                dtype=self.dtype, dropout_rate=self.dropout_rate,
+                attention_blocks=self.attention_blocks, train=train,
+                name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x[:, 0] if self.pool == "cls" else jnp.mean(x, axis=1)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x).astype(jnp.float32)

@@ -61,6 +61,7 @@ def make_data_parallel_train_step(
     donate: bool = True,
     grad_accum: int = 1,
     remat: Any = False,
+    with_rng: bool = False,
 ):
     """Build the jitted data-parallel train step.
 
@@ -71,6 +72,12 @@ def make_data_parallel_train_step(
     semantics). The optimizer should already wrap the communicator
     (create_multi_node_optimizer); a plain optax optimizer also works when
     autodiff inserts the psum (default shard_map mode).
+
+    ``with_rng=True`` changes the step signature to
+    ``step(state, x, y, rng)`` and threads per-shard dropout keys into the
+    loss (``rng`` is one PRNGKey; each shard folds in its mesh position, and
+    each micro-batch its index, so masks decorrelate). Required for models
+    with dropout — without it the loss runs rng-less and flax raises.
 
     ``grad_accum=N`` splits each shard's batch into N micro-batches and
     accumulates gradients over a ``lax.scan`` — same optimizer math as the
@@ -85,16 +92,26 @@ def make_data_parallel_train_step(
     axes = comm.axis_names
     dspec = P(axes if len(axes) > 1 else axes[0])
 
-    def local_step(state, x, y):
+    def local_step(state, x, y, rng=None):
         if mutable:
             params, opt_state, extra = state
         else:
             params, opt_state = state
             extra = None
 
-        def f(p, x, y, extra):
-            return lf(model, p, x, y, train=True, mutable=mutable,
-                      extra_vars=extra)
+        if rng is not None:
+            # decorrelate dropout masks across shards
+            for a in axes:
+                rng = jax.random.fold_in(rng, lax.axis_index(a))
+
+        if with_rng:
+            def f(p, x, y, extra, r):
+                return lf(model, p, x, y, train=True, mutable=mutable,
+                          extra_vars=extra, rngs={"dropout": r})
+        else:
+            def f(p, x, y, extra, r):
+                return lf(model, p, x, y, train=True, mutable=mutable,
+                          extra_vars=extra)
 
         if remat:
             policy = None if remat is True else remat
@@ -108,17 +125,19 @@ def make_data_parallel_train_step(
             xm = x.reshape((grad_accum, b // grad_accum) + x.shape[1:])
             ym = y.reshape((grad_accum, b // grad_accum) + y.shape[1:])
 
-            def one(extra_c, xi, yi):
+            def one(extra_c, xi, yi, i):
+                # per-micro-batch dropout key
+                r = None if rng is None else jax.random.fold_in(rng, i)
                 (loss, (acc, new_vars)), g = jax.value_and_grad(
-                    f, has_aux=True)(params, xi, yi, extra_c)
+                    f, has_aux=True)(params, xi, yi, extra_c, r)
                 new_extra = (
                     {k: new_vars[k] for k in mutable} if mutable else extra_c
                 )
                 return g, loss, acc, new_extra
 
-            def micro(carry, xy):
+            def micro(carry, xyi):
                 g_acc, loss_acc, acc_acc, extra_c = carry
-                g, loss, acc, new_extra = one(extra_c, *xy)
+                g, loss, acc, new_extra = one(extra_c, *xyi)
                 g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
                 return (g_acc, loss_acc + loss, acc_acc + acc,
                         new_extra), None
@@ -129,9 +148,10 @@ def make_data_parallel_train_step(
             # (axis-invariant) under vma tracking — casting a zeros carry to
             # varying here would make allreduce_grad re-reduce them (an N x
             # gradient), while leaving it invariant breaks BN state (varying).
-            g0, l0, a0, e0 = one(extra, xm[0], ym[0])
+            g0, l0, a0, e0 = one(extra, xm[0], ym[0], 0)
             (g_sum, loss_sum, acc_sum, new_extra), _ = lax.scan(
-                micro, (g0, l0, a0, e0), (xm[1:], ym[1:]))
+                micro, (g0, l0, a0, e0),
+                (xm[1:], ym[1:], jnp.arange(1, grad_accum)))
             grads = jax.tree_util.tree_map(
                 lambda g: g / grad_accum, g_sum)
             loss = loss_sum / grad_accum
@@ -139,7 +159,7 @@ def make_data_parallel_train_step(
             new_vars = new_extra if mutable else {}
         else:
             (loss, (acc, new_vars)), grads = jax.value_and_grad(
-                f, has_aux=True)(params, x, y, extra)
+                f, has_aux=True)(params, x, y, extra, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = {
@@ -157,11 +177,14 @@ def make_data_parallel_train_step(
         return (params, opt_state), metrics
 
     n_state = 3 if mutable else 2
+    in_specs = ((P(),) * n_state, dspec, dspec)
+    if with_rng:
+        in_specs = in_specs + (P(),)  # the PRNGKey, replicated
     step = jax.jit(
         shard_map(
             local_step,
             mesh=mesh,
-            in_specs=((P(),) * n_state, dspec, dspec),
+            in_specs=in_specs,
             out_specs=((P(),) * n_state, P()),
         ),
         donate_argnums=(0,) if donate else (),

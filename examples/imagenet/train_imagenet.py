@@ -31,6 +31,7 @@ ensure_platform()
 from chainermn_tpu.datasets.toy import ArrayDataset
 from chainermn_tpu.iterators import SerialIterator
 from chainermn_tpu.models.resnet import ResNet50
+from chainermn_tpu.models.vit import ViT
 from chainermn_tpu.training import LogReport, PrintReport, StandardUpdater, Trainer
 from chainermn_tpu.training.step import make_data_parallel_train_step
 
@@ -60,6 +61,10 @@ def main():
                         "ResNet needs layerwise trust ratios)")
     p.add_argument("--warmup-epochs", type=float, default=0.0,
                    help="linear LR warmup epochs (then cosine decay)")
+    p.add_argument("--model", choices=["resnet50", "vit"],
+                   default="resnet50",
+                   help="vit: patch-16 Vision Transformer (flash-attention "
+                        "encoder) instead of the conv net")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--n-train", type=int, default=2048)
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
@@ -84,13 +89,19 @@ def main():
     train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model = ResNet50(num_classes=1000, dtype=dtype)
+    if args.model == "vit":
+        model = ViT(num_classes=1000, dtype=dtype)
+        mutable = None
+    else:
+        model = ResNet50(num_classes=1000, dtype=dtype)
+        mutable = ("batch_stats",)
     variables = model.init(
         jax.random.PRNGKey(0),
         np.zeros((2, args.image_size, args.image_size, 3), np.float32),
     )
     params = comm.bcast_data(variables["params"])
-    batch_stats = comm.bcast_data(variables["batch_stats"])
+    batch_stats = (comm.bcast_data(variables["batch_stats"])
+                   if mutable else None)
 
     steps_per_epoch = max(1, len(train) * comm.size // global_batch)
     if args.warmup_epochs > 0:
@@ -107,10 +118,11 @@ def main():
         "lamb": lambda: optax.lamb(lr, weight_decay=1e-4),
     }[args.optimizer]()
     optimizer = chainermn_tpu.create_multi_node_optimizer(base_opt, comm)
-    state = (params, optimizer.init(params), {"batch_stats": batch_stats})
+    state = ((params, optimizer.init(params), {"batch_stats": batch_stats})
+             if mutable else (params, optimizer.init(params)))
 
     step = make_data_parallel_train_step(
-        model, optimizer, comm, mutable=("batch_stats",)
+        model, optimizer, comm, mutable=mutable
     )
 
     it = SerialIterator(train, global_batch, shuffle=True, seed=0)

@@ -1,0 +1,149 @@
+"""ViT: shapes, pooling variants, mixed precision, DP training."""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import chainermn_tpu
+from chainermn_tpu.models import ViT
+from chainermn_tpu.training.step import make_data_parallel_train_step
+
+
+def _tiny(**kw):
+    cfg = dict(num_classes=10, patch=8, d_model=32, n_layers=2, n_heads=4,
+               d_ff=64)
+    cfg.update(kw)
+    return ViT(**cfg)
+
+
+@pytest.mark.parametrize("pool", ["gap", "cls"])
+def test_forward_shape_and_finite(pool):
+    model = _tiny(pool=pool)
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    logits = model.apply(variables, x)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(logits)))
+    # token count: 16 patches (+1 cls)
+    n_tok = variables["params"]["pos_emb"].shape[0]
+    assert n_tok == (17 if pool == "cls" else 16)
+
+
+def test_bfloat16_compute_fp32_params():
+    model = _tiny(dtype=jnp.bfloat16)
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    leaves = jax.tree_util.tree_leaves(variables["params"])
+    assert all(l.dtype == jnp.float32 for l in leaves)
+    logits = model.apply(variables, x)
+    assert logits.dtype == jnp.float32
+
+
+def test_indivisible_image_rejected():
+    model = _tiny()
+    x = np.zeros((1, 30, 32, 3), np.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        model.init(jax.random.PRNGKey(0), x)
+
+
+def test_dropout_needs_rng_only_in_train():
+    model = _tiny(dropout_rate=0.1)
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    # eval: deterministic, no rng needed
+    a = model.apply(variables, x, train=False)
+    b = model.apply(variables, x, train=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # train: stochastic under an rng
+    c = model.apply(variables, x, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(1)})
+    d = model.apply(variables, x, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(2)})
+    assert not np.allclose(np.asarray(c), np.asarray(d))
+
+
+def test_remat_same_forward():
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    m1, m2 = _tiny(), _tiny(remat=True)
+    variables = m1.init(jax.random.PRNGKey(0), x)
+    np.testing.assert_allclose(
+        np.asarray(m1.apply(variables, x)),
+        np.asarray(m2.apply(variables, x)), rtol=1e-6)
+
+
+def test_remat_with_dropout_trains():
+    # regression: remat must not trace the `train` bool (branching on a
+    # traced bool in `deterministic=not train` crashes)
+    model = _tiny(dropout_rate=0.1, remat=True)
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x, train=True,
+                      rngs={"dropout": jax.random.PRNGKey(1)})
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_dropout_through_step_factory():
+    # regression: dropout models must be trainable via the framework's own
+    # step factory (with_rng threads per-shard dropout keys into the loss)
+    comm = chainermn_tpu.create_communicator("xla")
+    model = _tiny(dropout_rate=0.2)
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=16).astype(np.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2])
+    params = comm.bcast_data(variables["params"])
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.01), comm)
+    step = make_data_parallel_train_step(model, opt, comm, with_rng=True,
+                                         donate=False)
+    state = (params, opt.init(params))
+    k = jax.random.PRNGKey(7)
+    _, m1 = step(state, x, y, k)
+    _, m1b = step(state, x, y, k)
+    _, m2 = step(state, x, y, jax.random.PRNGKey(8))
+    # same key reproduces, different key gives different dropout masks
+    assert float(m1["main/loss"]) == float(m1b["main/loss"])
+    assert float(m1["main/loss"]) != float(m2["main/loss"])
+
+
+def test_dropout_step_factory_grad_accum():
+    comm = chainermn_tpu.create_communicator("xla")
+    model = _tiny(dropout_rate=0.2)
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=16).astype(np.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2])
+    params = comm.bcast_data(variables["params"])
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.01), comm)
+    step = make_data_parallel_train_step(model, opt, comm, with_rng=True,
+                                         grad_accum=2)
+    state = (params, opt.init(params))
+    state, m = step(state, x, y, jax.random.PRNGKey(7))
+    assert np.isfinite(float(m["main/loss"]))
+
+
+def test_data_parallel_training_learns():
+    comm = chainermn_tpu.create_communicator("xla")
+    model = _tiny(d_model=48, n_layers=2)
+    # 4 linearly-separable-ish classes from patch means
+    rng = np.random.RandomState(0)
+    n = 64
+    y = rng.randint(0, 4, size=n).astype(np.int32)
+    x = 0.5 * rng.rand(n, 32, 32, 3).astype(np.float32)
+    x += y[:, None, None, None] * 0.3
+
+    variables = model.init(jax.random.PRNGKey(0), x[:2])
+    params = comm.bcast_data(variables["params"])
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(3e-3), comm)
+    step = make_data_parallel_train_step(model, opt, comm)
+    state = (params, opt.init(params))
+    first = None
+    for i in range(30):
+        state, m = step(state, x, y)
+        if first is None:
+            first = float(m["main/loss"])
+    last = float(m["main/loss"])
+    assert last < first * 0.5, (first, last)
