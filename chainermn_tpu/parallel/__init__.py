@@ -1,7 +1,9 @@
 from .expert_parallel import ExpertParallelMLP, switch_dispatch
 from .pipeline import (
+    build_interleaved_schedule,
     pipeline_1f1b_value_and_grad,
     pipeline_apply,
+    pipeline_interleaved_1f1b_value_and_grad,
     stack_stage_params,
 )
 from .ring_attention import (
@@ -24,6 +26,8 @@ __all__ = [
     "local_attention_reference",
     "pipeline_apply",
     "pipeline_1f1b_value_and_grad",
+    "pipeline_interleaved_1f1b_value_and_grad",
+    "build_interleaved_schedule",
     "stack_stage_params",
     "ColumnParallelDense",
     "RowParallelDense",

@@ -15,7 +15,9 @@ holds its own stage's parameters only.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Dict, NamedTuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -229,4 +231,329 @@ def pipeline_1f1b_value_and_grad(
 
     loss = lax.psum(lacc, axis_name) / m
     grads = jax.tree_util.tree_map(lambda g: g / m, gacc)
+    return loss, grads
+
+
+class InterleavedSchedule(NamedTuple):
+    """Static tick tables for the interleaved 1F1B schedule (all shapes
+    [S, T], int32; invalid entries hold 0 with the valid flag 0)."""
+
+    S: int
+    V: int
+    M: int
+    T: int
+    depth_act: int     # saved-activation ring-buffer depth per chunk
+    depth_fin: int     # forward-inbox depth per chunk
+    depth_bin: int     # backward-inbox depth per chunk
+    f_valid: np.ndarray
+    f_chunk: np.ndarray
+    f_mb: np.ndarray
+    b_valid: np.ndarray
+    b_chunk: np.ndarray
+    b_mb: np.ndarray
+    fr_valid: np.ndarray   # forward-activation receive → inbox write
+    fr_chunk: np.ndarray
+    fr_mb: np.ndarray
+    br_valid: np.ndarray   # backward-cotangent receive → inbox write
+    br_chunk: np.ndarray
+    br_mb: np.ndarray
+
+
+def build_interleaved_schedule(S: int, V: int, M: int) -> InterleavedSchedule:
+    """Event-simulate the interleaved (virtual-chunk) 1F1B schedule.
+
+    Device ``d`` owns logical stages ``{v*S + d : v < V}`` (round-robin, the
+    interleaved placement); every logical hop k→k+1 is a +1 ring transfer.
+    Each synchronized tick a device runs at most ONE forward unit and ONE
+    backward unit; transfers land one tick after the producer. Forward order
+    is the virtual-micro-batch numbering (groups of S micro-batches per
+    chunk, chunks cycled); backward mirrors it with chunks reversed; a
+    device may run ahead of its backward stream by at most the interleaved
+    warmup bound ``(S-d-1)*2 + (V-1)*S``. Greedy list-scheduling under
+    those dependencies reproduces the classic 1F1B tick count exactly at
+    V=1 (T = 2(S-1)+M) and keeps devices busy with other chunks during
+    what a fused-stage pipeline would spend as bubble.
+
+    All tables are static numpy — the compiled step indexes them with
+    ``(axis_index, tick)``, so the whole schedule is data-independent.
+    """
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved schedule needs M % S == 0 (M={M}, S={S})")
+    if V < 1:
+        raise ValueError("V must be >= 1")
+    N = S * V
+    MV = M * V
+
+    order_f = []
+    order_b = []
+    for q in range(MV):
+        mb = (q // N) * S + (q % S)
+        order_f.append(((q % N) // S, mb))
+        order_b.append((V - 1 - (q % N) // S, mb))
+    warm = [min((S - d - 1) * 2 + (V - 1) * S, MV) for d in range(S)]
+
+    f_done: Dict = {}
+    b_done: Dict = {}
+    fi = [0] * S
+    bi = [0] * S
+    events = []  # (tick, device, op, chunk, mb)
+
+    t = 0
+    limit = 20 * (MV + N) + 64
+    while any(b < MV for b in bi):
+        if t >= limit:
+            raise RuntimeError("interleaved schedule did not converge")
+        staged = []
+        for d in range(S):
+            did_f = did_b = None
+            if fi[d] < MV and fi[d] - bi[d] < warm[d] + 1:
+                v, j = order_f[fi[d]]
+                k = v * S + d
+                if k == 0 or f_done.get((k - 1, j), limit) + 1 <= t:
+                    did_f = (v, j)
+            if bi[d] < MV:
+                v, j = order_b[bi[d]]
+                k = v * S + d
+                if k == N - 1:
+                    ft = f_done.get((k, j))
+                    if (ft is not None and ft <= t) or did_f == (v, j):
+                        did_b = (v, j)
+                elif b_done.get((k + 1, j), limit) + 1 <= t:
+                    did_b = (v, j)
+            staged.append((did_f, did_b))
+        for d, (did_f, did_b) in enumerate(staged):
+            if did_f:
+                v, j = did_f
+                f_done[(v * S + d, j)] = t
+                fi[d] += 1
+                events.append((t, d, "F", v, j))
+            if did_b:
+                v, j = did_b
+                b_done[(v * S + d, j)] = t
+                bi[d] += 1
+                events.append((t, d, "B", v, j))
+        t += 1
+    T = t
+
+    def tab():
+        return (np.zeros((S, T), np.int32), np.zeros((S, T), np.int32),
+                np.zeros((S, T), np.int32))
+
+    f_valid, f_chunk, f_mb = tab()
+    b_valid, b_chunk, b_mb = tab()
+    fr_valid, fr_chunk, fr_mb = tab()
+    br_valid, br_chunk, br_mb = tab()
+
+    for (tk, d, op, v, j) in events:
+        if op == "F":
+            f_valid[d, tk], f_chunk[d, tk], f_mb[d, tk] = 1, v, j
+            k = v * S + d
+            if k != N - 1 and tk + 1 < T:
+                # output arrives at device (d+1)%S next tick; at the wrap
+                # (d == S-1) the consumer is the next chunk on device 0
+                rd = (d + 1) % S
+                rv = v + 1 if d == S - 1 else v
+                fr_valid[rd, tk + 1] = 1
+                fr_chunk[rd, tk + 1] = rv
+                fr_mb[rd, tk + 1] = j
+        else:
+            b_valid[d, tk], b_chunk[d, tk], b_mb[d, tk] = 1, v, j
+            k = v * S + d
+            if k != 0 and tk + 1 < T:
+                rd = (d - 1) % S
+                rv = v - 1 if d == 0 else v
+                br_valid[rd, tk + 1] = 1
+                br_chunk[rd, tk + 1] = rv
+                br_mb[rd, tk + 1] = j
+
+    def max_overlap(intervals):
+        pts = []
+        for (a, b) in intervals:
+            pts.append((a, 1))
+            pts.append((b + 1, -1))
+        peak = cur = 0
+        for _, delta in sorted(pts):
+            cur += delta
+            peak = max(peak, cur)
+        return max(peak, 1)
+
+    # ring-buffer depths from the simulated lifetimes (FIFO per chunk, so
+    # mb % depth is collision-free at depth >= max overlap)
+    acts, fins, bins_ = [], [], []
+    for d in range(S):
+        for v in range(V):
+            k = v * S + d
+            acts.append(max_overlap(
+                [(f_done[(k, j)], b_done[(k, j)]) for j in range(M)]))
+            if k != 0:
+                fins.append(max_overlap(
+                    [(f_done[(k - 1, j)] + 1, f_done[(k, j)])
+                     for j in range(M)]))
+            bins_.append(max_overlap(
+                [((f_done[(k, j)] if k == N - 1
+                   else b_done[(k + 1, j)] + 1), b_done[(k, j)])
+                 for j in range(M)]))
+    return InterleavedSchedule(
+        S=S, V=V, M=M, T=T,
+        depth_act=max(acts), depth_fin=max(fins or [1]),
+        depth_bin=max(bins_),
+        f_valid=f_valid, f_chunk=f_chunk, f_mb=f_mb,
+        b_valid=b_valid, b_chunk=b_chunk, b_mb=b_mb,
+        fr_valid=fr_valid, fr_chunk=fr_chunk, fr_mb=fr_mb,
+        br_valid=br_valid, br_chunk=br_chunk, br_mb=br_mb,
+    )
+
+
+def pipeline_interleaved_1f1b_value_and_grad(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    stage_params: Any,
+    x_microbatches,
+    y_microbatches,
+    axis_name: str,
+    n_chunks: int,
+):
+    """Interleaved-1F1B pipeline training step (virtual stages).
+
+    Each device owns ``n_chunks`` (V) non-adjacent pipeline stages —
+    logical stage ``v*S + d`` lives on device ``d`` — so during a plain
+    pipeline's fill/drain bubble a device works on its other chunks. Per
+    tick a device runs at most one sub-stage forward and one backward
+    (in-stage remat, like :func:`pipeline_1f1b_value_and_grad`); the
+    schedule is the static tick table from
+    :func:`build_interleaved_schedule`. Activation cost: three ring
+    buffers per chunk (saved activations + two transfer inboxes) sized by
+    the schedule's in-flight maxima — deeper than non-interleaved 1F1B's
+    2(S−1), the known memory-for-bubble trade of interleaving.
+
+    Args:
+      stage_fn: ``(params, h) -> h`` — ONE sub-stage's compute
+        (shape-preserving, homogeneous pipeline).
+      loss_fn: ``(out, target) -> scalar`` per micro-batch.
+      stage_params: THIS device's chunk parameters, each leaf stacked on a
+        leading ``V`` axis. Arrange the global [N, ...] logical-stage stack
+        as ``[V, S, ...]`` and shard axis 1 over ``axis_name`` (device d
+        then holds rows ``v*S+d`` — the interleaved placement).
+      x_microbatches: [M, mb, ...] inputs, replicated (M % S == 0).
+      y_microbatches: [M, ...] targets, replicated.
+      axis_name: the stage mesh axis.
+      n_chunks: V, virtual stages per device.
+
+    Returns ``(loss, grads)``: mean loss over micro-batches (replicated)
+    and the gradient w.r.t. THIS device's ``stage_params`` (same [V, ...]
+    stacking).
+    """
+    S = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    V = n_chunks
+    N = S * V
+    m = x_microbatches.shape[0]
+    mb_shape = x_microbatches.shape[1:]
+
+    chunk0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    act_dtype = _stage_act_dtype(stage_fn, chunk0, mb_shape,
+                                 x_microbatches.dtype)
+
+    sched = build_interleaved_schedule(S, V, m)
+    T, Da, Df, Db = (sched.T, sched.depth_act, sched.depth_fin,
+                     sched.depth_bin)
+    tabs = {k: jnp.asarray(getattr(sched, k)) for k in (
+        "f_valid", "f_chunk", "f_mb", "b_valid", "b_chunk", "b_mb",
+        "fr_valid", "fr_chunk", "fr_mb", "br_valid", "br_chunk", "br_mb")}
+
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def zeros_buf(depth):
+        return match_vma(jnp.zeros((V, depth) + mb_shape, act_dtype), my)
+
+    def buf_read(buf, chunk, slot):
+        sl = lax.dynamic_slice(
+            buf, (chunk, slot) + (0,) * len(mb_shape),
+            (1, 1) + mb_shape)
+        return sl.reshape(mb_shape)
+
+    def buf_write(buf, chunk, slot, val, valid):
+        cur = buf_read(buf, chunk, slot)
+        new = jnp.where(valid, val.astype(buf.dtype), cur)
+        return lax.dynamic_update_slice(
+            buf, new[(None, None)], (chunk, slot) + (0,) * len(mb_shape))
+
+    carry0 = dict(
+        fin=zeros_buf(Df),
+        bin=zeros_buf(Db),
+        act=zeros_buf(Da),
+        y_send=match_vma(jnp.zeros(mb_shape, act_dtype), my),
+        g_send=match_vma(jnp.zeros(mb_shape, act_dtype), my),
+        gacc=match_vma(
+            jax.tree_util.tree_map(jnp.zeros_like, stage_params), my),
+        lacc=match_vma(jnp.zeros((), jnp.float32), my),
+    )
+
+    def chunk_params(c):
+        return jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            stage_params)
+
+    def tick(t, carry):
+        # 1. land last tick's transfers in the inboxes
+        y_recv = lax.ppermute(carry["y_send"], axis_name, fwd_perm)
+        g_recv = lax.ppermute(carry["g_send"], axis_name, bwd_perm)
+        frv = tabs["fr_valid"][my, t]
+        fin = buf_write(carry["fin"], tabs["fr_chunk"][my, t],
+                        tabs["fr_mb"][my, t] % Df, y_recv, frv)
+        brv = tabs["br_valid"][my, t]
+        bin_ = buf_write(carry["bin"], tabs["br_chunk"][my, t],
+                         tabs["br_mb"][my, t] % Db, g_recv, brv)
+
+        # 2. forward unit
+        fv = tabs["f_valid"][my, t]
+        fc = tabs["f_chunk"][my, t]
+        fm = tabs["f_mb"][my, t]
+        k_f = fc * S + my
+        feed = lax.dynamic_index_in_dim(
+            x_microbatches, fm, axis=0, keepdims=False).astype(act_dtype)
+        h_in = jnp.where(k_f == 0, feed, buf_read(fin, fc, fm % Df))
+        y_f = stage_fn(chunk_params(fc), h_in)
+        tgt = lax.dynamic_index_in_dim(
+            y_microbatches, fm, axis=0, keepdims=False)
+        loss_j, dldy = jax.value_and_grad(loss_fn)(y_f, tgt)
+        is_last_f = jnp.logical_and(fv, k_f == N - 1)
+        lacc = carry["lacc"] + jnp.where(is_last_f, loss_j, 0.0)
+        # the last logical stage's cotangent is produced locally
+        bin_ = buf_write(bin_, V - 1, fm % Db, dldy, is_last_f)
+
+        # 3. backward unit (reads inboxes/activations, then F's act lands)
+        bv = tabs["b_valid"][my, t]
+        bc = tabs["b_chunk"][my, t]
+        bm = tabs["b_mb"][my, t]
+        k_b = bc * S + my
+        g_in = buf_read(bin_, bc, bm % Db)
+        same_tick = jnp.logical_and(
+            jnp.logical_and(k_b == N - 1, is_last_f), bm == fm)
+        h_bwd = jnp.where(same_tick, h_in,
+                          buf_read(carry["act"], bc, bm % Da))
+        act = buf_write(carry["act"], fc, fm % Da, h_in, fv)
+        _, vjp_fn = jax.vjp(stage_fn, chunk_params(bc), h_bwd)
+        gp, gh = vjp_fn(g_in.astype(act_dtype))
+        # where, not multiply: bubble ticks run the vjp on zero-filled
+        # buffers, and 0 * NaN would poison the accumulator
+        gacc = jax.tree_util.tree_map(
+            lambda a, g: lax.dynamic_update_index_in_dim(
+                a, lax.dynamic_index_in_dim(a, bc, 0, keepdims=False)
+                + jnp.where(bv != 0, g, jnp.zeros_like(g)), bc, axis=0),
+            carry["gacc"], gp)
+
+        # 4. this tick's transfers
+        y_send = jnp.where(jnp.logical_and(fv, k_f != N - 1), y_f,
+                           jnp.zeros_like(y_f))
+        g_send = jnp.where(jnp.logical_and(bv, k_b != 0), gh,
+                           jnp.zeros_like(gh)).astype(act_dtype)
+        return dict(fin=fin, bin=bin_, act=act, y_send=y_send,
+                    g_send=g_send, gacc=gacc, lacc=lacc)
+
+    out = lax.fori_loop(0, T, tick, carry0)
+    loss = lax.psum(out["lacc"], axis_name) / m
+    grads = jax.tree_util.tree_map(lambda g: g / m, out["gacc"])
     return loss, grads
