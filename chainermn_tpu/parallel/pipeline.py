@@ -413,6 +413,8 @@ def pipeline_interleaved_1f1b_value_and_grad(
     y_microbatches,
     axis_name: str,
     n_chunks: int,
+    head_params: Any = None,
+    return_input_grads: bool = False,
 ):
     """Interleaved-1F1B pipeline training step (virtual stages).
 
@@ -439,10 +441,24 @@ def pipeline_interleaved_1f1b_value_and_grad(
       y_microbatches: [M, ...] targets, replicated.
       axis_name: the stage mesh axis.
       n_chunks: V, virtual stages per device.
+      head_params: optional pytree of loss-side trainable parameters (an
+        LM head / classifier). When given, ``loss_fn`` is called as
+        ``loss_fn(head_params, out, target)`` and their gradient is
+        returned — this is how a real model's head trains through the
+        pipeline (the head runs on the last logical stage's device and
+        its grads are psum-replicated).
+      return_input_grads: also return d(loss)/d(x_microbatches) — the
+        cotangents leaving logical stage 0 — so the caller can backprop
+        into whatever produced the inputs (an embedding) with its own
+        ``jax.vjp``. Composition contract: embed outside → pipeline →
+        head inside ``loss_fn``.
 
-    Returns ``(loss, grads)``: mean loss over micro-batches (replicated)
-    and the gradient w.r.t. THIS device's ``stage_params`` (same [V, ...]
-    stacking).
+    Returns ``(loss, grads)``, or ``(loss, grads, aux)`` when
+    ``head_params``/``return_input_grads`` is set, with
+    ``aux['head_grads']`` (replicated) and/or ``aux['input_grads']``
+    ([M, mb, ...], replicated). ``loss`` is the micro-batch mean
+    (replicated); ``grads`` is w.r.t. THIS device's ``stage_params``
+    (same [V, ...] stacking).
     """
     S = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -490,6 +506,18 @@ def pipeline_interleaved_1f1b_value_and_grad(
             jax.tree_util.tree_map(jnp.zeros_like, stage_params), my),
         lacc=match_vma(jnp.zeros((), jnp.float32), my),
     )
+    if head_params is not None:
+        carry0["hacc"] = match_vma(
+            jax.tree_util.tree_map(jnp.zeros_like, head_params), my)
+        # pcast to varying BEFORE differentiating: the grad w.r.t. an
+        # axis-invariant (replicated) pytree is auto-psummed by shard_map's
+        # vma tracking, which would fold every device's (mostly garbage,
+        # masked-out) head contribution into each device's dhp before the
+        # is_last_f mask can filter them
+        head_params_v = match_vma(head_params, my)
+    if return_input_grads:
+        carry0["dxs"] = match_vma(
+            jnp.zeros((m,) + mb_shape, jnp.float32), my)
 
     def chunk_params(c):
         return jax.tree_util.tree_map(
@@ -518,8 +546,33 @@ def pipeline_interleaved_1f1b_value_and_grad(
         y_f = stage_fn(chunk_params(fc), h_in)
         tgt = lax.dynamic_index_in_dim(
             y_microbatches, fm, axis=0, keepdims=False)
-        loss_j, dldy = jax.value_and_grad(loss_fn)(y_f, tgt)
         is_last_f = jnp.logical_and(fv, k_f == N - 1)
+        hacc = carry.get("hacc")
+        if head_params is None:
+            loss_j, dldy = jax.value_and_grad(loss_fn)(y_f, tgt)
+        else:
+            # cond, not masking: the head (an LM's d_model x vocab matmul +
+            # backward) runs only on the last logical stage's M forward
+            # ticks instead of on every device every tick. Safe under
+            # shard_map because loss_fn must not contain collectives.
+            def _head_fwd_bwd(yv):
+                lj, (dy, dh) = jax.value_and_grad(
+                    lambda y, hp: loss_fn(hp, y, tgt), argnums=(0, 1))(
+                        yv, head_params_v)
+                return lj.astype(jnp.float32), dy, dh
+
+            def _head_skip(yv):
+                # fresh zeros are axis-invariant; pcast to match the real
+                # branch's varying outputs or cond rejects the branch types
+                return match_vma(
+                    (jnp.zeros((), jnp.float32), jnp.zeros_like(yv),
+                     jax.tree_util.tree_map(jnp.zeros_like,
+                                            head_params_v)), my)
+
+            loss_j, dldy, dhp = lax.cond(
+                is_last_f, _head_fwd_bwd, _head_skip, y_f)
+            hacc = jax.tree_util.tree_map(
+                lambda a, g: a + g, hacc, dhp)
         lacc = carry["lacc"] + jnp.where(is_last_f, loss_j, 0.0)
         # the last logical stage's cotangent is produced locally
         bin_ = buf_write(bin_, V - 1, fm % Db, dldy, is_last_f)
@@ -550,10 +603,35 @@ def pipeline_interleaved_1f1b_value_and_grad(
                            jnp.zeros_like(y_f))
         g_send = jnp.where(jnp.logical_and(bv, k_b != 0), gh,
                            jnp.zeros_like(gh)).astype(act_dtype)
-        return dict(fin=fin, bin=bin_, act=act, y_send=y_send,
-                    g_send=g_send, gacc=gacc, lacc=lacc)
+        new = dict(fin=fin, bin=bin_, act=act, y_send=y_send,
+                   g_send=g_send, gacc=gacc, lacc=lacc)
+        if hacc is not None:
+            new["hacc"] = hacc
+        if return_input_grads:
+            # cotangent leaving logical stage 0 = d(loss_mb)/d(x_mb)
+            is_first_b = jnp.logical_and(bv, k_b == 0)
+            cur = lax.dynamic_index_in_dim(carry["dxs"], bm, axis=0,
+                                           keepdims=False)
+            val = jnp.where(is_first_b, gh.astype(jnp.float32), cur)
+            new["dxs"] = lax.dynamic_update_index_in_dim(
+                carry["dxs"], val, bm, axis=0)
+        return new
 
     out = lax.fori_loop(0, T, tick, carry0)
     loss = lax.psum(out["lacc"], axis_name) / m
     grads = jax.tree_util.tree_map(lambda g: g / m, out["gacc"])
-    return loss, grads
+    if head_params is None and not return_input_grads:
+        return loss, grads
+    aux = {}
+    if head_params is not None:
+        # the head ran on the last logical stage's device only
+        aux["head_grads"] = jax.tree_util.tree_map(
+            lambda h: lax.psum(h, axis_name) / m, out["hacc"])
+    if return_input_grads:
+        # nonzero only on device 0 (owner of logical stage 0); cast back to
+        # the input dtype so the caller's emb_vjp cotangent matches its
+        # primal (accumulation itself stays f32)
+        aux["input_grads"] = (
+            lax.psum(out["dxs"], axis_name) / m
+        ).astype(x_microbatches.dtype)
+    return loss, grads, aux

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Pipeline-parallel Transformer LM with the interleaved 1F1B schedule.
+
+Composition contract (parallel/pipeline.py): embedding runs outside the
+pipeline (its gradient returns through ``input_grads``), TransformerBlocks
+are the homogeneous stages — logical stage v*S+d on device d (virtual
+chunks) — and the LM head trains inside ``loss_fn`` via ``head_params``.
+One optax update covers all three parameter groups.
+
+Beyond the reference's surface: upstream pipeline usage is
+MultiNodeChainList's sequential fill/drain (SURVEY.md §2.6); this example
+is the micro-batched, interleaved schedule on a real LM.
+
+Run (8 virtual devices):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/pipeline_lm/train_pipeline_lm.py --steps 20
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import jax
+from chainermn_tpu.utils import ensure_platform
+
+ensure_platform()
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from chainermn_tpu.models.transformer import TransformerBlock
+from chainermn_tpu.parallel import (
+    pipeline_interleaved_1f1b_value_and_grad,
+    stack_stage_params,
+)
+
+
+class EmbedIn(nn.Module):
+    vocab: int
+    d_model: int
+    max_len: int
+
+    @nn.compact
+    def __call__(self, toks):
+        x = nn.Embed(self.vocab, self.d_model, name="tok")(toks)
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (self.max_len, self.d_model))
+        return x + pos[None, : toks.shape[-1]]
+
+
+class HeadOut(nn.Module):
+    vocab: int
+
+    @nn.compact
+    def __call__(self, h):
+        h = nn.LayerNorm()(h)
+        return nn.Dense(self.vocab, use_bias=False, name="out")(h)
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="ChainerMN-TPU example: pipeline-parallel LM")
+    p.add_argument("--stages-per-device", "-V", type=int, default=2)
+    p.add_argument("--n-pipeline", "-S", type=int, default=None,
+                   help="pipeline depth in devices (default: all)")
+    p.add_argument("--microbatches", "-M", type=int, default=None,
+                   help="micro-batches per step (default: 2*S)")
+    p.add_argument("--mb-size", type=int, default=4)
+    p.add_argument("--seq-len", "-L", type=int, default=32)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--d-ff", type=int, default=128)
+    p.add_argument("--attention", default="flash",
+                   choices=["flash", "reference"])
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=3e-3)
+    args = p.parse_args()
+
+    S = args.n_pipeline or jax.device_count()
+    V = args.stages_per_device
+    M = args.microbatches or 2 * S
+    N = S * V
+    mesh = Mesh(np.array(jax.devices()[:S]), ("stage",))
+    print(f"pipeline: {S} devices x {V} chunks = {N} blocks, "
+          f"{M} micro-batches of {args.mb_size}x{args.seq_len}")
+
+    block = TransformerBlock(
+        d_model=args.d_model, n_heads=args.n_heads, d_ff=args.d_ff,
+        attention=args.attention)
+    embed = EmbedIn(args.vocab, args.d_model, args.seq_len)
+    head = HeadOut(args.vocab)
+
+    rng = jax.random.PRNGKey(0)
+    toks0 = np.zeros((args.mb_size, args.seq_len), np.int32)
+    h0 = np.zeros((args.mb_size, args.seq_len, args.d_model), np.float32)
+    emb_p = embed.init(rng, toks0)["params"]
+    stage_p = stack_stage_params([
+        block.init(jax.random.fold_in(rng, k), h0)["params"]
+        for k in range(N)])
+    stage_p = jax.tree_util.tree_map(
+        lambda q: q.reshape((V, S) + q.shape[1:]), stage_p)
+    head_p = head.init(jax.random.fold_in(rng, 999), h0)["params"]
+    params = (emb_p, stage_p, head_p)
+    opt = optax.adam(args.lr)
+    opt_state = opt.init(params)
+
+    def head_loss(hp, out, tgt):
+        logits = head.apply({"params": hp}, out)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    def stage_fn(sp, h):
+        return block.apply({"params": sp}, h)
+
+    def pipe(sp, hp, x_mb, tgts):
+        sp = jax.tree_util.tree_map(lambda q: q.squeeze(1), sp)
+        loss, g, aux = pipeline_interleaved_1f1b_value_and_grad(
+            stage_fn, head_loss, sp, x_mb, tgts, "stage", V,
+            head_params=hp, return_input_grads=True)
+        return (loss, jax.tree_util.tree_map(lambda q: q[:, None], g),
+                aux["head_grads"], aux["input_grads"])
+
+    pipe_sm = shard_map(
+        pipe, mesh=mesh,
+        in_specs=(P(None, "stage"), P(), P(), P()),
+        out_specs=(P(), P(None, "stage"), P(), P()))
+
+    @jax.jit
+    def train_step(params, opt_state, toks, tgts):
+        emb_p, stage_p, head_p = params
+        x_mb, emb_vjp = jax.vjp(
+            lambda ep: jax.vmap(
+                lambda t: embed.apply({"params": ep}, t))(toks), emb_p)
+        loss, sgrads, hgrads, dxs = pipe_sm(stage_p, head_p, x_mb, tgts)
+        (degrads,) = emb_vjp(dxs)
+        grads = (degrads, sgrads, hgrads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # synthetic next-token data with learnable structure: each sequence
+    # cycles through the vocab from a random start
+    data_rng = np.random.RandomState(0)
+
+    def batch():
+        start = data_rng.randint(0, args.vocab,
+                                 size=(M, args.mb_size, 1))
+        seq = (start + np.arange(args.seq_len + 1)) % args.vocab
+        return (jnp.asarray(seq[..., :-1], jnp.int32),
+                jnp.asarray(seq[..., 1:], jnp.int32))
+
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        toks, tgts = batch()
+        params, opt_state, loss = train_step(params, opt_state, toks, tgts)
+        if step == 0 or (step + 1) % 10 == 0:
+            print(f"step {step + 1:4d}  loss {float(loss):.4f}  "
+                  f"({time.perf_counter() - t0:.1f}s)")
+    print(f"final loss: {float(loss):.4f}")
+    return float(loss)
+
+
+if __name__ == "__main__":
+    main()
