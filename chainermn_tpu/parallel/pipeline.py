@@ -119,6 +119,54 @@ def stack_stage_params(params_list):
     )
 
 
+def _head_loss_grads(loss_fn, head_params_v, is_last, y, tgt, my):
+    """Loss value + output/head cotangents for the last stage's tick,
+    cond-guarded so the head (an LM's d_model x vocab matmul + backward)
+    runs only where the mask is true. ``loss_fn(head, out, tgt)`` must not
+    contain collectives (cond branches diverge per device). The head pytree
+    must already be pcast to varying (``head_params_v``) — differentiating
+    the replicated original would auto-psum every device's masked-out
+    contribution into each device's gradient under shard_map's vma
+    autodiff."""
+
+    def _fwd_bwd(yv):
+        lj, (dy, dh) = jax.value_and_grad(
+            lambda y_, hp: loss_fn(hp, y_, tgt), argnums=(0, 1))(
+                yv, head_params_v)
+        return lj.astype(jnp.float32), dy, dh
+
+    def _skip(yv):
+        # fresh zeros are axis-invariant; pcast to match the real branch
+        return match_vma(
+            (jnp.zeros((), jnp.float32), jnp.zeros_like(yv),
+             jax.tree_util.tree_map(jnp.zeros_like, head_params_v)), my)
+
+    return lax.cond(is_last, _fwd_bwd, _skip, y)
+
+
+def _masked_slot_write(buf, idx, val, valid):
+    """buf[idx] = val where valid (read-modify-write, NaN-safe)."""
+    cur = lax.dynamic_index_in_dim(buf, idx, axis=0, keepdims=False)
+    new = jnp.where(valid, val.astype(buf.dtype), cur)
+    return lax.dynamic_update_index_in_dim(buf, new, idx, axis=0)
+
+
+def _pipeline_aux(out, axis_name, m, x_dtype, head_params,
+                  return_input_grads):
+    """Assemble the optional aux dict shared by both 1F1B kernels."""
+    aux = {}
+    if head_params is not None:
+        # the head ran on the last logical stage's device only
+        aux["head_grads"] = jax.tree_util.tree_map(
+            lambda h: lax.psum(h, axis_name) / m, out["hacc"])
+    if return_input_grads:
+        # nonzero only on the owner of logical stage 0; cast back to the
+        # input dtype so the caller's emb_vjp cotangent matches its primal
+        aux["input_grads"] = (
+            lax.psum(out["dxs"], axis_name) / m).astype(x_dtype)
+    return aux
+
+
 def pipeline_1f1b_value_and_grad(
     stage_fn: Callable,
     loss_fn: Callable,
@@ -126,6 +174,8 @@ def pipeline_1f1b_value_and_grad(
     x_microbatches,
     y_microbatches,
     axis_name: str,
+    head_params: Any = None,
+    return_input_grads: bool = False,
 ):
     """1F1B-scheduled pipeline training step (loss + per-stage grads).
 
@@ -153,9 +203,14 @@ def pipeline_1f1b_value_and_grad(
       x_microbatches: [M, mb, ...] inputs, replicated across shards.
       y_microbatches: [M, ...] per-micro-batch targets, replicated.
       axis_name: the stage mesh axis.
+      head_params / return_input_grads: the same composition hooks as
+        :func:`pipeline_interleaved_1f1b_value_and_grad` — a loss-side
+        trainable pytree (``loss_fn(head_params, out, tgt)``; ``loss_fn``
+        must not contain collectives) and the stage-0 input cotangents.
 
-    Returns ``(loss, grads)``: the mean loss (replicated) and the gradient
-    of it w.r.t. THIS shard's ``stage_params``.
+    Returns ``(loss, grads)``, plus an ``aux`` dict (``head_grads``,
+    ``input_grads``) when either hook is set: the mean loss (replicated)
+    and the gradient of it w.r.t. THIS shard's ``stage_params``.
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -176,9 +231,20 @@ def pipeline_1f1b_value_and_grad(
     gacc0 = match_vma(
         jax.tree_util.tree_map(jnp.zeros_like, stage_params), my)
     lacc0 = match_vma(jnp.zeros((), jnp.float32), my)
+    carry0 = dict(h=h0, g=g0, buf=buf0, gacc=gacc0, lacc=lacc0)
+    if head_params is not None:
+        carry0["hacc"] = match_vma(
+            jax.tree_util.tree_map(jnp.zeros_like, head_params), my)
+        # see the interleaved kernel: differentiate against a varying copy
+        # or vma autodiff psums every device's masked-out contribution in
+        head_params_v = match_vma(head_params, my)
+    if return_input_grads:
+        carry0["dxs"] = match_vma(
+            jnp.zeros((m,) + mb_shape, jnp.float32), my)
 
     def tick(t, carry):
-        h_ring, g_ring, buf, gacc, lacc = carry
+        h_ring, g_ring, buf = carry["h"], carry["g"], carry["buf"]
+        gacc, lacc = carry["gacc"], carry["lacc"]
         mb_f = t - my                       # micro-batch in forward here
         v_f = jnp.logical_and(mb_f >= 0, mb_f < m)
         mb_b = t - (2 * (n - 1) - my)       # micro-batch in backward here
@@ -211,9 +277,15 @@ def pipeline_1f1b_value_and_grad(
         # loss value + cotangent, meaningful on the last stage only
         tgt = lax.dynamic_index_in_dim(
             y_microbatches, jnp.clip(mb_f, 0, m - 1), axis=0, keepdims=False)
-        loss_j, dldy = jax.value_and_grad(loss_fn)(y_fwd, tgt)
-        lacc = lacc + jnp.where(
-            jnp.logical_and(v_f, my == n - 1), loss_j, 0.0)
+        is_last_f = jnp.logical_and(v_f, my == n - 1)
+        hacc = carry.get("hacc")
+        if head_params is None:
+            loss_j, dldy = jax.value_and_grad(loss_fn)(y_fwd, tgt)
+        else:
+            loss_j, dldy, dhp = _head_loss_grads(
+                loss_fn, head_params_v, is_last_f, y_fwd, tgt, my)
+            hacc = jax.tree_util.tree_map(lambda a, g: a + g, hacc, dhp)
+        lacc = lacc + jnp.where(is_last_f, loss_j, 0.0)
 
         # backward step: rematerialize the stage at the saved activation
         g_in = jnp.where(my == n - 1, dldy.astype(act_dtype), g_ring)
@@ -224,14 +296,25 @@ def pipeline_1f1b_value_and_grad(
 
         h_next = lax.ppermute(jnp.where(v_f, y_fwd, 0), axis_name, fwd_perm)
         g_next = lax.ppermute(jnp.where(v_b, gh, 0), axis_name, bwd_perm)
-        return h_next, g_next, buf, gacc, lacc
+        new = dict(h=h_next, g=g_next, buf=buf, gacc=gacc, lacc=lacc)
+        if hacc is not None:
+            new["hacc"] = hacc
+        if return_input_grads:
+            is_first_b = jnp.logical_and(v_b, my == 0)
+            new["dxs"] = _masked_slot_write(
+                carry["dxs"], jnp.clip(mb_b, 0, m - 1),
+                gh.astype(jnp.float32), is_first_b)
+        return new
 
-    _, _, _, gacc, lacc = lax.fori_loop(
-        0, ticks, tick, (h0, g0, buf0, gacc0, lacc0))
+    out = lax.fori_loop(0, ticks, tick, carry0)
 
-    loss = lax.psum(lacc, axis_name) / m
-    grads = jax.tree_util.tree_map(lambda g: g / m, gacc)
-    return loss, grads
+    loss = lax.psum(out["lacc"], axis_name) / m
+    grads = jax.tree_util.tree_map(lambda g: g / m, out["gacc"])
+    if head_params is None and not return_input_grads:
+        return loss, grads
+    return loss, grads, _pipeline_aux(
+        out, axis_name, m, x_microbatches.dtype, head_params,
+        return_input_grads)
 
 
 class InterleavedSchedule(NamedTuple):
@@ -551,26 +634,8 @@ def pipeline_interleaved_1f1b_value_and_grad(
         if head_params is None:
             loss_j, dldy = jax.value_and_grad(loss_fn)(y_f, tgt)
         else:
-            # cond, not masking: the head (an LM's d_model x vocab matmul +
-            # backward) runs only on the last logical stage's M forward
-            # ticks instead of on every device every tick. Safe under
-            # shard_map because loss_fn must not contain collectives.
-            def _head_fwd_bwd(yv):
-                lj, (dy, dh) = jax.value_and_grad(
-                    lambda y, hp: loss_fn(hp, y, tgt), argnums=(0, 1))(
-                        yv, head_params_v)
-                return lj.astype(jnp.float32), dy, dh
-
-            def _head_skip(yv):
-                # fresh zeros are axis-invariant; pcast to match the real
-                # branch's varying outputs or cond rejects the branch types
-                return match_vma(
-                    (jnp.zeros((), jnp.float32), jnp.zeros_like(yv),
-                     jax.tree_util.tree_map(jnp.zeros_like,
-                                            head_params_v)), my)
-
-            loss_j, dldy, dhp = lax.cond(
-                is_last_f, _head_fwd_bwd, _head_skip, y_f)
+            loss_j, dldy, dhp = _head_loss_grads(
+                loss_fn, head_params_v, is_last_f, y_f, tgt, my)
             hacc = jax.tree_util.tree_map(
                 lambda a, g: a + g, hacc, dhp)
         lacc = carry["lacc"] + jnp.where(is_last_f, loss_j, 0.0)
@@ -610,11 +675,8 @@ def pipeline_interleaved_1f1b_value_and_grad(
         if return_input_grads:
             # cotangent leaving logical stage 0 = d(loss_mb)/d(x_mb)
             is_first_b = jnp.logical_and(bv, k_b == 0)
-            cur = lax.dynamic_index_in_dim(carry["dxs"], bm, axis=0,
-                                           keepdims=False)
-            val = jnp.where(is_first_b, gh.astype(jnp.float32), cur)
-            new["dxs"] = lax.dynamic_update_index_in_dim(
-                carry["dxs"], val, bm, axis=0)
+            new["dxs"] = _masked_slot_write(
+                carry["dxs"], bm, gh.astype(jnp.float32), is_first_b)
         return new
 
     out = lax.fori_loop(0, T, tick, carry0)
@@ -622,16 +684,6 @@ def pipeline_interleaved_1f1b_value_and_grad(
     grads = jax.tree_util.tree_map(lambda g: g / m, out["gacc"])
     if head_params is None and not return_input_grads:
         return loss, grads
-    aux = {}
-    if head_params is not None:
-        # the head ran on the last logical stage's device only
-        aux["head_grads"] = jax.tree_util.tree_map(
-            lambda h: lax.psum(h, axis_name) / m, out["hacc"])
-    if return_input_grads:
-        # nonzero only on device 0 (owner of logical stage 0); cast back to
-        # the input dtype so the caller's emb_vjp cotangent matches its
-        # primal (accumulation itself stays f32)
-        aux["input_grads"] = (
-            lax.psum(out["dxs"], axis_name) / m
-        ).astype(x_microbatches.dtype)
-    return loss, grads, aux
+    return loss, grads, _pipeline_aux(
+        out, axis_name, m, x_microbatches.dtype, head_params,
+        return_input_grads)

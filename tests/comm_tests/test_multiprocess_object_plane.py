@@ -99,9 +99,16 @@ def test_two_process_object_plane(tmp_path):
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=110)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=110)
+            outs.append(out)
+    finally:
+        # a worker that died early leaves its peer hung in a collective;
+        # kill both so a failure doesn't leak processes past the test
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"WORKER{i} OK" in out

@@ -253,6 +253,64 @@ def test_full_model_composition_embed_head():
                                rtol=2e-4, atol=1e-6)
 
 
+def test_full_model_composition_classic_1f1b():
+    """The classic (non-interleaved) 1F1B carries the same composition
+    hooks: head_params + return_input_grads vs the full-model oracle."""
+    S, M, VOCAB = 4, 8, 12
+    rng = np.random.RandomState(1)
+    full = _full_params(S, 11)
+    emb = jnp.asarray(rng.randn(VOCAB, DIM).astype(np.float32) * 0.3)
+    head = {"w": jnp.asarray(rng.randn(DIM, VOCAB).astype(np.float32) * 0.3)}
+    toks = jnp.asarray(rng.randint(0, VOCAB, size=(M, 2)).astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, VOCAB, size=(M, 2)).astype(np.int32))
+
+    def head_loss(hp, out, tgt):
+        lp = jax.nn.log_softmax(out @ hp["w"])
+        return -jnp.mean(jnp.take_along_axis(lp, tgt[..., None],
+                                             -1).squeeze(-1))
+
+    def oracle(params):
+        e, fp, hp = params
+
+        def one(j):
+            h = e[toks[j]]
+            for k in range(S):
+                h = _stage_fn({"w": fp["w"][k], "b": fp["b"][k]}, h)
+            return head_loss(hp, h, labels[j])
+
+        return sum(one(j) for j in range(M)) / M
+
+    ref_loss, (ref_de, ref_dfp, ref_dhp) = jax.value_and_grad(oracle)(
+        (emb, full, head))
+
+    def fn(sp, hp, xs, ys):
+        sp = jax.tree_util.tree_map(lambda p: p.squeeze(0), sp)
+        loss, g, aux = pipeline_1f1b_value_and_grad(
+            _stage_fn, head_loss, sp, xs, ys, "stage",
+            head_params=hp, return_input_grads=True)
+        return (loss, jax.tree_util.tree_map(lambda p: p[None], g),
+                aux["head_grads"], aux["input_grads"])
+
+    x_mb, emb_vjp = jax.vjp(lambda e: e[toks], emb)
+    loss, grads, hgrads, dx = jax.jit(shard_map(
+        fn, mesh=_mesh(S),
+        in_specs=(P("stage"), P(), P(), P()),
+        out_specs=(P(), P("stage"), P(), P()),
+    ))(full, head, x_mb, labels)
+    (d_emb,) = emb_vjp(dx)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(ref_dfp[k]),
+                                   rtol=2e-4, atol=1e-6, err_msg=k)
+    np.testing.assert_allclose(np.asarray(hgrads["w"]),
+                               np.asarray(ref_dhp["w"]),
+                               rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(d_emb), np.asarray(ref_de),
+                               rtol=2e-4, atol=1e-6)
+
+
 def test_nan_prone_stage_survives_bubble_ticks():
     """Bubble ticks run the vjp on zero-filled buffers; a stage whose
     gradient is non-finite at zero input (norm without eps) must still
