@@ -19,6 +19,7 @@ reference's node-level object plane. Transport:
 from __future__ import annotations
 
 import pickle
+import threading
 from typing import Any, List, Optional
 
 import numpy as np
@@ -27,6 +28,43 @@ import jax
 
 # KV-store chunk bound: coordinator values are strings; keep chunks modest.
 _KV_CHUNK = 4 * 1024 * 1024
+
+# Fail-fast granularity: long waits are sliced into probes of this length so
+# a dead coordinator is detected in O(seconds), not after the full budget
+# (the reference gets this from MPI_Abort killing the world; here a crashed
+# coordinator host would otherwise leave peers retrying gRPC for minutes).
+_PROBE_MS = 10_000
+
+# seeded by every ObjectPlane at construction; read by the liveness probes
+_ALIVE_KEY = "og/liveness/seed"
+
+# set by post_abort (the global except hook's MPI_Abort analog); checked by
+# every liveness probe so peers of a crashed rank raise within one probe
+# interval instead of waiting out their collective budgets
+_ABORT_KEY = "og/abort"
+
+
+class JobAbortedError(RuntimeError):
+    """Another process declared the job dead (global except hook)."""
+
+
+def post_abort(reason: str) -> None:
+    """Mark the job aborted for every peer (best-effort, bounded).
+
+    The crashing process may be the coordinator host, where a graceful
+    ``jax.distributed.shutdown()`` can block forever waiting for peers that
+    are themselves stuck in collectives — so this posts a poison key with a
+    short thread-guarded budget and swallows every failure (if the
+    coordinator is already gone, peers fail fast via the liveness probe
+    instead)."""
+    client = _client()
+    if client is None:
+        return
+    try:
+        _guard_rpc(lambda: client.key_value_set(
+            _ABORT_KEY, reason[:512]), budget_ms=5_000)
+    except Exception:
+        pass
 
 
 def _client():
@@ -66,6 +104,19 @@ class ObjectPlane:
             ObjectPlane._seq_client = client
             ObjectPlane._seq.clear()
         self._p2p_seq = ObjectPlane._seq
+        if client is not None and self.process_count > 1:
+            # seed the liveness key the fail-fast probes read: a get on it
+            # returns instantly while the coordinator lives, so any error
+            # (incl. client-side deadline) means the coordinator is gone
+            try:
+                client.key_value_set(_ALIVE_KEY, "1", allow_overwrite=True)
+            except TypeError:  # older client without allow_overwrite
+                try:
+                    client.key_value_set(_ALIVE_KEY, "1")
+                except Exception:
+                    pass
+            except Exception:
+                pass
 
     # -- collectives ----------------------------------------------------
 
@@ -98,11 +149,10 @@ class ObjectPlane:
         if self.process_count == 1:
             return [obj]
         # KV-store allgather: every process publishes, barriers, reads all.
-        client = _client()
         seq = self._next_seq("allgather")
         key = f"og/ag/{seq}"
         self._kv_put(f"{key}/{self.process_index}", pickle.dumps(obj))
-        client.wait_at_barrier(f"{key}/barrier", 60_000)
+        self._barrier(f"{key}/barrier", 60_000)
         return [
             pickle.loads(self._kv_get(f"{key}/{i}"))
             for i in range(self.process_count)
@@ -112,11 +162,10 @@ class ObjectPlane:
         if self.process_count == 1:
             return [obj]
         # like allgather, but only root pays the N reads
-        client = _client()
         seq = self._next_seq("gather")
         key = f"og/g/{seq}"
         self._kv_put(f"{key}/{self.process_index}", pickle.dumps(obj))
-        client.wait_at_barrier(f"{key}/barrier", 600_000)
+        self._barrier(f"{key}/barrier", 600_000)
         if self.process_index != root:
             return None
         return [
@@ -128,7 +177,6 @@ class ObjectPlane:
         if self.process_count == 1:
             assert objs is not None
             return objs[0]
-        client = _client()
         seq = self._next_seq("scatter")
         key = f"og/sc/{seq}"
         if self.process_index == root:
@@ -136,7 +184,7 @@ class ObjectPlane:
             for i, o in enumerate(objs):
                 if i != root:
                     self._kv_put(f"{key}/{i}", pickle.dumps(o))
-        client.wait_at_barrier(f"{key}/barrier", 600_000)
+        self._barrier(f"{key}/barrier", 600_000)
         if self.process_index == root:
             return objs[self.process_index]
         return pickle.loads(self._kv_get(f"{key}/{self.process_index}"))
@@ -170,17 +218,106 @@ class ObjectPlane:
     def _kv_put(self, key: str, data: bytes) -> None:
         client = _client()
         nchunks = max(1, (len(data) + _KV_CHUNK - 1) // _KV_CHUNK)
-        client.key_value_set(f"{key}/n", str(nchunks))
+        _guard_rpc(lambda: client.key_value_set(f"{key}/n", str(nchunks)))
         for c in range(nchunks):
             chunk = data[c * _KV_CHUNK : (c + 1) * _KV_CHUNK]
-            client.key_value_set_bytes(f"{key}/{c}", chunk)
+            _guard_rpc(
+                lambda c=c: client.key_value_set_bytes(f"{key}/{c}", chunk))
 
     def _kv_get(self, key: str, timeout_ms: int = 600_000) -> bytes:
-        client = _client()
-        nchunks = int(client.blocking_key_value_get(f"{key}/n", timeout_ms))
+        nchunks = int(_sliced_get(f"{key}/n", timeout_ms))
         parts = []
         for c in range(nchunks):
-            parts.append(
-                client.blocking_key_value_get_bytes(f"{key}/{c}", timeout_ms)
-            )
+            parts.append(_sliced_get(f"{key}/{c}", timeout_ms, raw=True))
         return b"".join(parts)
+
+    def _barrier(self, name: str, timeout_ms: int) -> None:
+        client = _client()
+        # barriers cannot be sliced (a timed-out barrier id is poisoned for
+        # every participant), so guard the single long wait with probes
+        _guard_rpc(lambda: client.wait_at_barrier(name, timeout_ms),
+                   budget_ms=timeout_ms + _PROBE_MS)
+
+
+def _coordinator_alive() -> None:
+    """Raise if the job is aborted or the coordinator is unreachable.
+
+    Two checks: (1) the poison key posted by a crashing rank's except hook
+    (non-blocking try_get; missing key = healthy); (2) a short get on the
+    liveness key every ObjectPlane seeds at construction — it returns
+    instantly while the coordinator lives, so ANY error (including a
+    client-side deadline against a dead endpoint) means the coordinator is
+    gone."""
+    client = _client()
+    try:
+        reason = client.key_value_try_get(_ABORT_KEY)
+    except Exception:  # NotFound: nobody aborted (or see check 2 below)
+        pass
+    else:
+        raise JobAbortedError(
+            f"job aborted by a crashed peer: {reason}")
+    last = None
+    for attempt_ms in (2_000, 5_000):  # one retry: a loaded coordinator
+        #                                may miss a single short deadline
+        try:
+            client.blocking_key_value_get(_ALIVE_KEY, attempt_ms)
+            return
+        except Exception as e:  # noqa: BLE001
+            last = e
+    raise RuntimeError(
+        f"jax.distributed coordinator unreachable — aborting instead "
+        f"of waiting out the full collective timeout: {last}") from last
+
+
+def _guard_rpc(fn, budget_ms: int = 600_000):
+    """Run a coordinator RPC that has no deadline of its own on a worker
+    thread; while it blocks, probe coordinator liveness every _PROBE_MS and
+    raise promptly if the coordinator is gone (the abandoned daemon thread
+    is moot — the caller is about to tear the process down)."""
+    result: dict = {}
+
+    def run():
+        try:
+            result["v"] = fn()
+        except BaseException as e:  # noqa: BLE001 — surfaced to caller
+            result["e"] = e
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    waited = 0
+    while True:
+        slice_ms = min(_PROBE_MS, budget_ms - waited)
+        th.join(max(slice_ms, 1) / 1000)
+        waited += slice_ms
+        if not th.is_alive():
+            break
+        if waited >= budget_ms:
+            raise TimeoutError(
+                f"coordinator RPC exceeded its {budget_ms} ms budget")
+        _coordinator_alive()
+    if "e" in result:
+        raise result["e"]
+    return result.get("v")
+
+
+def _sliced_get(key: str, timeout_ms: int, raw: bool = False):
+    """blocking_key_value_get with the budget sliced into short attempts,
+    probing coordinator liveness between slices (fail-fast)."""
+    client = _client()
+    get = (client.blocking_key_value_get_bytes if raw
+           else client.blocking_key_value_get)
+    waited = 0
+    while True:
+        slice_ms = min(_PROBE_MS, timeout_ms - waited)
+        if slice_ms <= 0:
+            raise TimeoutError(
+                f"key {key!r} not published within {timeout_ms} ms")
+        try:
+            return get(key, slice_ms)
+        except Exception as e:  # noqa: BLE001 — gRPC taxonomy via message
+            msg = str(e).lower()
+            if not ("deadline" in msg or "timed out" in msg
+                    or "timeout" in msg):
+                raise  # transport error: coordinator gone — fail fast
+            waited += slice_ms
+            _coordinator_alive()

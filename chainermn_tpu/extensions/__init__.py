@@ -85,8 +85,10 @@ def install_global_except_hook(communicator: CommunicatorBase = None):
 
     Reference: chainermn/global_except_hook.py — prints the traceback and
     calls MPI_Abort so no rank is left deadlocked inside a collective. Here:
-    print, best-effort shutdown of the jax.distributed coordinator (which
-    poisons every other process's barriers/collectives), hard-exit. With one
+    print, post the abort poison key (peers' object-plane probes raise
+    within seconds), hard-exit. NOT a graceful ``jax.distributed.shutdown``
+    — on the coordinator host that blocks waiting for the very peers that
+    are stuck in collectives, leaving the job wedged (observed). With one
     process it degrades to print-and-exit, still avoiding a wedged TPU
     runtime on partially-enqueued programs.
     """
@@ -101,7 +103,10 @@ def install_global_except_hook(communicator: CommunicatorBase = None):
         finally:
             try:
                 if jax.process_count() > 1:
-                    jax.distributed.shutdown()
+                    from chainermn_tpu.comm.object_plane import post_abort
+
+                    post_abort(f"{exc_type.__name__}: {exc_value} "
+                               f"(process {jax.process_index()})")
             except Exception:
                 pass
             import os

@@ -9,11 +9,13 @@ run must converge identically on both processes, with gradients synced by
 """
 
 import os
-import socket
-import subprocess
 import sys
 
 import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mp_harness import assert_all_ok, run_workers
 
 _WORKER = r"""
 import os, sys
@@ -95,41 +97,11 @@ print(f"WORKER{proc_id} OK w={w:.4f} b={b:.4f}", flush=True)
 """
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 @pytest.mark.timeout(180)
 def test_two_process_data_parallel_training(tmp_path):
-    port = _free_port()
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
-    env = dict(os.environ)
-    env["REPO_ROOT"] = os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    procs = [
-        subprocess.Popen([sys.executable, str(script), str(i), str(port)],
-                         env=env, stdout=subprocess.PIPE,
-                         stderr=subprocess.STDOUT, text=True)
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=170)
-            outs.append(out)
-    finally:
-        # a worker that died early leaves its peer hung in a collective;
-        # kill both so a failure doesn't leak processes past the test
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
-        assert f"WORKER{i} OK" in out
+    procs, outs = run_workers(
+        _WORKER, tmp_path, timeout=170,
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    assert_all_ok(procs, outs)

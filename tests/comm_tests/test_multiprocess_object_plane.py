@@ -8,11 +8,13 @@ send_obj/recv_obj plus scatter_dataset across them.
 """
 
 import os
-import socket
-import subprocess
 import sys
 
 import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mp_harness import assert_all_ok, run_workers
 
 _WORKER = r"""
 import os, sys
@@ -84,39 +86,5 @@ print(f"WORKER{proc_id} OK", flush=True)
 
 @pytest.mark.timeout(120)
 def test_two_process_object_plane(tmp_path):
-    port = _free_port()
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
-    env = dict(os.environ)
-    env["REPO_ROOT"] = os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)  # single device per process is fine
-    procs = [
-        subprocess.Popen([sys.executable, str(script), str(i), str(port)],
-                         env=env, stdout=subprocess.PIPE,
-                         stderr=subprocess.STDOUT, text=True)
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=110)
-            outs.append(out)
-    finally:
-        # a worker that died early leaves its peer hung in a collective;
-        # kill both so a failure doesn't leak processes past the test
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} failed:\n{out}"
-        assert f"WORKER{i} OK" in out
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    procs, outs = run_workers(_WORKER, tmp_path, timeout=110)
+    assert_all_ok(procs, outs)

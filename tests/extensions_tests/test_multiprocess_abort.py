@@ -1,0 +1,75 @@
+"""Fail-fast job abort across REAL processes.
+
+The reference's global_except_hook exists so one crashing rank kills the
+job instead of leaving the others deadlocked inside a collective
+(SURVEY.md §5). Here: process 0 (the jax.distributed coordinator host)
+installs the hook and raises; the hook must hard-exit it with code 13
+(NOT block in a graceful coordinator shutdown — the original failure mode
+this test caught), and the surviving process must terminate promptly
+rather than hang: either jax's coordination agent kills it on coordinator
+loss, or the object plane's liveness/abort probes raise."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mp_harness import run_workers
+
+_WORKER = r"""
+import os, sys, time
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import chainermn_tpu
+from chainermn_tpu.comm.object_plane import ObjectPlane
+
+op = ObjectPlane()
+# sync point: both processes alive
+assert op.allgather_obj(proc_id) == [0, 1]
+
+if proc_id == 0:
+    chainermn_tpu.install_global_except_hook()
+    raise RuntimeError("simulated rank crash")   # -> hook -> os._exit(13)
+
+# survivor: give the crash a moment, then hit the object plane. The
+# coordinator died with process 0, so this must not deadlock: either the
+# jax coordination agent terminates this process first, or the collective
+# raises through the object plane's fail-fast probes.
+time.sleep(3)
+try:
+    op.allgather_obj("after-crash")
+    print("WORKER1 COLLECTIVE SUCCEEDED UNEXPECTEDLY", flush=True)
+    sys.exit(1)
+except BaseException as e:
+    print(f"WORKER1 SAW ABORT: {type(e).__name__}", flush=True)
+    os._exit(0)
+"""
+
+
+@pytest.mark.timeout(180)
+def test_crash_aborts_instead_of_deadlocking(tmp_path):
+    procs, outs = run_workers(_WORKER, tmp_path, timeout=150)
+    assert procs[0].returncode == 13, (
+        f"crasher should hard-exit 13:\n{outs[0][-2000:]}")
+    # the survivor must TERMINATE promptly, by either fail-fast path:
+    # our probes raising (exit 0 + marker) or jax's coordination agent
+    # terminating the process on coordinator loss (fatal nonzero exit)
+    saw_probe_abort = ("WORKER1 SAW ABORT" in outs[1]
+                       and procs[1].returncode == 0)
+    saw_agent_kill = (procs[1].returncode not in (None, 0)
+                      and ("Terminating process" in outs[1]
+                           or "coordination" in outs[1]))
+    assert saw_probe_abort or saw_agent_kill, (
+        f"survivor neither raised nor was terminated "
+        f"(rc={procs[1].returncode}):\n{outs[1][-2000:]}")
+    assert "SUCCEEDED UNEXPECTEDLY" not in outs[1]

@@ -1,0 +1,59 @@
+"""Shared harness for tests that spawn REAL jax.distributed processes.
+
+Every multiprocess test writes a worker script that initializes
+`jax.distributed` against a local coordinator and prints `WORKER<i> OK` on
+success. This module owns the spawn/communicate/cleanup boilerplate so the
+timeout and leak handling live in exactly one place."""
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_workers(worker_src: str, tmp_path, n: int = 2, timeout: int = 110,
+                env_extra: dict = None):
+    """Spawn ``n`` worker processes running ``worker_src`` (argv: proc_id,
+    port) and wait for them. Returns ``(procs, outs)`` with stdout+stderr
+    text per worker; workers left alive after a failure are killed so a
+    peer hung in a collective never leaks past the test."""
+    port = free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src)
+    env = dict(os.environ)
+    env["REPO_ROOT"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.update(env_extra or {})
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(i), str(port)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for i in range(n)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+def assert_all_ok(procs, outs):
+    """Every worker exited 0 and printed its WORKER<i> OK marker."""
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert f"WORKER{i} OK" in out
