@@ -59,10 +59,36 @@ def transfer(x, communicator, edges: Sequence[Tuple[int, int]]):
     Every shard executes this (SPMD). A shard that is a dst in ``edges``
     receives the src's value; all other shards receive zeros. Lowered to one
     XLA collective-permute; differentiable (transpose = reversed edges).
+    Multi-axis communicators (e.g. the multi-process ``('dcn', 'ici')``
+    mesh) permute over the linearized rank space, so chain-list stages may
+    span the DCN seam.
+
+    Rank-order subtlety: edge ranks use the COMMUNICATOR's linearization
+    (``comm.axis_index`` — row-major over ``comm.axis_names``), but
+    ``lax.ppermute``'s lowering sorts each replica group, interpreting
+    indices in MESH axis order. When a communicator was built with axes
+    out of mesh order, the edges are remapped — without this the permute
+    silently routes to the wrong shards.
     """
-    axis = communicator.axis_name
+    axes = tuple(communicator.axis_names)
+    mesh_order = tuple(a for a in communicator.mesh.axis_names if a in axes)
+    if axes != mesh_order:
+        shape = dict(communicator.mesh.shape)
+        sizes = [shape[a] for a in axes]
+
+        def remap(r: int) -> int:
+            coords = {}
+            for a, s in zip(reversed(axes), reversed(sizes)):
+                coords[a] = r % s
+                r //= s
+            out = 0
+            for a in mesh_order:
+                out = out * shape[a] + coords[a]
+            return out
+
+        edges = [(remap(s), remap(d)) for (s, d) in edges]
     return jax.tree_util.tree_map(
-        lambda l: lax.ppermute(l, axis, list(edges)), x
+        lambda l: lax.ppermute(l, axes, list(edges)), x
     )
 
 

@@ -93,6 +93,35 @@ from chainermn_tpu.comm.object_plane import ObjectPlane
 got = ObjectPlane().allgather_obj((w, b))
 assert got[0] == got[1], got
 
+# ---- model parallel ACROSS PROCESSES: chain stages span the DCN seam ----
+# (BASELINE config #5 multi-host: stage ranks 0,3,6 live on different
+# process-local device groups, so the ppermute edges cross gloo)
+import flax.linen as nn
+from chainermn_tpu.links import MultiNodeChainList
+
+class Part(nn.Module):
+    feat: int
+    @nn.compact
+    def __call__(self, x):
+        return jnp.tanh(nn.Dense(self.feat)(x))
+
+chain = MultiNodeChainList(comm)
+chain.add_link(Part(8), rank=0, rank_in=None, rank_out=3)
+chain.add_link(Part(6), rank=3, rank_in=0, rank_out=6)
+chain.add_link(Part(4), rank=6, rank_in=3, rank_out=None)
+
+xin = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+cparams = chain.init(jax.random.PRNGKey(0), jnp.asarray(xin))
+out = jax.jit(shard_map(
+    lambda x: chain.apply(cparams, x), mesh=comm.mesh,
+    in_specs=(P(),), out_specs=P()))(jnp.asarray(xin))
+out = np.asarray(jax.device_get(out.addressable_shards[0].data))
+
+h = jnp.asarray(xin)
+for feat, p in zip([8, 6, 4], cparams):
+    h = Part(feat).apply(p, h)
+np.testing.assert_allclose(out, np.asarray(h), rtol=1e-5, atol=1e-6)
+
 print(f"WORKER{proc_id} OK w={w:.4f} b={b:.4f}", flush=True)
 """
 

@@ -115,3 +115,36 @@ def test_recv_mismatched_src(comm):
     phi = F.DelegateVariable(jnp.ones(3), src=2, dest=3)
     with pytest.raises(ValueError):
         F.recv(comm, 0, delegate_variable=phi)
+
+
+def test_transfer_multi_axis_mesh():
+    """Edges on a 2-axis communicator route by the COMMUNICATOR's rank
+    linearization, including when its axes order differs from the mesh's
+    (ppermute interprets ranks in mesh order; transfer must remap)."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from chainermn_tpu.comm.xla import XlaCommunicator
+    from chainermn_tpu.functions.point_to_point import transfer
+
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("a", "b"))
+    for axes in (("a", "b"), ("b", "a")):
+        comm = XlaCommunicator(mesh, axes=axes)
+
+        def f(x):
+            # every shard holds its comm-rank; edge 1 -> 2 must deliver
+            # comm-rank 1's value to comm-rank 2
+            mine = comm.axis_index().astype(jnp.float32)[None]
+            moved = transfer(mine, comm, [(1, 2)])
+            # expose each shard's received value at its comm-rank slot
+            out = jnp.zeros((4,), jnp.float32)
+            out = out.at[comm.axis_index()].set(moved[0])
+            return jax.lax.psum(out, axes)
+
+        got = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=(P(),), out_specs=P()))(
+                jnp.zeros((1,), jnp.float32))
+        got = np.asarray(got)
+        assert got[2] == 1.0, (axes, got)
+        assert got[1] == 0.0 or got[1] != 1.0  # rank 1 got nothing back
