@@ -38,6 +38,25 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -1e30  # finite stand-in: -inf breaks max/exp chains on the VPU
 
 
+def _dimsem():
+    """Grid dims (batch*heads, tile, tile): the first two are independent,
+    only the innermost accumulates — declaring this lets Mosaic pipeline
+    the HBM block copies across grid steps instead of serializing
+    copy→compute. None when the API is unavailable."""
+    dims = ("parallel", "parallel", "arbitrary")
+    for cls_name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, cls_name, None)
+        if cls is not None:
+            try:
+                return cls(dimension_semantics=dims)
+            except Exception:
+                continue
+    return None
+
+
+_DIMSEM = _dimsem()
+
+
 def _fit_block(block: int, l: int) -> int:
     """Largest divisor of ``l`` that is <= ``block``, preferring
     lane-aligned (multiple-of-128) tiles, then sublane-aligned (8).
@@ -89,10 +108,11 @@ def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, bq, bk,
     sequences) blanks positions whose query and key segment ids differ;
     a sliding window keeps only the last ``window`` positions (causal).
     """
-    q = q_ref[0].astype(jnp.float32)          # [bq, d]
-    k = k_ref[0].astype(jnp.float32)          # [bk, d]
+    # native-dtype operands, f32 accumulation: a bf16 model's Q·Kᵀ runs at
+    # the MXU's bf16 rate (upcasting first quartered throughput and paid
+    # VPU casts); f32 inputs behave exactly as before
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale                                  # [bq, bk]
     if causal:
@@ -140,7 +160,6 @@ def _fa_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False,
         lrow[:] = jnp.zeros_like(lrow)
 
     def _compute():
-        v = v_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
                          bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref,
                          window=window)
@@ -150,8 +169,9 @@ def _fa_kernel(*refs, scale, causal, bq, bk, nk, has_segs=False,
         p = _masked_exp(s, m_new, has_segs)        # [bq, bk]
         alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
         lrow[:, :1] = lrow[:, :1] * alpha + jnp.sum(p, -1, keepdims=True)
+        # P cast to V's dtype: bf16 MXU dot with f32 accumulation
         acc[:] = acc[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         mrow[:, :1] = m_new
@@ -258,6 +278,7 @@ def _flash_fwd_3d(q, k, v, *, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((bq, 128), jnp.float32),   # running sum (col 0)
         ],
         interpret=interpret,
+        compiler_params=_DIMSEM,
     )(*operands)
     return out, lse
 
@@ -286,18 +307,17 @@ def _fa_bwd_dq_kernel(*refs, scale, causal, bq, bk, nk,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _compute():
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
                          bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref,
                          window=window)
         p = _masked_exp(s, lse_ref[0], has_segs)       # [bq, bk]
+        # native-dtype MXU dots, f32 accumulation (see _tile_scores)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
         ds = p * (dp - dr_ref[0]) * scale
         dq_acc[:] += jax.lax.dot_general(
-            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     # traced-predicate gate even when non-causal — see _fa_kernel
@@ -327,21 +347,20 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
         s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
                          bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref,
                          window=window)
         p = _masked_exp(s, lse_ref[0], has_segs)       # [bq, bk]
+        # native-dtype MXU dots, f32 accumulation (see _tile_scores)
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bk, d]
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, bk]
         ds = p * (dp - dr_ref[0]) * scale
         dk_acc[:] += jax.lax.dot_general(
-            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bk, d]
 
     # traced-predicate gate even when non-causal — see _fa_kernel
@@ -390,6 +409,7 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
         out_shape=_sds(q, (bh, lq, d), q.dtype, k, v, do),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=_DIMSEM,
     )(*operands)
 
     # dk/dv iterate q innermost; same index maps with (b, ki, qi). Outputs
@@ -418,6 +438,7 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=_DIMSEM,
     )(*operands)
     return dq, dk, dv
 

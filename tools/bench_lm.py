@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Transformer-LM training throughput (tokens/sec) on the available chips.
+
+Secondary benchmark (the driver's recorded metric is bench.py's ResNet-50):
+a GPT-small-ish causal LM on the flash-attention path, bf16 compute,
+data-parallel step factory. Prints one JSON line per config.
+
+Usage: python tools/bench_lm.py [d_model n_layers seq_len batch]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu.models.transformer import TransformerLM, lm_loss_with_aux
+    from chainermn_tpu.training.step import make_data_parallel_train_step
+
+    d_model = int(sys.argv[1]) if len(sys.argv) > 1 else 768
+    n_layers = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+    seq_len = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+    batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+
+    comm = chainermn_tpu.create_communicator("xla")
+    model = TransformerLM(
+        vocab=32768, d_model=d_model, n_heads=d_model // 64,
+        n_layers=n_layers, d_ff=4 * d_model, max_len=seq_len,
+        pos_emb="rope", attention="flash", dtype=jnp.bfloat16)
+
+    toks = np.random.RandomState(0).randint(
+        0, 32768, size=(batch * comm.size, seq_len + 1)).astype(np.int32)
+    params = comm.bcast_data(
+        model.init(jax.random.PRNGKey(0), toks[:1, :-1])["params"])
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.adamw(3e-4), comm)
+    step = make_data_parallel_train_step(
+        model, opt, comm, loss_fn=lm_loss_with_aux)
+    state = (params, opt.init(params))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dsh = NamedSharding(comm.mesh, P(comm.axis_names[0]))
+    x = jax.device_put(toks[:, :-1], dsh)
+    y = jax.device_put(toks[:, 1:], dsh)
+
+    # three warmup executions: compile, plus the tunneled chip's deferred
+    # one-time second-execution cost (see bench.py)
+    for _ in range(3):
+        state, m = step(state, x, y)
+        float(m["main/loss"])
+    n_iters = 10
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, m = step(state, x, y)
+    final = float(m["main/loss"])
+    dt = time.perf_counter() - t0
+    assert final == final, "loss is NaN"
+
+    tokens_per_sec = n_iters * batch * comm.size * seq_len / dt
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    print(json.dumps({
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / comm.size, 1),
+        "unit": "tokens/sec/chip",
+        "config": {"d_model": d_model, "n_layers": n_layers,
+                   "seq_len": seq_len, "batch_per_chip": batch,
+                   "params_m": round(n_params / 1e6, 1)},
+    }))
+
+
+if __name__ == "__main__":
+    main()
