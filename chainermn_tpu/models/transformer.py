@@ -27,7 +27,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from chainermn_tpu.ops.flash_attention import flash_attention
+from chainermn_tpu.ops.flash_attention import (DEFAULT_BLOCKS,
+                                               flash_attention)
 from chainermn_tpu.parallel.expert_parallel import ExpertParallelMLP
 from chainermn_tpu.parallel.ring_attention import (
     local_attention_reference,
@@ -144,7 +145,13 @@ class TransformerBlock(nn.Module):
                       "ulysses": ulysses_attention}[self.attention]
             att = seq_fn(q, k, v, axis_name=self.seq_axis, causal=True)
         elif self.attention == "flash":
-            bq, bk = self.attention_blocks or (256, 512)
+            bq, bk = self.attention_blocks or DEFAULT_BLOCKS
+            if self.attention_window is not None:
+                # large k-tiles defeat the sliding-window tile skip: cap
+                # block_k near the window so skipped tiles stay skippable
+                bk = min(bk, max(128,
+                                 ((self.attention_window + 127) // 128)
+                                 * 128))
             att = flash_attention(q, k, v, causal=True, block_q=bq,
                                   block_k=bk, window=self.attention_window)
         else:

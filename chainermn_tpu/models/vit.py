@@ -21,7 +21,8 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from chainermn_tpu.ops.flash_attention import flash_attention
+from chainermn_tpu.ops.flash_attention import (DEFAULT_BLOCKS,
+                                               flash_attention)
 
 __all__ = ["ViT", "ViTEncoderBlock"]
 
@@ -54,7 +55,7 @@ class ViTEncoderBlock(nn.Module):
         q = q.reshape(b, l, self.n_heads, dh)
         k = k.reshape(b, l, self.n_heads, dh)
         v = v.reshape(b, l, self.n_heads, dh)
-        bq, bk = self.attention_blocks or (256, 512)
+        bq, bk = self.attention_blocks or DEFAULT_BLOCKS
         att = flash_attention(q, k, v, causal=False, block_q=bq, block_k=bk)
         att = att.reshape(b, l, self.d_model).astype(self.dtype)
         att = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,

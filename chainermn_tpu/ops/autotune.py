@@ -1,6 +1,6 @@
 """On-device block-size autotuning for the flash attention kernels.
 
-The tuned defaults in `ops/flash_attention.py` (256, 512) were measured
+The tuned defaults in `ops/flash_attention.py` (1024, 1024) were measured
 on v5e at d=128; other head dims, sequence lengths, or TPU generations
 can prefer different tiles (BASELINE.md's sweep saw 2x spread). This
 sweeps candidate (block_q, block_k) pairs with the REAL kernels on the
@@ -23,8 +23,8 @@ import jax.numpy as jnp
 
 _CACHE: dict = {}
 
-_CANDIDATES = ((128, 128), (128, 256), (256, 256), (256, 512),
-               (512, 512), (512, 1024))
+_CANDIDATES = ((128, 256), (256, 512), (512, 512), (512, 1024),
+               (1024, 512), (1024, 1024))
 
 
 def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
@@ -41,14 +41,15 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
     memoizes. Use the result as the ``block_q``/``block_k`` arguments or
     `TransformerBlock`'s ``attention_blocks``.
     """
-    from chainermn_tpu.ops.flash_attention import flash_attention
+    from chainermn_tpu.ops.flash_attention import (DEFAULT_BLOCKS,
+                                               flash_attention)
 
     key = (batch, seq_len, heads, head_dim, kv_heads, str(dtype), causal,
            window, include_backward)
     if key in _CACHE:
         return _CACHE[key]
     if jax.default_backend() != "tpu":
-        _CACHE[key] = (256, 512)  # defaults; interpreter timing is noise
+        _CACHE[key] = DEFAULT_BLOCKS  # defaults; interpreter timing is noise
         return _CACHE[key]
 
     hkv = kv_heads or heads
@@ -57,7 +58,7 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
     k = jax.random.normal(ks[1], (batch, seq_len, hkv, head_dim), dtype)
     v = jax.random.normal(ks[2], (batch, seq_len, hkv, head_dim), dtype)
 
-    best, best_dt = (256, 512), float("inf")
+    best, best_dt = DEFAULT_BLOCKS, float("inf")
     for bq, bk in candidates:
         def loss(q, k, v, bq=bq, bk=bk):
             out = flash_attention(q, k, v, causal, None, bq, bk, None,

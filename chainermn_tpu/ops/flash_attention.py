@@ -37,6 +37,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30  # finite stand-in: -inf breaks max/exp chains on the VPU
 
+# tuned default tile sizes (v5e, 2026-07-30 sweep — BASELINE.md); clamped
+# to legal divisors of L per call, so they are safe for any length. The
+# single source of truth: models/parallel wrappers import this.
+DEFAULT_BLOCKS = (1024, 1024)
+
 
 def _dimsem():
     """Grid dims (batch*heads, tile, tile): the first two are independent,
@@ -458,7 +463,8 @@ def _reference(q, k, v, causal, scale):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 9))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
-                    block_q: int = 256, block_k: int = 512,
+                    block_q: int = DEFAULT_BLOCKS[0],
+                    block_k: int = DEFAULT_BLOCKS[1],
                     interpret: Optional[bool] = None,
                     segment_ids=None, window: Optional[int] = None):
     """Fused blockwise attention. q: [B, Lq, H, D]; k, v: [B, Lk, Hkv, D]
@@ -481,11 +487,12 @@ def flash_attention(q, k, v, causal: bool = False,
     ``interpret=None`` auto-selects: the Pallas interpreter off-TPU (tests),
     the compiled kernel on TPU.
 
-    Default blocks (256, 512) measured fastest on v5e (d=128, causal,
-    bf16): 1.77x over the materializing XLA attention at L=8192, vs 0.86x
-    at the old (128, 128) — see BASELINE.md. Block sizes are clamped to
-    the largest divisor of L (lane-aligned where possible), so any length
-    works; explicit blocks are only a tuning knob.
+    Default blocks (1024, 1024) measured fastest on v5e with the
+    native-dtype MXU + pipelined-DMA kernel (2026-07-30 sweep: 7.3 ms vs
+    8.6 ms at (256,512) for the d=64/L=2048 LM shape; 6.6 vs 11.6 ms at
+    d=128/L=8192; backward agrees) — see BASELINE.md. Block sizes are
+    clamped to the largest divisor of L (lane-aligned where possible), so
+    any length works; explicit blocks are only a tuning knob.
     """
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
                       segment_ids, window)[0]

@@ -20,6 +20,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from chainermn_tpu.ops.flash_attention import DEFAULT_BLOCKS
 from jax import lax
 
 
@@ -130,7 +132,8 @@ def _ring_blocks(causal, my, src, full_fn, diag_fn, skip_fn):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
                          scale: Optional[float] = None,
-                         block_q: int = 256, block_k: int = 512,
+                         block_q: int = DEFAULT_BLOCKS[0],
+                         block_k: int = DEFAULT_BLOCKS[1],
                          interpret: Optional[bool] = None):
     """`ring_attention` with the Pallas flash kernel as the per-block
     compute. Same calling convention: inside shard_map, q/k/v

@@ -27,12 +27,14 @@ from typing import Optional
 
 from jax import lax
 
-from chainermn_tpu.ops.flash_attention import flash_attention
+from chainermn_tpu.ops.flash_attention import (DEFAULT_BLOCKS,
+                                               flash_attention)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
                       scale: Optional[float] = None,
-                      block_q: int = 256, block_k: int = 512,
+                      block_q: Optional[int] = None,
+                      block_k: Optional[int] = None,
                       interpret: Optional[bool] = None):
     """Attention over a sequence sharded on ``axis_name``.
 
@@ -54,7 +56,8 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     reshard = lambda x: lax.all_to_all(x, axis_name, split_axis=2,
                                        concat_axis=1, tiled=True)
     o = flash_attention(reshard(q), reshard(k), reshard(v), causal, scale,
-                        block_q, block_k, interpret)
+                        block_q or DEFAULT_BLOCKS[0],
+                        block_k or DEFAULT_BLOCKS[1], interpret)
     # [B, L, H/n, D] -> [B, L/n, H, D]
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)

@@ -11,7 +11,7 @@ from chainermn_tpu.ops.autotune import _CACHE, tune_flash_blocks
 def test_off_tpu_returns_defaults_and_caches():
     _CACHE.clear()
     blocks = tune_flash_blocks(2, 512, 4, 64)
-    assert blocks == (256, 512)  # interpreter timing would be noise
+    assert blocks == (1024, 1024)  # interpreter timing would be noise
     assert len(_CACHE) == 1
     assert tune_flash_blocks(2, 512, 4, 64) == blocks
     assert len(_CACHE) == 1
