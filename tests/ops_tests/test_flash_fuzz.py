@@ -2,8 +2,10 @@
 
 Random combinations of GQA, causal, sliding window, segment packing, and
 odd lengths (auto-padding) — the pairwise tests cover each feature alone;
-this catches interactions between them. All cases run in float32 (the
-oracle's comparison dtype).
+this catches interactions between them. The matrix runs in float32 (the
+oracle's comparison dtype) plus bfloat16 spot-checks: bf16 inputs take a
+DIFFERENT kernel path (native-dtype MXU dots with f32 accumulation, P/dS
+downcast), so they need their own regression coverage at bf16 tolerances.
 """
 
 import numpy as np
@@ -76,3 +78,67 @@ def test_fuzz_matches_oracle(i, causal, h, hkv, lq, lk, window, segs):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=2e-3, atol=2e-4,
                                    err_msg=f"d{nm} case {i}")
+
+
+# hand-picked bf16 coverage: plain, GQA, segment packing, sliding window
+# (the bf16 kernel path differs — native-dtype MXU dots, P/dS downcast)
+BF16_CASES = [
+    # (causal, h, hkv, lq, lk, window, segs)
+    (False, 2, 2, 128, 128, None, False),
+    (True, 4, 1, 128, 128, None, False),    # MQA
+    (True, 4, 2, 128, 128, None, True),     # GQA + segments
+    (True, 2, 2, 128, 128, 40, False),      # sliding window
+]
+
+
+@pytest.mark.parametrize("causal,h,hkv,lq,lk,window,segs", BF16_CASES)
+def test_bf16_matches_f32_oracle(causal, h, hkv, lq, lk, window, segs):
+    """bf16 inputs (the native-dtype MXU path): forward and gradients must
+    track the f32 oracle within bf16 tolerances."""
+    rng = np.random.RandomState(hash((causal, h, hkv, window, segs)) % 997)
+    b, d = 2, 16
+    q = rng.randn(b, lq, h, d).astype(np.float32)
+    k = rng.randn(b, lk, hkv, d).astype(np.float32)
+    v = rng.randn(b, lk, hkv, d).astype(np.float32)
+    if segs:
+        cut = lq // 2
+        q_seg = np.zeros((b, lq), np.int32)
+        q_seg[:, cut:] = 1
+        kv_seg = np.zeros((b, lk), np.int32)
+        kv_seg[:, cut:] = 1
+        seg_arg = (jnp.asarray(q_seg), jnp.asarray(kv_seg))
+    else:
+        q_seg = np.zeros((b, lq), np.int32)
+        kv_seg = np.zeros((b, lk), np.int32)
+        seg_arg = None
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q.astype(jnp.bfloat16),
+                              k.astype(jnp.bfloat16),
+                              v.astype(jnp.bfloat16),
+                              causal, None, 64, 64, True, seg_arg, window)
+        out = out.astype(jnp.float32)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    def loss_ref(q, k, v):
+        out = _oracle(q, k, v, jnp.asarray(q_seg), jnp.asarray(kv_seg),
+                      causal, window, d ** -0.5)
+        return jnp.sum(out * jnp.cos(out)), out
+
+    (lf, of), g = jax.value_and_grad(loss_flash, argnums=(0, 1, 2),
+                                     has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    (lr, orf), gr = jax.value_and_grad(loss_ref, argnums=(0, 1, 2),
+                                       has_aux=True)(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    # bf16 inputs: ~8-bit mantissa; scale tolerances by each tensor's
+    # magnitude so the check is not atol-dominated (sign flips must fail)
+    ref_o = np.asarray(orf)
+    np.testing.assert_allclose(np.asarray(of), ref_o, rtol=5e-2,
+                               atol=0.02 * np.abs(ref_o).max(),
+                               err_msg="bf16 fwd")
+    for a, r, nm in zip(g, gr, "qkv"):
+        r = np.asarray(r)
+        np.testing.assert_allclose(np.asarray(a), r, rtol=1e-1,
+                                   atol=0.03 * np.abs(r).max(),
+                                   err_msg=f"bf16 d{nm}")
