@@ -218,11 +218,17 @@ class ObjectPlane:
     def _kv_put(self, key: str, data: bytes) -> None:
         client = _client()
         nchunks = max(1, (len(data) + _KV_CHUNK - 1) // _KV_CHUNK)
-        _guard_rpc(lambda: client.key_value_set(f"{key}/n", str(nchunks)))
-        for c in range(nchunks):
-            chunk = data[c * _KV_CHUNK : (c + 1) * _KV_CHUNK]
-            _guard_rpc(
-                lambda c=c: client.key_value_set_bytes(f"{key}/{c}", chunk))
+
+        def put_all():
+            # ONE guard thread for the whole put (not one per chunk RPC):
+            # large scatters would otherwise spawn hundreds of short-lived
+            # threads; the liveness probe still fires every _PROBE_MS
+            client.key_value_set(f"{key}/n", str(nchunks))
+            for c in range(nchunks):
+                client.key_value_set_bytes(
+                    f"{key}/{c}", data[c * _KV_CHUNK:(c + 1) * _KV_CHUNK])
+
+        _guard_rpc(put_all)
 
     def _kv_get(self, key: str, timeout_ms: int = 600_000) -> bytes:
         nchunks = int(_sliced_get(f"{key}/n", timeout_ms))

@@ -146,12 +146,6 @@ class TransformerBlock(nn.Module):
             att = seq_fn(q, k, v, axis_name=self.seq_axis, causal=True)
         elif self.attention == "flash":
             bq, bk = self.attention_blocks or DEFAULT_BLOCKS
-            if self.attention_window is not None:
-                # large k-tiles defeat the sliding-window tile skip: cap
-                # block_k near the window so skipped tiles stay skippable
-                bk = min(bk, max(128,
-                                 ((self.attention_window + 127) // 128)
-                                 * 128))
             att = flash_attention(q, k, v, causal=True, block_q=bq,
                                   block_k=bk, window=self.attention_window)
         else:

@@ -42,7 +42,7 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
     `TransformerBlock`'s ``attention_blocks``.
     """
     from chainermn_tpu.ops.flash_attention import (DEFAULT_BLOCKS,
-                                               flash_attention)
+                                                   flash_attention)
 
     key = (batch, seq_len, heads, head_dim, kv_heads, str(dtype), causal,
            window, include_backward)

@@ -62,6 +62,16 @@ def _dimsem():
 _DIMSEM = _dimsem()
 
 
+def _window_cap(block_k: int, window) -> int:
+    """Cap block_k near the sliding window: tiles wider than the
+    window defeat the band-tile skip (every q row would pay for a full
+    k-tile of mostly-masked columns). Applied identically in the forward
+    and backward rules so the custom_vjp pair stays consistent."""
+    if window is None:
+        return block_k
+    return min(block_k, max(128, ((window + 127) // 128) * 128))
+
+
 def _fit_block(block: int, l: int) -> int:
     """Largest divisor of ``l`` that is <= ``block``, preferring
     lane-aligned (multiple-of-128) tiles, then sublane-aligned (8).
@@ -554,6 +564,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires "
                          "causal=True")
+    block_k = _window_cap(block_k, window)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -582,6 +593,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
     q, k, v, out, lse3, segment_ids = res
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    block_k = _window_cap(block_k, window)
     sc = scale if scale is not None else q.shape[-1] ** -0.5
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
