@@ -228,7 +228,8 @@ class ObjectPlane:
                 client.key_value_set_bytes(
                     f"{key}/{c}", data[c * _KV_CHUNK:(c + 1) * _KV_CHUNK])
 
-        _guard_rpc(put_all)
+        # budget scales with payload so multi-GB scatters aren't cut off
+        _guard_rpc(put_all, budget_ms=600_000 + 10_000 * nchunks)
 
     def _kv_get(self, key: str, timeout_ms: int = 600_000) -> bytes:
         nchunks = int(_sliced_get(f"{key}/n", timeout_ms))

@@ -35,6 +35,11 @@ from chainermn_tpu.parallel.ring_attention import (
     ring_attention,
     ring_flash_attention,
 )
+from chainermn_tpu.parallel.tensor_parallel import (
+    ColumnParallelDense,
+    RowParallelDense,
+    TensorParallelMLP,
+)
 from chainermn_tpu.parallel.ulysses import ulysses_attention
 from chainermn_tpu.ops.rotary import apply_rope
 
@@ -57,6 +62,7 @@ class TransformerBlock(nn.Module):
     pos_emb: str = "learned"           # 'learned' (handled by the LM) | 'rope'
     rope_theta: float = 10000.0
     seq_axis: Optional[str] = None     # mesh axis for 'ring'
+    tp_axis: Optional[str] = None      # Megatron-style intra-op TP axis
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
@@ -71,7 +77,32 @@ class TransformerBlock(nn.Module):
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
         hkv = self.n_kv_heads or self.n_heads
-        if hkv == self.n_heads:
+        n_heads, n_kv = self.n_heads, hkv  # per-shard head counts below
+        if self.tp_axis is not None:
+            # Megatron attention: heads sharded over the model axis —
+            # column-parallel QKV (no collective), per-shard attention on
+            # local heads, row-parallel out projection (one psum)
+            if self.decode or self.moe_experts_per_device > 0:
+                raise ValueError(
+                    "tp_axis does not compose with decode or the MoE FFN")
+            if self.attention not in ("flash", "reference"):
+                raise ValueError(
+                    "tp_axis supports the 'flash'/'reference' attention "
+                    "paths")
+            ntp = jax.lax.axis_size(self.tp_axis)
+            if self.n_heads % ntp or hkv % ntp:
+                raise ValueError(
+                    f"heads ({self.n_heads}/{hkv}) must divide by the "
+                    f"'{self.tp_axis}' axis size ({ntp})")
+            n_heads, n_kv = self.n_heads // ntp, hkv // ntp
+            q = ColumnParallelDense(self.d_model, self.tp_axis,
+                                    use_bias=False, dtype=self.dtype,
+                                    name="q_proj")(h)
+            kv = ColumnParallelDense(2 * hkv * dh, self.tp_axis,
+                                     use_bias=False, dtype=self.dtype,
+                                     name="kv_proj")(h)
+            k, v = jnp.split(kv, 2, axis=-1)
+        elif hkv == self.n_heads:
             qkv = nn.Dense(3 * self.d_model, use_bias=False,
                            dtype=self.dtype, name="qkv")(h)
             q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -85,9 +116,9 @@ class TransformerBlock(nn.Module):
             kv = nn.Dense(2 * hkv * dh, use_bias=False, dtype=self.dtype,
                           name="kv_proj")(h)
             k, v = jnp.split(kv, 2, axis=-1)
-        q = q.reshape(b, l, self.n_heads, dh)
-        k = k.reshape(b, l, hkv, dh)
-        v = v.reshape(b, l, hkv, dh)
+        q = q.reshape(b, l, n_heads, dh)
+        k = k.reshape(b, l, n_kv, dh)
+        v = v.reshape(b, l, n_kv, dh)
         if self.decode:
             # KV-cache step: x is ONE new token; its position is the cache
             # fill level. Attention is a [1, cached] product — memory-bound,
@@ -153,12 +184,20 @@ class TransformerBlock(nn.Module):
                 k = jnp.repeat(k, self.n_heads // hkv, axis=2)
                 v = jnp.repeat(v, self.n_heads // hkv, axis=2)
             att = local_attention_reference(q, k, v, causal=True)
-        att = att.reshape(b, l, self.d_model).astype(self.dtype)
-        x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
-                         name="attn_out")(att)
+        att = att.reshape(b, l, -1).astype(self.dtype)  # local heads if TP
+        if self.tp_axis is not None:
+            x = x + RowParallelDense(self.d_model, self.tp_axis,
+                                     use_bias=False, dtype=self.dtype,
+                                     name="attn_out")(att)
+        else:
+            x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
+                             name="attn_out")(att)
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
-        if self.moe_experts_per_device > 0:
+        if self.tp_axis is not None:
+            x = x + TensorParallelMLP(self.d_ff, self.d_model, self.tp_axis,
+                                      dtype=self.dtype, name="tp_ffn")(h)
+        elif self.moe_experts_per_device > 0:
             y, aux = ExpertParallelMLP(
                 hidden=self.d_ff,
                 experts_per_device=self.moe_experts_per_device,
@@ -201,6 +240,7 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.float32
     attention: str = "flash"
     seq_axis: Optional[str] = None
+    tp_axis: Optional[str] = None      # Megatron intra-op TP (see block)
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
@@ -233,7 +273,7 @@ class TransformerLM(nn.Module):
                 attention_window=self.attention_window,
                 attention_blocks=self.attention_blocks,
                 pos_emb=self.pos_emb, rope_theta=self.rope_theta,
-                seq_axis=self.seq_axis,
+                seq_axis=self.seq_axis, tp_axis=self.tp_axis,
                 moe_experts_per_device=self.moe_experts_per_device,
                 expert_axis=self.expert_axis,
                 capacity_factor=self.capacity_factor,

@@ -42,6 +42,7 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
     `TransformerBlock`'s ``attention_blocks``.
     """
     from chainermn_tpu.ops.flash_attention import (DEFAULT_BLOCKS,
+                                                   _window_cap,
                                                    flash_attention)
 
     key = (batch, seq_len, heads, head_dim, kv_heads, str(dtype), causal,
@@ -59,7 +60,16 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
     v = jax.random.normal(ks[2], (batch, seq_len, hkv, head_dim), dtype)
 
     best, best_dt = DEFAULT_BLOCKS, float("inf")
+    # a window caps block_k inside the kernel: candidates above the cap
+    # alias the same compiled kernel — dedup so they are timed once
+    seen = set()
+    deduped = []
     for bq, bk in candidates:
+        eff = (bq, _window_cap(bk, window))
+        if eff not in seen:
+            seen.add(eff)
+            deduped.append((bq, bk))
+    for bq, bk in deduped:
         def loss(q, k, v, bq=bq, bk=bk):
             out = flash_attention(q, k, v, causal, None, bq, bk, None,
                                   None, window)

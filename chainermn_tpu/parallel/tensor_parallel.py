@@ -27,12 +27,38 @@ import jax.numpy as jnp
 from jax import lax
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp_region(x, axis_name):
+    """Megatron's *f* operator: identity forward, psum backward.
+
+    Placed at the entry of every column-parallel region. Each shard's
+    backward produces only ITS slice's contribution to the input gradient;
+    the full gradient is their sum. Without this, gradients flowing back to
+    REPLICATED parameters (embeddings, LayerNorms) are partial and differ
+    per shard, silently desynchronizing them from the first optimizer step
+    (the row-parallel side needs no twin: psum's transpose is already the
+    broadcast)."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+copy_to_tp_region.defvjp(_copy_fwd, _copy_bwd)
+
+
 class ColumnParallelDense(nn.Module):
     """Dense with output features split over ``axis_name``.
 
     In-shard features = ``features // axis_size``. Input must be replicated
     (or identically sharded) across the model axis; output is sharded on the
-    feature dim.
+    feature dim. The input rides :func:`copy_to_tp_region`, so gradients
+    leaving the TP region are the full cross-shard sum.
     """
 
     features: int
@@ -46,6 +72,7 @@ class ColumnParallelDense(nn.Module):
         assert self.features % n == 0, (
             f"features {self.features} not divisible by axis {n}")
         local = self.features // n
+        x = copy_to_tp_region(x, self.axis_name)
         y = nn.Dense(local, use_bias=self.use_bias, dtype=self.dtype)(x)
         return y
 
