@@ -120,11 +120,11 @@ class TransformerBlock(nn.Module):
         k = k.reshape(b, l, n_kv, dh)
         v = v.reshape(b, l, n_kv, dh)
         if self.decode:
-            # KV-cache step: x is ONE new token; its position is the cache
-            # fill level. Attention is a [1, cached] product — memory-bound,
-            # no flash kernel needed.
-            if l != 1:
-                raise ValueError("decode=True processes one token at a time")
+            # KV-cache step: x is a slab of l NEW tokens starting at the
+            # cache fill level — l == 1 is autoregressive decoding, l > 1
+            # is PREFILL (the whole prompt in one forward pass instead of
+            # one sequential step per prompt token). Attention is a
+            # [l, cached] product with causal masking inside the slab.
             if self.moe_experts_per_device > 0:
                 raise ValueError("decode does not support the MoE FFN")
             ck = self.variable("cache", "k", jnp.zeros,
@@ -135,26 +135,40 @@ class TransformerBlock(nn.Module):
                                 lambda: jnp.zeros((), jnp.int32))
             pos = idx.value
             if self.pos_emb == "rope":
-                q = apply_rope(q, pos[None], self.rope_theta)
-                k = apply_rope(k, pos[None], self.rope_theta)
+                slab = pos + jnp.arange(l)
+                q = apply_rope(q, slab, self.rope_theta)
+                k = apply_rope(k, slab, self.rope_theta)
             ck.value = jax.lax.dynamic_update_slice(
                 ck.value, k.astype(self.dtype), (0, pos, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(self.dtype), (0, pos, 0, 0))
-            idx.value = pos + 1
-            kc = ck.value.astype(jnp.float32)
-            vc = cv.value.astype(jnp.float32)
-            if hkv != self.n_heads:
-                kc = jnp.repeat(kc, self.n_heads // hkv, axis=2)
-                vc = jnp.repeat(vc, self.n_heads // hkv, axis=2)
-            s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                           kc) * dh ** -0.5
-            keys = jnp.arange(self.max_len)
-            visible = keys <= pos
-            if self.attention_window is not None:
-                visible &= keys > pos - self.attention_window
-            s = jnp.where(visible[None, None, None], s, -jnp.inf)
-            att = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), vc)
+            idx.value = pos + l
+            if l > 1:
+                # PREFILL slab: nothing precedes it (the cache starts
+                # empty), so attention is causal self-attention over the
+                # slab itself — the flash kernel, with no dense
+                # [l, max_len] scores and no full-cache read; a 32k-token
+                # prompt prefills at the training path's memory cost
+                bq, bk = self.attention_blocks or DEFAULT_BLOCKS
+                att = flash_attention(q, k, v, causal=True, block_q=bq,
+                                      block_k=bk,
+                                      window=self.attention_window)
+            else:
+                kc = ck.value.astype(jnp.float32)
+                vc = cv.value.astype(jnp.float32)
+                if hkv != self.n_heads:
+                    kc = jnp.repeat(kc, self.n_heads // hkv, axis=2)
+                    vc = jnp.repeat(vc, self.n_heads // hkv, axis=2)
+                s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                               kc) * dh ** -0.5
+                keys = jnp.arange(self.max_len)[None, :]
+                rows = pos + jnp.arange(l)[:, None]
+                visible = keys <= rows
+                if self.attention_window is not None:
+                    visible &= keys > rows - self.attention_window
+                s = jnp.where(visible[None, None], s, -jnp.inf)
+                att = jnp.einsum("bhqk,bkhd->bqhd",
+                                 jax.nn.softmax(s, -1), vc)
             # falls through to the SHARED projection/FFN tail below — the
             # decode path must never duplicate training-path math
         elif self.pos_emb == "rope":
@@ -295,9 +309,12 @@ def generate(model, params, prompt, max_new_tokens: int,
     ``rng=None`` → greedy argmax; else categorical at ``temperature``
     (optionally truncated to the ``top_k`` highest logits).
 
-    One compiled lax.scan step per position (prompt teacher-forced, then
-    sampled): decode is memory-bound, so the cache path uses plain XLA
-    attention over the cached keys rather than the flash kernel.
+    PREFILL + decode: the whole prompt runs through ONE forward pass that
+    fills every layer's KV cache (l-token slab writes, causal inside the
+    slab), then one compiled lax.scan step per sampled token. Prefill is
+    compute-bound (big matmuls); per-token decode is memory-bound, so the
+    cache path uses plain XLA attention over the cached keys rather than
+    the flash kernel.
     """
     if model.moe_experts_per_device > 0:
         raise ValueError("generate() does not support MoE models: the "
@@ -317,32 +334,43 @@ def generate(model, params, prompt, max_new_tokens: int,
         lambda t: dm.init(jax.random.PRNGKey(0), t), prompt[:, :1])["cache"]
     cache0 = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
-    padded = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
     greedy = rng is None
     rng = jax.random.PRNGKey(0) if greedy else rng
+
+    def sample(logits, rng):
+        if greedy:
+            return jnp.argmax(logits, -1).astype(jnp.int32)
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        if top_k is not None:
+            kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(rng, scaled).astype(jnp.int32)
+
+    if max_new_tokens == 0:
+        return prompt
+
+    # prefill: ONE forward over the whole prompt fills every layer's cache
+    # (lp sequential steps collapse into one compute-bound pass); the last
+    # prompt position's logits seed the first sampled token
+    logits_p, upd = dm.apply(
+        {"params": params, "cache": cache0}, prompt, pos_offset=0,
+        mutable=["cache"])
+    rng, sub = jax.random.split(rng)
+    tok0 = sample(logits_p[:, -1], sub)
 
     def step(carry, t):
         cache, tok, rng = carry
         logits, upd = dm.apply(
             {"params": params, "cache": cache}, tok[:, None],
             pos_offset=t, mutable=["cache"])
-        logits = logits[:, 0]
-        if greedy:
-            sampled = jnp.argmax(logits, -1)
-        else:
-            scaled = logits / jnp.maximum(temperature, 1e-6)
-            if top_k is not None:
-                kth = jax.lax.top_k(scaled, top_k)[0][:, -1:]
-                scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
-            rng, sub = jax.random.split(rng)
-            sampled = jax.random.categorical(sub, scaled)
-        nxt = jnp.where(t + 1 < lp, jnp.take(padded, t + 1, axis=1),
-                        sampled.astype(jnp.int32))
+        rng, sub = jax.random.split(rng)
+        nxt = sample(logits[:, 0], sub)
         return (upd["cache"], nxt, rng), nxt
 
+    # an empty scan (max_new_tokens == 1) returns the carry and 0 tokens
     (_, _, _), toks = jax.lax.scan(
-        step, (cache0, prompt[:, 0], rng), jnp.arange(total - 1))
-    return jnp.concatenate([prompt[:, :1], toks.T], axis=1)
+        step, (upd["cache"], tok0, rng), jnp.arange(lp, total - 1))
+    return jnp.concatenate([prompt, tok0[:, None], toks.T], axis=1)
 
 
 def lm_loss_with_aux(model, params, x, y, train=True, mutable=None,
