@@ -301,13 +301,18 @@ class TransformerLM(nn.Module):
 
 
 def generate(model, params, prompt, max_new_tokens: int,
-             rng=None, temperature: float = 1.0, top_k: Optional[int] = None):
+             rng=None, temperature: float = 1.0, top_k: Optional[int] = None,
+             eos_id: Optional[int] = None, pad_id: int = 0):
     """Autoregressive sampling with a per-layer KV cache.
 
     model: the TRAINING TransformerLM (decode twin derived internally);
     prompt: int32 [B, Lp]; returns int32 [B, Lp + max_new_tokens].
     ``rng=None`` → greedy argmax; else categorical at ``temperature``
-    (optionally truncated to the ``top_k`` highest logits).
+    (optionally truncated to the ``top_k`` highest logits). ``eos_id``
+    enables per-sequence early stop: once a sequence samples it, every
+    later position emits ``pad_id`` (shapes stay static — finished
+    sequences idle through the remaining scan steps, the SPMD-friendly
+    form of early exit).
 
     PREFILL + decode: the whole prompt runs through ONE forward pass that
     fills every layer's KV cache (l-token slab writes, causal inside the
@@ -357,19 +362,24 @@ def generate(model, params, prompt, max_new_tokens: int,
         mutable=["cache"])
     rng, sub = jax.random.split(rng)
     tok0 = sample(logits_p[:, -1], sub)
+    done0 = (jnp.zeros((b,), bool) if eos_id is None
+             else tok0 == eos_id)
 
     def step(carry, t):
-        cache, tok, rng = carry
+        cache, tok, rng, done = carry
         logits, upd = dm.apply(
             {"params": params, "cache": cache}, tok[:, None],
             pos_offset=t, mutable=["cache"])
         rng, sub = jax.random.split(rng)
         nxt = sample(logits[:, 0], sub)
-        return (upd["cache"], nxt, rng), nxt
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+            done = done | (nxt == eos_id)
+        return (upd["cache"], nxt, rng, done), nxt
 
     # an empty scan (max_new_tokens == 1) returns the carry and 0 tokens
-    (_, _, _), toks = jax.lax.scan(
-        step, (upd["cache"], tok0, rng), jnp.arange(lp, total - 1))
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (upd["cache"], tok0, rng, done0), jnp.arange(lp, total - 1))
     return jnp.concatenate([prompt, tok0[:, None], toks.T], axis=1)
 
 

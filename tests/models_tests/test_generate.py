@@ -77,3 +77,32 @@ def test_moe_generate_raises_clearly():
     model = _model(moe_experts_per_device=1)
     with pytest.raises(ValueError, match="MoE"):
         generate(model, {}, np.zeros((1, 4), np.int32), 2)
+
+
+def test_eos_early_stop_masks_continuations():
+    """Once a sequence emits eos_id, every later position is pad_id; other
+    sequences in the batch keep generating."""
+    model = _model(vocab=8, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+                   max_len=32, pos_emb="rope")
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 8, size=(4, 4)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    out = generate(model, params, prompt, 16,
+                   rng=jax.random.PRNGKey(1), temperature=2.0,
+                   eos_id=3, pad_id=0)
+    gen = np.asarray(out)[:, 4:]
+    # the scenario must actually exercise the mask (not pass vacuously)
+    assert any((row == 3).any() for row in gen), gen
+    for row in gen:
+        hits = np.where(row == 3)[0]
+        if hits.size:
+            after = row[hits[0] + 1:]
+            assert np.all(after == 0), row
+    # the masking changes nothing before (and including) the first eos
+    out2 = generate(model, params, prompt, 16,
+                    rng=jax.random.PRNGKey(1), temperature=2.0)
+    g2 = np.asarray(out2)[:, 4:]
+    for row, row2 in zip(gen, g2):
+        hits = np.where(row == 3)[0]
+        upto = hits[0] + 1 if hits.size else len(row)
+        np.testing.assert_array_equal(row[:upto], row2[:upto])
