@@ -39,12 +39,14 @@ from chainermn_tpu.parallel.tensor_parallel import (
     ColumnParallelDense,
     RowParallelDense,
     TensorParallelMLP,
+    pmax_stop_gradient,
+    vocab_parallel_cross_entropy,
 )
 from chainermn_tpu.parallel.ulysses import ulysses_attention
 from chainermn_tpu.ops.rotary import apply_rope
 
 __all__ = ["TransformerLM", "TransformerBlock", "generate",
-           "lm_loss_with_aux"]
+           "lm_loss_with_aux", "tp_lm_loss"]
 
 
 class TransformerBlock(nn.Module):
@@ -255,6 +257,10 @@ class TransformerLM(nn.Module):
     attention: str = "flash"
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None      # Megatron intra-op TP (see block)
+    lm_head_tp: bool = False           # column-parallel head: returns
+    #                                    VOCAB-SHARDED logits; consume with
+    #                                    vocab_parallel_cross_entropy (the
+    #                                    full [B, L, V] never materializes)
     moe_experts_per_device: int = 0
     expert_axis: str = "expert"
     capacity_factor: float = 1.25
@@ -295,8 +301,15 @@ class TransformerLM(nn.Module):
                 decode=self.decode, max_len=self.max_len,
                 name=f"block_{i}")(x, pos_offset=pos_offset)
         x = nn.LayerNorm(dtype=self.dtype)(x)
-        logits = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
-                          name="lm_head")(x)
+        if self.lm_head_tp:
+            if self.tp_axis is None:
+                raise ValueError("lm_head_tp requires tp_axis")
+            logits = ColumnParallelDense(
+                self.vocab, self.tp_axis, use_bias=False,
+                dtype=jnp.float32, name="lm_head")(x)
+        else:
+            logits = nn.Dense(self.vocab, use_bias=False, dtype=jnp.float32,
+                              name="lm_head")(x)
         return logits.astype(jnp.float32)
 
 
@@ -324,6 +337,11 @@ def generate(model, params, prompt, max_new_tokens: int,
     if model.moe_experts_per_device > 0:
         raise ValueError("generate() does not support MoE models: the "
                          "decode path has no expert dispatch")
+    if model.tp_axis is not None or model.lm_head_tp:
+        raise ValueError("generate() runs the single-device decode path; "
+                         "tp_axis/lm_head_tp models decode without TP "
+                         "(clone with tp_axis=None, lm_head_tp=False and "
+                         "gather the sharded weights)")
     dm = model.clone(decode=True)
     b, lp = prompt.shape
     total = lp + max_new_tokens
@@ -381,6 +399,38 @@ def generate(model, params, prompt, max_new_tokens: int,
     (_, _, _, _), toks = jax.lax.scan(
         step, (upd["cache"], tok0, rng, done0), jnp.arange(lp, total - 1))
     return jnp.concatenate([prompt, tok0[:, None], toks.T], axis=1)
+
+
+def tp_lm_loss(model, params, x, y, train=True, mutable=None,
+               extra_vars=None, rngs=None):
+    """Loss for ``lm_head_tp`` models: vocab-parallel cross-entropy over the
+    sharded logits (communication O(B·L), the full vocab never gathers).
+    Step-factory signature; accuracy is the global argmax assembled with
+    pmax (the shard holding the global max logit contributes its index)."""
+    from jax import lax
+
+    if not getattr(model, "lm_head_tp", False):
+        raise ValueError(
+            "tp_lm_loss expects an lm_head_tp model (sharded logits); a "
+            "replicated head would inflate the psum'd normalizer by the "
+            "axis size and desynchronize gradients")
+    variables = {"params": params, **(extra_vars or {})}
+    logits = model.apply(variables, x, rngs=rngs)
+    ax = model.tp_axis
+    loss = vocab_parallel_cross_entropy(logits, y, ax).mean()
+    # accuracy: global argmax = the shard holding the global max logit.
+    # pmax has no differentiation rule; the metric needs no gradient, so
+    # route it through the zero-cotangent custom_vjp
+    vl = logits.shape[-1]
+    lo = lax.axis_index(ax) * vl
+    local_max = jnp.max(logits, -1)
+    local_arg = (lo + jnp.argmax(logits, -1)).astype(jnp.float32)
+    global_max = pmax_stop_gradient(local_max, ax)
+    # the owning shard contributes its argmax (ties: highest shard wins)
+    mine = local_max == global_max
+    pred = pmax_stop_gradient(jnp.where(mine, local_arg, -1.0), ax)
+    acc = jnp.mean((pred == y.astype(jnp.float32)).astype(jnp.float32))
+    return loss, (acc, {})
 
 
 def lm_loss_with_aux(model, params, x, y, train=True, mutable=None,

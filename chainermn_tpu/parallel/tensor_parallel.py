@@ -162,3 +162,7 @@ def _pmax_sg_bwd(axis_name, _, g):
 
 
 _pmax_stop_gradient.defvjp(_pmax_sg_fwd, _pmax_sg_bwd)
+
+# public alias: a pmax whose gradient is defined (zero cotangent) — for
+# metrics computed alongside a differentiated loss
+pmax_stop_gradient = _pmax_stop_gradient

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from chainermn_tpu.models.transformer import TransformerBlock, TransformerLM
+from chainermn_tpu.models.transformer import (TransformerBlock,
+                                               TransformerLM, tp_lm_loss)
 
 NTP, D, H, FF, L, B = 4, 32, 4, 64, 16, 2
 
@@ -86,30 +87,43 @@ def test_tp_block_matches_scaled_local_oracle():
                                atol=2e-5)
 
 
-def test_tp_lm_trains():
-    """Full TP LM under shard_map: per-shard params (distinct rng), loss
-    decreases — exercises the collective structure with REAL distinct
-    shards, gradients flowing through psum transposes."""
+@pytest.mark.parametrize("head_tp", [False, True])
+def test_tp_lm_trains(head_tp):
+    """Full TP LM under shard_map: loss decreases — exercises the
+    collective structure with gradients flowing through psum transposes.
+    ``head_tp`` adds the column-parallel vocab head + vocab-parallel CE
+    (full logits never materialize)."""
     import optax
 
     mesh = _mesh()
     model = TransformerLM(vocab=32, d_model=D, n_heads=H, n_layers=2,
                           d_ff=FF, max_len=L, pos_emb="rope",
-                          attention="reference", tp_axis="tp")
+                          attention="reference", tp_axis="tp",
+                          lm_head_tp=head_tp)
     rng = np.random.RandomState(0)
     toks = (np.arange(L + 1)[None] + rng.randint(0, 32, size=(8, 1))) % 32
     x = jnp.asarray(toks[:, :-1], jnp.int32)
     y = jnp.asarray(toks[:, 1:], jnp.int32)
 
     def init_fn(x):
-        # SAME rng on every shard: non-TP leaves (embedding, LayerNorm,
-        # lm_head) must be identical across the model axis. Their gradients
-        # are identical too because copy_to_tp_region (Megatron's f
-        # operator, in ColumnParallelDense) psums the partial input grads —
-        # without it each shard would keep only its partial and the
-        # replicated leaves would silently desynchronize (regression
-        # checked below).
-        return model.init(jax.random.PRNGKey(0), x)["params"]
+        # SAME rng on every shard: REPLICATED leaves (embedding,
+        # LayerNorm, and the head when it is not column-parallel) must be
+        # identical across the model axis. Their gradients are identical
+        # too because copy_to_tp_region (Megatron's f operator, in
+        # ColumnParallelDense) psums the partial input grads — without it
+        # each shard would keep only its partial and the replicated leaves
+        # would silently desynchronize (regression checked below).
+        p = model.init(jax.random.PRNGKey(0), x)["params"]
+        if head_tp:
+            # the column-parallel head is legitimately SHARDED: same-rng
+            # init would tie every shard's vocab slice (a log(ntp) loss
+            # floor); decorrelate it per shard
+            r = jax.random.fold_in(jax.random.PRNGKey(7),
+                                   jax.lax.axis_index("tp"))
+            kern = p["lm_head"]["Dense_0"]["kernel"]
+            p["lm_head"]["Dense_0"]["kernel"] = (
+                0.1 * jax.random.normal(r, kern.shape, kern.dtype))
+        return p
 
     params = jax.jit(shard_map(init_fn, mesh=mesh, in_specs=P(),
                                out_specs=P("tp"), check_vma=False))(x)
@@ -118,6 +132,8 @@ def test_tp_lm_trains():
     def step(params, opt_state, x, y):
         def local(p, x, y):
             def loss_fn(p):
+                if head_tp:
+                    return tp_lm_loss(model, p, x, y)[0]
                 logits = model.apply({"params": p}, x)
                 return optax.softmax_cross_entropy_with_integer_labels(
                     logits, y).mean()
@@ -145,8 +161,10 @@ def test_tp_lm_trains():
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     for path, leaf in flat:
         name = jax.tree_util.keystr(path)
-        if any(t in name for t in ("tok_emb", "lm_head", "LayerNorm",
-                                   "pos_emb")):
+        repl = ["tok_emb", "LayerNorm", "pos_emb"]
+        if not head_tp:
+            repl.append("lm_head")   # column-parallel head is sharded
+        if any(t in name for t in repl):
             a = np.asarray(leaf)
             n_dev = NTP
             per = a.shape[0] // n_dev
