@@ -5,6 +5,13 @@ under ``mpiexec -n 2`` on one host — real MPI/NCCL, tiny world, no mocks
 (SURVEY.md §4). The TPU-native analog: force 8 host-platform devices so a
 single process gets a real 8-device mesh whose collectives are real XLA
 collectives, then run everything SPMD under jit/shard_map.
+
+1-CORE SYNC RULE: this host has one CPU core. A test loop that dispatches
+collective-bearing steps WITHOUT syncing each iteration (pull a scalar,
+e.g. ``float(metrics["main/loss"])``, or ``jax.block_until_ready``) piles
+up async executions until the XLA CPU collective rendezvous aborts the
+process ("Fatal Python error: Aborted", intermittent, load-dependent).
+Every multi-iteration training loop in this suite must sync per step.
 """
 
 import os

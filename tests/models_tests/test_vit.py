@@ -140,10 +140,10 @@ def test_data_parallel_training_learns():
     opt = chainermn_tpu.create_multi_node_optimizer(optax.adam(3e-3), comm)
     step = make_data_parallel_train_step(model, opt, comm)
     state = (params, opt.init(params))
-    first = None
+    first = last = None
     for i in range(30):
         state, m = step(state, x, y)
+        last = float(m["main/loss"])  # sync every iter (1-core rendezvous)
         if first is None:
-            first = float(m["main/loss"])
-    last = float(m["main/loss"])
+            first = last
     assert last < first * 0.5, (first, last)

@@ -55,6 +55,7 @@ def test_matches_large_batch_sgd(comm):
     step = _make_step(comm, opt)
     for _ in range(20):
         state = step(state, x, y)
+        jax.block_until_ready(state)   # per-iter sync (conftest 1-core rule)
     w_dist = np.asarray(state[0]["w"])
 
     # single-device on full batch
