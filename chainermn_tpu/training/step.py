@@ -62,6 +62,7 @@ def make_data_parallel_train_step(
     grad_accum: int = 1,
     remat: Any = False,
     with_rng: bool = False,
+    scan_steps: int = 1,
 ):
     """Build the jitted data-parallel train step.
 
@@ -78,6 +79,13 @@ def make_data_parallel_train_step(
     loss (``rng`` is one PRNGKey; each shard folds in its mesh position, and
     each micro-batch its index, so masks decorrelate). Required for models
     with dropout — without it the loss runs rng-less and flax raises.
+
+    ``scan_steps=K`` compiles K optimizer steps into ONE XLA program via
+    ``lax.scan``: the step signature becomes ``step(state, xs, ys)`` where
+    ``xs``/``ys`` carry a leading K axis (one batch per inner step) and the
+    returned metrics gain a leading K axis. One dispatch per K steps — on
+    hosts with a high per-dispatch floor (e.g. a tunneled chip) this is the
+    difference between measuring dispatch latency and measuring the device.
 
     ``grad_accum=N`` splits each shard's batch into N micro-batches and
     accumulates gradients over a ``lax.scan`` — same optimizer math as the
@@ -176,8 +184,25 @@ def make_data_parallel_train_step(
             return (params, opt_state, new_extra), metrics
         return (params, opt_state), metrics
 
+    if scan_steps > 1:
+        single = local_step
+
+        def local_step(state, xs, ys, rng=None):
+            def body(state, ixy):
+                i, x, y = ixy
+                r = None if rng is None else jax.random.fold_in(rng, i)
+                return single(state, x, y, r)
+
+            return lax.scan(
+                body, state, (jnp.arange(scan_steps), xs, ys))
+
+        # batch axis moves to dim 1 under the leading scan axis
+        batch_spec = P(None, axes if len(axes) > 1 else axes[0])
+    else:
+        batch_spec = dspec
+
     n_state = 3 if mutable else 2
-    in_specs = ((P(),) * n_state, dspec, dspec)
+    in_specs = ((P(),) * n_state, batch_spec, batch_spec)
     if with_rng:
         in_specs = in_specs + (P(),)  # the PRNGKey, replicated
     step = jax.jit(

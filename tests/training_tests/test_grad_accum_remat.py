@@ -100,3 +100,35 @@ def test_accum_rejects_indivisible_batch(comm):
     x, y = _data(comm, per=8)  # 8 per shard, not divisible by 3
     with pytest.raises(Exception):
         step(state, x, y)
+
+
+def test_scan_steps_matches_sequential(comm):
+    # K scanned steps in one program == K sequential single-step dispatches
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    model, state_a = _mlp_state(comm, opt)
+    _, state_b = _mlp_state(comm, opt)
+    K = 3
+    single = make_data_parallel_train_step(model, opt, comm, donate=False)
+    scanned = make_data_parallel_train_step(model, opt, comm, donate=False,
+                                            scan_steps=K)
+    n = comm.size * 8
+    rs = np.random.RandomState(0)
+    xs = rs.rand(K, n, 28, 28).astype(np.float32)
+    ys = rs.randint(0, 10, size=(K, n)).astype(np.int32)
+    dsh = NamedSharding(comm.mesh, P(None, comm.axis_names[0]))
+    xs_d, ys_d = jax.device_put(xs, dsh), jax.device_put(ys, dsh)
+
+    losses_a = []
+    for i in range(K):
+        state_a, ma = single(state_a, xs[i], ys[i])
+        losses_a.append(float(ma["main/loss"]))
+    state_b, mb = scanned(state_b, xs_d, ys_d)
+    assert mb["main/loss"].shape == (K,)
+    np.testing.assert_allclose(losses_a, np.asarray(mb["main/loss"]),
+                               rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        state_a[0], state_b[0],
+    )
