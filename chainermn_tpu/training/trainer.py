@@ -60,6 +60,24 @@ class StandardUpdater:
 
     def shard_batch(self, arrays):
         n = self.comm.size
+        if jax.process_count() > 1:
+            # each process feeds its LOCAL rows; assemble the global
+            # sharded array without any host ever holding the full batch
+            n_local = jax.local_device_count()
+            for a in arrays:
+                if hasattr(a, "shape") and a.shape and (
+                        a.shape[0] % n_local != 0):
+                    raise ValueError(
+                        f"per-process batch size {a.shape[0]} is not "
+                        f"divisible by the {n_local} local devices — pick "
+                        "a global batch size that is a multiple of "
+                        f"{n} (the data-axis size)"
+                    )
+            return tuple(
+                jax.make_array_from_process_local_data(
+                    self._data_sharding, np.asarray(a))
+                for a in arrays
+            )
         for a in arrays:
             if hasattr(a, "shape") and a.shape and a.shape[0] % n != 0:
                 raise ValueError(

@@ -67,6 +67,16 @@ def main():
                         "encoder) instead of the conv net")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--n-train", type=int, default=2048)
+    p.add_argument("--loader", action="store_true",
+                   help="feed batches through the native double-buffered "
+                        "prefetch loader from a file-backed uint8 dataset "
+                        "(mmap + off-thread C++ gather + on-device decode) "
+                        "instead of SerialIterator over in-memory float32")
+    p.add_argument("--data-file", default=None, metavar="PREFIX",
+                   help="with --loader: path prefix of an existing "
+                        "<PREFIX>_x.npy (uint8, N,H,W,3) + <PREFIX>_y.npy "
+                        "(int32, N) pair, mmap-opened; errors if missing. "
+                        "Default: a synthetic pair written under --out")
     p.add_argument("--dtype", choices=["float32", "bfloat16"],
                    default="bfloat16")
     p.add_argument("--snapshot-every", type=int, default=0,
@@ -85,8 +95,43 @@ def main():
         print(f"devices: {comm.size}  global batch: {global_batch}  "
               f"dtype: {args.dtype}")
 
-    train = synthetic_imagenet(args.n_train, args.image_size)
-    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
+    n_proc = jax.process_count()
+    if args.loader:
+        # File-backed uint8 dataset, mmap-opened; the native C++ loader
+        # gathers each batch's rows off-thread (double-buffered) while the
+        # device runs the previous step, and the uint8→bf16 decode +
+        # normalize happens ON DEVICE inside the compiled step — the host
+        # only ever touches bytes. Each process slices its contiguous
+        # shard of the file (shared-storage layout, reference-style).
+        base = args.data_file or os.path.join(args.out, "synthetic_u8")
+        xpath, ypath = base + "_x.npy", base + "_y.npy"
+        if args.data_file and not (os.path.exists(xpath)
+                                   and os.path.exists(ypath)):
+            raise SystemExit(
+                f"--data-file: {xpath} / {ypath} not found (expected an "
+                "existing uint8/int32 .npy pair; omit --data-file to "
+                "generate synthetic data)")
+        if comm.is_master and not os.path.exists(xpath):
+            os.makedirs(os.path.dirname(xpath) or ".", exist_ok=True)
+            rs = np.random.RandomState(0)
+            np.save(xpath, rs.randint(
+                0, 256, (args.n_train, args.image_size, args.image_size, 3),
+                dtype=np.uint8))
+            np.save(ypath, rs.randint(
+                0, 1000, size=args.n_train).astype(np.int32))
+        if n_proc > 1:
+            comm.bcast_obj(None)  # barrier: wait for the master's write
+        xs_mm = np.load(xpath, mmap_mode="r")
+        ys_mm = np.load(ypath, mmap_mode="r")
+        shard = len(xs_mm) // n_proc
+        lo = jax.process_index() * shard
+        train_len = shard * n_proc
+        train = (xs_mm[lo:lo + shard], ys_mm[lo:lo + shard])
+    else:
+        train = synthetic_imagenet(args.n_train, args.image_size)
+        train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True,
+                                              seed=0)
+        train_len = len(train) * n_proc
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.model == "vit":
@@ -103,7 +148,7 @@ def main():
     batch_stats = (comm.bcast_data(variables["batch_stats"])
                    if mutable else None)
 
-    steps_per_epoch = max(1, len(train) * comm.size // global_batch)
+    steps_per_epoch = max(1, train_len // global_batch)
     if args.warmup_epochs > 0:
         total = steps_per_epoch * args.epoch
         lr = optax.warmup_cosine_decay_schedule(
@@ -121,12 +166,35 @@ def main():
     state = ((params, optimizer.init(params), {"batch_stats": batch_stats})
              if mutable else (params, optimizer.init(params)))
 
+    loss_fn = None
+    if args.loader:
+        from chainermn_tpu.training.step import classifier_loss
+
+        def loss_fn(model, params, x, y, **kw):
+            # on-device decode: the loader ships raw uint8 rows
+            x = x.astype(dtype) / jnp.asarray(255.0, dtype)
+            return classifier_loss(model, params, x, y, **kw)
+
     step = make_data_parallel_train_step(
-        model, optimizer, comm, mutable=mutable
+        model, optimizer, comm, mutable=mutable, loss_fn=loss_fn
     )
 
-    it = SerialIterator(train, global_batch, shuffle=True, seed=0)
-    updater = StandardUpdater(it, step, state, comm)
+    if args.loader:
+        from chainermn_tpu.training.loader import PrefetchingLoader
+
+        xs_shard, ys_shard = train
+        it = PrefetchingLoader(xs_shard, ys_shard,
+                               global_batch // n_proc,
+                               shuffle=True, seed=0)
+        updater = StandardUpdater(it, step, state, comm,
+                                  converter=lambda b: b)
+    else:
+        # multi-process: each process's iterator feeds its LOCAL rows
+        # (scatter_dataset already split by process); StandardUpdater
+        # assembles the global batch across processes
+        it = SerialIterator(train, global_batch // n_proc, shuffle=True,
+                            seed=0)
+        updater = StandardUpdater(it, step, state, comm)
 
     checkpointer = None
     restored = None
