@@ -16,9 +16,10 @@ Modes:
                 of setup without changing the measurement.
   --realistic   pays an input pipeline every step: a device-resident
                 uint8 dataset (the ImageNet-shape analog of an HBM-fit
-                corpus), per-step shuffled indices from the host, and
-                on-device gather + uint8→bf16 decode + normalize fused
-                into the compiled train step. The HOST-side prefetch
+                corpus), per-step shuffled indices from the host, and a
+                separate on-device gather + uint8→bf16 decode + normalize
+                program ahead of the SAME compiled train step the default
+                mode runs. The HOST-side prefetch
                 loader path (native C++ double-buffered gather) cannot
                 feed this tunnel (~10 MB/s vs the ~375 MB/s the model
                 consumes); it is proven on the CPU mesh instead —
@@ -35,15 +36,23 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
-def _bench_default(jax, jnp, optax, chainermn_tpu, comm, model, image,
-                   per_device_batch, name, mutable):
-    from jax.sharding import NamedSharding, PartitionSpec as P
+SCAN_K = 8  # optimizer steps compiled per dispatch (both modes MUST share
+#             one step program — the default-vs-realistic comparison is
+#             meaningless otherwise)
+
+
+def _init_state_and_step(jax, optax, chainermn_tpu, comm, model, image,
+                         mutable):
+    """Model/optimizer state + the ONE train-step program both modes run.
+
+    K=SCAN_K steps per dispatch (lax.scan inside the compiled program):
+    the tunneled chip has a ~100 ms per-dispatch round-trip, so
+    one-step-per-dispatch timing would measure the tunnel, not the device
+    (docs/resnet50_roofline.md quantifies both).
+    """
     from chainermn_tpu.training.step import make_data_parallel_train_step
 
-    n_dev = comm.size
-    global_batch = per_device_batch * n_dev
-    rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, image)
+    variables = model.init(jax.random.PRNGKey(0), image)
     params = comm.bcast_data(variables["params"])
     extra = (
         {k: comm.bcast_data(variables[k]) for k in mutable}
@@ -56,13 +65,20 @@ def _bench_default(jax, jnp, optax, chainermn_tpu, comm, model, image,
         (params, opt.init(params), extra)
         if mutable else (params, opt.init(params))
     )
-    # K optimizer steps per dispatch (lax.scan inside the compiled
-    # program): the tunneled chip has a ~100 ms per-dispatch round-trip,
-    # so one-step-per-dispatch timing would measure the tunnel, not the
-    # device (docs/resnet50_roofline.md quantifies both).
-    scan_k = 8
     step = make_data_parallel_train_step(model, opt, comm, mutable=mutable,
-                                         scan_steps=scan_k)
+                                         scan_steps=SCAN_K)
+    return state, step
+
+
+def _bench_default(jax, jnp, optax, chainermn_tpu, comm, model, image,
+                   per_device_batch, name, mutable):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_dev = comm.size
+    global_batch = per_device_batch * n_dev
+    state, step = _init_state_and_step(jax, optax, chainermn_tpu, comm,
+                                       model, image, mutable)
+    scan_k = SCAN_K
 
     shape = (scan_k, global_batch) + image.shape[1:]
     axes = comm.axis_names
@@ -102,18 +118,18 @@ def _bench_default(jax, jnp, optax, chainermn_tpu, comm, model, image,
 def _bench_realistic(jax, jnp, optax, chainermn_tpu, comm, model, image,
                      per_device_batch, name, mutable):
     """Input-pipeline-paying variant: device-resident uint8 dataset,
-    host-shuffled indices, on-device gather + uint8→bf16 decode, then the
-    EXACT train-step program the default mode benchmarks."""
+    host-shuffled indices, an on-device gather+decode program, then the
+    EXACT train-step program the default mode benchmarks (two dispatches
+    + one ~8 KB index transfer per K-step iteration)."""
     import functools
 
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from chainermn_tpu.training.step import make_data_parallel_train_step
 
     mesh = comm.mesh
     axes = comm.axis_names
     ax = axes if len(axes) > 1 else axes[0]
     global_batch = per_device_batch * comm.size
-    scan_k = 8
+    scan_k = SCAN_K
     n_data = 2048  # device-resident corpus (uint8: 308 MB at 224px)
     n_classes = 1000 if name == "resnet50" else 10
     in_dtype = jnp.bfloat16 if name == "resnet50" else jnp.float32
@@ -128,22 +144,8 @@ def _bench_realistic(jax, jnp, optax, chainermn_tpu, comm, model, image,
                 jax.random.randint(ky, (n_data,), 0, n_classes, jnp.int32))
 
     data_x, data_y = synth_data(jax.random.PRNGKey(2))
-
-    variables = model.init(jax.random.PRNGKey(0), image)
-    params = comm.bcast_data(variables["params"])
-    extra = (
-        {k: comm.bcast_data(variables[k]) for k in mutable}
-        if mutable else None
-    )
-    opt = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(0.1, momentum=0.9), comm
-    )
-    state = (
-        (params, opt.init(params), extra)
-        if mutable else (params, opt.init(params))
-    )
-    step = make_data_parallel_train_step(model, opt, comm, mutable=mutable,
-                                         scan_steps=scan_k)
+    state, step = _init_state_and_step(jax, optax, chainermn_tpu, comm,
+                                       model, image, mutable)
 
     dsh = NamedSharding(mesh, P(None, ax))
 

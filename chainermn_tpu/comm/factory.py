@@ -40,12 +40,15 @@ def create_communicator(
     mesh: Optional[Mesh] = None,
     allreduce_grad_dtype: Optional[Any] = None,
     axes=None,
+    dcn_bucket_bytes: Optional[int] = None,
 ) -> XlaCommunicator:
     """Create a communicator by name.
 
     All names return an :class:`XlaCommunicator`; legacy names are topology
     aliases. ``mesh``/``axes`` allow full control (e.g. a ``('data','model')``
     mesh with two communicators for hybrid parallelism).
+    ``dcn_bucket_bytes`` bounds the flat-packed gradient buffers of
+    ``allreduce_grad`` — the multi-slice (DCN) overlap-granularity knob.
     """
     name = communicator_name
     if name not in _COMM_NAMES:
@@ -69,7 +72,8 @@ def create_communicator(
             mesh = Mesh(devs.reshape(-1, local), ("dcn", "ici"))
 
     comm = XlaCommunicator(
-        mesh=mesh, axes=axes, allreduce_grad_dtype=allreduce_grad_dtype
+        mesh=mesh, axes=axes, allreduce_grad_dtype=allreduce_grad_dtype,
+        dcn_bucket_bytes=dcn_bucket_bytes,
     )
     comm.name = name
     return comm

@@ -256,13 +256,17 @@ def _coordinator_alive() -> None:
     client-side deadline against a dead endpoint) means the coordinator is
     gone."""
     client = _client()
-    try:
-        reason = client.key_value_try_get(_ABORT_KEY)
-    except Exception:  # NotFound: nobody aborted (or see check 2 below)
-        pass
-    else:
-        raise JobAbortedError(
-            f"job aborted by a crashed peer: {reason}")
+    if hasattr(client, "key_value_try_get"):
+        # guarded: on older jaxlib clients without the method the abort-key
+        # fast path must be *visibly absent* (fall through to check 2), not
+        # a swallowed AttributeError masquerading as "no abort posted"
+        try:
+            reason = client.key_value_try_get(_ABORT_KEY)
+        except Exception:  # NotFound: nobody aborted (or see check 2)
+            pass
+        else:
+            raise JobAbortedError(
+                f"job aborted by a crashed peer: {reason}")
     last = None
     for attempt_ms in (2_000, 5_000):  # one retry: a loaded coordinator
         #                                may miss a single short deadline
@@ -307,6 +311,29 @@ def _guard_rpc(fn, budget_ms: int = 600_000):
     return result.get("v")
 
 
+def _is_deadline_error(e: Exception) -> bool:
+    """Timed-out-waiting-for-key vs transport failure.
+
+    Prefer a structured gRPC status when the client exposes one (``code()``
+    on grpc-style errors); fall back to the canonical status NAME in the
+    message (jaxlib's XlaRuntimeError stringifies as
+    'DEADLINE_EXCEEDED: ...'), and only then to loose wording — gRPC/jaxlib
+    phrasing varies across versions and a misclassified transport error
+    would be retried while a misclassified deadline aborts the collective.
+    """
+    code = getattr(e, "code", None)
+    if callable(code):
+        try:
+            name = getattr(code(), "name", "")
+            if name:
+                return name.upper() == "DEADLINE_EXCEEDED"
+        except Exception:
+            pass
+    msg = str(e).lower()
+    return ("deadline_exceeded" in msg or "deadline" in msg
+            or "timed out" in msg or "timeout" in msg)
+
+
 def _sliced_get(key: str, timeout_ms: int, raw: bool = False):
     """blocking_key_value_get with the budget sliced into short attempts,
     probing coordinator liveness between slices (fail-fast)."""
@@ -321,10 +348,8 @@ def _sliced_get(key: str, timeout_ms: int, raw: bool = False):
                 f"key {key!r} not published within {timeout_ms} ms")
         try:
             return get(key, slice_ms)
-        except Exception as e:  # noqa: BLE001 — gRPC taxonomy via message
-            msg = str(e).lower()
-            if not ("deadline" in msg or "timed out" in msg
-                    or "timeout" in msg):
+        except Exception as e:  # noqa: BLE001
+            if not _is_deadline_error(e):
                 raise  # transport error: coordinator gone — fail fast
             waited += slice_ms
             _coordinator_alive()

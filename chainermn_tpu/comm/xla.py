@@ -93,6 +93,7 @@ class XlaCommunicator(CommunicatorBase):
         mesh: Optional[Mesh] = None,
         axes: Optional[Sequence[str]] = None,
         allreduce_grad_dtype: Optional[Any] = None,
+        dcn_bucket_bytes: Optional[int] = None,
         _object_plane: Optional[ObjectPlane] = None,
     ):
         if mesh is None:
@@ -103,6 +104,7 @@ class XlaCommunicator(CommunicatorBase):
             if a not in mesh.axis_names:
                 raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
         self._grad_dtype = allreduce_grad_dtype
+        self._bucket_bytes = dcn_bucket_bytes
         self._obj = _object_plane or ObjectPlane()
         self._jit_cache = {}
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -173,14 +175,28 @@ class XlaCommunicator(CommunicatorBase):
 
     # -- sub-communicators ---------------------------------------------
 
-    def split(self, color, key=None) -> "XlaCommunicator":
-        """Split into per-color sub-communicators.
+    def split(self, color, key=None, rank: Optional[int] = None
+              ) -> "XlaCommunicator":
+        """Split into per-color sub-communicators (reference:
+        ``CommunicatorBase.split(color, key)``, any MPI coloring).
 
         ``color`` may be a length-``size`` sequence (every rank's color, the
         SPMD single-controller form of the reference's per-rank argument) or
-        the common closed forms ``('block', k)`` / ``('stride', k)``.
-        Only regular partitions are supported — they are the ones expressible
-        as a mesh axis factorization.
+        the closed forms ``('block', k)`` / ``('stride', k)``.
+
+        Regular partitions (block/strided) take the fast path: the
+        communicator's device block is re-factored into a 2-D mesh, so the
+        sub-communicator's collectives stay addressable inside ONE compiled
+        program spanning the parent mesh. Arbitrary colorings build a fresh
+        sub-mesh from the color group's devices — fully supported for
+        driver-level collectives, the object plane, and per-group
+        shard_map programs, but (by construction) an irregular group is not
+        a named axis of the parent mesh, so it cannot be psum-addressed
+        from a program compiled over the parent.
+
+        ``rank`` selects whose color group to return (default: this
+        process's rank) — the single-controller escape hatch for driving
+        several groups from one script.
 
         ``key`` (MPI rank-ordering within each group) is honored only in its
         order-preserving form — ``None`` or monotonically increasing (the
@@ -199,23 +215,41 @@ class XlaCommunicator(CommunicatorBase):
                     "(key=None or key=rank)"
                 )
         n = self._size
+        kind = None
         if isinstance(color, tuple) and color[0] in ("block", "stride"):
             kind, k = color
+            if k <= 0 or n % k != 0:
+                raise ValueError(f"group size {k} does not divide world {n}")
         else:
             colors = list(color)
             if len(colors) != n:
                 raise ValueError(f"need {n} colors, got {len(colors)}")
-            k = n // (max(colors) + 1)
-            if colors == [r // k for r in range(n)]:
-                kind = "block"
-            elif colors == [r % (n // k) for r in range(n)]:
-                kind, k = "stride", k
-            else:
-                raise ValueError(
-                    "only regular (block or strided) splits are supported on a mesh"
+            # An explicit rank asks for THAT rank's group as its own mesh —
+            # honor it even for colorings that happen to be regular, so a
+            # per-group driving loop never silently gets the SPMD
+            # axes-refactored communicator instead.
+            if rank is None:
+                k = n // (max(colors) + 1) if max(colors) >= 0 else n
+                if (k > 0 and n % k == 0
+                        and colors == [r // k for r in range(n)]):
+                    kind = "block"
+                elif (k > 0 and n % k == 0
+                      and colors == [r % (n // k) for r in range(n)]):
+                    kind = "stride"
+            if kind is None:
+                # per-group sub-mesh from the color's device list
+                r = self.rank if rank is None else rank
+                if not 0 <= r < n:
+                    raise ValueError(f"rank {r} out of range [0, {n})")
+                members = [i for i in range(n) if colors[i] == colors[r]]
+                sub = self._comm_devices()[members]
+                mesh = Mesh(sub, (f"{self._axes[0]}_split",))
+                return XlaCommunicator(
+                    mesh=mesh,
+                    allreduce_grad_dtype=self._grad_dtype,
+                    dcn_bucket_bytes=self._bucket_bytes,
+                    _object_plane=self._obj,
                 )
-        if n % k != 0:
-            raise ValueError(f"group size {k} does not divide world {n}")
         # Re-factor the communicator's device block into a 2-D mesh whose
         # second ("intra") axis walks the members of one color group.
         flat = self._comm_devices()
@@ -232,6 +266,7 @@ class XlaCommunicator(CommunicatorBase):
             mesh=mesh,
             axes=owned,
             allreduce_grad_dtype=self._grad_dtype,
+            dcn_bucket_bytes=self._bucket_bytes,
             _object_plane=self._obj,
         )
 
@@ -315,14 +350,70 @@ class XlaCommunicator(CommunicatorBase):
         )
 
     def send(self, x, dest: int, tag: int = 0):
-        raise RuntimeError(
-            "point-to-point send/recv are compiled collective-permutes; use "
-            "chainermn_tpu.functions.send/recv inside a jitted (shard_map) "
-            "program — there is no eager host-level P2P on a TPU mesh"
-        )
+        """Eager point-to-point send of concrete arrays.
+
+        Reference (mpi_communicator_base.py): mid-script blocking
+        ``comm.send(array, dest, tag)`` between processes. In-graph
+        (tracer) P2P must use :mod:`chainermn_tpu.functions` — compiled
+        ``ppermute`` — but on concrete arrays this routes device→host →
+        chunked object plane → peer process, so reference-shaped eager
+        scripts run unchanged.
+
+        Eager P2P is PROCESS-level (the reference's rank IS a process —
+        one MPI rank per GPU): ``dest``/``src`` must be the canonical
+        (first) rank of their process. Finer-than-process addressing would
+        need per-device inboxes that a host plane cannot order; targeting
+        a non-canonical rank of a multi-device process raises.
+        """
+        if _is_tracer(x):
+            raise RuntimeError(
+                "comm.send was called on a traced value: inside a jitted "
+                "(shard_map) program point-to-point transfers are compiled "
+                "collective-permutes — use chainermn_tpu.functions.send/recv"
+            )
+        dest_proc = self._rank_process(dest)
+        if dest_proc == jax.process_index():
+            raise ValueError(
+                f"eager send to rank {dest} targets this same process; "
+                "same-process shards exchange data inside the compiled "
+                "program (chainermn_tpu.functions.send/recv)"
+            )
+        payload = jax.tree_util.tree_map(np.asarray, x)  # device_get
+        self._obj.send_obj(payload, dest_proc, tag)
 
     def recv(self, src: int, tag: int = 0):
-        self.send(None, src, tag)
+        """Eager point-to-point receive (see :meth:`send`); returns
+        device-committed arrays."""
+        src_proc = self._rank_process(src)
+        if src_proc == jax.process_index():
+            raise ValueError(
+                f"eager recv from rank {src} targets this same process; "
+                "same-process shards exchange data inside the compiled "
+                "program (chainermn_tpu.functions.send/recv)"
+            )
+        obj = self._obj.recv_obj(src_proc, tag)
+        return jax.tree_util.tree_map(
+            lambda l: jnp.asarray(l) if isinstance(l, np.ndarray) else l,
+            obj,
+        )
+
+    def _rank_process(self, rank: int) -> int:
+        """Owning process of the given rank; eager P2P requires the rank to
+        be its process's canonical (first) rank — see :meth:`send`."""
+        if not 0 <= rank < self._size:
+            raise ValueError(f"rank {rank} out of range [0, {self._size})")
+        procs = [int(d.process_index) for d in self._comm_devices()]
+        proc = procs[rank]
+        first = procs.index(proc)
+        if first != rank:
+            raise ValueError(
+                f"eager P2P rank {rank} is not its process's canonical "
+                f"rank ({first}): the host object plane addresses "
+                "processes, and messages to co-located ranks would share "
+                "one ordered channel — address rank "
+                f"{first} (process {proc}) instead"
+            )
+        return proc
 
     def _replicate(self, x):
         repl = NamedSharding(self._mesh, P())
@@ -451,6 +542,13 @@ class XlaCommunicator(CommunicatorBase):
         data-dependent path) is indistinguishable from an autodiff-psummed
         per-rank sum and will also be scaled by 1/N; fold such regularizers
         into the per-rank loss (where they belong) or use ``op='sum'``.
+
+        **Bucketing** (``dcn_bucket_bytes`` on the communicator): leaves are
+        packed into flat buffers of at most that many bytes and reduced one
+        buffer at a time — the reference FlatCommunicator's pack, bounded.
+        Over ICI XLA's own fusion makes this a wash; the knob exists for the
+        multi-slice (DCN) regime, where collective message size vs. overlap
+        granularity is the tuning surface (SURVEY.md §7 "hard parts").
         """
         cdt = self._grad_dtype
 
@@ -461,6 +559,10 @@ class XlaCommunicator(CommunicatorBase):
                 return self._axes
             vma = jax.typeof(l).vma
             return tuple(a for a in self._axes if a in vma)
+
+        if (_is_tracer(grads) and self._bucket_bytes
+                and op in ("sum", "mean")):
+            return self._bucketed_allreduce_grad(grads, op, _varying_axes)
 
         def _ar(l):
             varying = _varying_axes(l)
@@ -484,6 +586,51 @@ class XlaCommunicator(CommunicatorBase):
             return jax.tree_util.tree_map(_ar, grads)
         # Driver level: stacked per-rank grads (e.g. out of a per-device map).
         return self._driver(("allreduce_grad", op, cdt), grads, stacked_in=True)
+
+    def _bucketed_allreduce_grad(self, grads, op, varying_axes_of):
+        """Flat-packed psum in ≤``dcn_bucket_bytes`` buffers.
+
+        Leaves are grouped by (varying axes, dtype-after-cast) — only
+        same-typed leaves can share a buffer — then packed greedily in
+        pytree order. Invariant leaves skip communication entirely (they
+        are already global sums under vma tracking)."""
+        from collections import defaultdict
+
+        cdt = self._grad_dtype
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        out = [None] * len(leaves)
+        groups = defaultdict(list)
+        for i, l in enumerate(leaves):
+            va = varying_axes_of(l)
+            if not va:
+                out[i] = l / self._size if op == "mean" else l
+                continue
+            comm_dtype = cdt if cdt is not None else l.dtype
+            groups[(va, jnp.dtype(comm_dtype))].append(i)
+
+        for (va, comm_dtype), idxs in groups.items():
+            buckets, cur, cur_bytes = [], [], 0
+            for i in idxs:
+                nb = leaves[i].size * comm_dtype.itemsize
+                if cur and cur_bytes + nb > self._bucket_bytes:
+                    buckets.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(i)
+                cur_bytes += nb
+            if cur:
+                buckets.append(cur)
+            for bucket in buckets:
+                flat = jnp.concatenate(
+                    [leaves[i].astype(comm_dtype).ravel() for i in bucket])
+                red = lax.psum(flat, va)
+                off = 0
+                for i in bucket:
+                    l = leaves[i]
+                    piece = red[off:off + l.size].reshape(l.shape).astype(
+                        l.dtype)
+                    off += l.size
+                    out[i] = piece / self._size if op == "mean" else piece
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     # -- misc -----------------------------------------------------------
 

@@ -79,30 +79,85 @@ def scatter_dataset(
     seed: Optional[int] = None,
     max_buf_len: int = 256 * 1024 * 1024,
     force_equal_length: bool = True,
+    shared_storage: bool = True,
 ):
     """Split ``dataset`` across the process plane; return this process's shard.
 
     Single-process: the whole dataset (shuffled view if requested) — device
-    sharding is the compiled step's job. Multi-process: the root computes the
-    index plan and scatters index arrays (cheap) — every process is assumed
-    to reach the same storage, the common TPU-pod case; processes without
-    shared storage should ship samples via ``comm.scatter_obj`` themselves.
-    ``max_buf_len`` is accepted for API parity; chunking lives in the object
-    plane transport.
+    sharding is the compiled step's job. Multi-process, ``shared_storage=
+    True`` (default): the root computes the index plan and scatters index
+    arrays (cheap) — every process reaches the same storage, the common
+    TPU-pod case. ``shared_storage=False``: reference semantics
+    (chainermn/datasets/scatter_dataset.py, SURVEY.md §3.4) — the root
+    materializes each shard's actual SAMPLES and ships them pickled over
+    the chunked object plane; non-root processes may pass ``dataset=None``
+    and receive a materialized :class:`ListDataset`. Variable-length
+    Python samples (seq2seq) ship fine — the plane pickles anything.
+    ``max_buf_len`` bounds the per-message chunk the root materializes and
+    ships (estimated from the first sample's pickle size, the reference's
+    256 MB default); the transport further slices each message at the
+    KV-store bound.
     """
     k = comm.inter_size
     if k == 1:
         # one process: it is the root whatever `root` says
         my = split_indices(len(dataset), k, shuffle, seed,
                            force_equal_length)[0]
-    else:
+        return SubDataset(dataset, my)
+    if shared_storage:
         if comm.inter_rank == root:
             plans = split_indices(len(dataset), k, shuffle, seed,
                                   force_equal_length)
         else:
             plans = None
         my = comm.scatter_obj(plans, root=root)
-    return SubDataset(dataset, my)
+        return SubDataset(dataset, my)
+    # payload shipping: the root streams each shard in ≤max_buf_len chunks
+    # (reference scatter_dataset.py behavior) — one chunk materialized at a
+    # time, so root memory stays bounded by dataset + one chunk instead of
+    # 2-3x the dataset
+    _SCATTER_TAG = 0x5CA77E0
+    if comm.inter_rank == root:
+        import pickle
+
+        plans = split_indices(len(dataset), k, shuffle, seed,
+                              force_equal_length)
+        for r in range(k):
+            if r == root:
+                continue
+            plan = plans[r]
+            if len(plan):
+                est = max(1, len(pickle.dumps(
+                    dataset[int(plan[0])], pickle.HIGHEST_PROTOCOL)))
+                per = max(1, min(len(plan), max_buf_len // est))
+            else:
+                per = 1
+            chunks = [plan[i:i + per] for i in range(0, len(plan), per)]
+            comm.send_obj(len(chunks), dest=r, tag=_SCATTER_TAG)
+            for part in chunks:
+                comm.send_obj([dataset[int(i)] for i in part], dest=r,
+                              tag=_SCATTER_TAG)
+        return ListDataset(dataset[int(i)] for i in plans[root])
+    n_chunks = comm.recv_obj(src=root, tag=_SCATTER_TAG)
+    samples = []
+    for _ in range(n_chunks):
+        samples.extend(comm.recv_obj(src=root, tag=_SCATTER_TAG))
+    return ListDataset(samples)
+
+
+class ListDataset:
+    """Received-payload shard: samples materialized on this process
+    (reference: the unpickled sub-dataset a non-root rank receives from
+    chainermn/datasets/scatter_dataset.py's chunked MPI scatter)."""
+
+    def __init__(self, samples):
+        self._samples = list(samples)
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, i):
+        return self._samples[i]
 
 
 class _EmptyDataset:

@@ -50,7 +50,16 @@ __all__ = ["TransformerLM", "TransformerBlock", "generate",
 
 
 class TransformerBlock(nn.Module):
-    """Pre-LN block: causal attention + (dense | MoE) FFN."""
+    """Pre-LN block: causal attention + (dense | MoE) FFN.
+
+    ``decode=True`` PRECONDITION: a multi-token apply (l > 1) is a PREFILL
+    and requires an EMPTY cache — it attends only within the slab, so any
+    previously cached tokens would be silently ignored (``pos`` is traced
+    and cannot be asserted). Chunked prefill (a second l > 1 apply at
+    pos > 0) is NOT supported: prefill once from pos 0, then decode
+    token-by-token (l == 1), which reads the full cache. ``generate()``
+    follows this contract.
+    """
 
     d_model: int
     n_heads: int
@@ -317,6 +326,10 @@ def generate(model, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 1.0, top_k: Optional[int] = None,
              eos_id: Optional[int] = None, pad_id: int = 0):
     """Autoregressive sampling with a per-layer KV cache.
+
+    The prompt prefills ONCE from an empty cache (the only legal l > 1
+    apply — see :class:`TransformerBlock`'s decode precondition), then
+    decoding proceeds one token at a time against the full cache.
 
     model: the TRAINING TransformerLM (decode twin derived internally);
     prompt: int32 [B, Lp]; returns int32 [B, Lp + max_new_tokens].

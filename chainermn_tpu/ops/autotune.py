@@ -42,6 +42,8 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
     `TransformerBlock`'s ``attention_blocks``.
     """
     from chainermn_tpu.ops.flash_attention import (DEFAULT_BLOCKS,
+                                                   _fit_block,
+                                                   _padded_len,
                                                    _window_cap,
                                                    flash_attention)
 
@@ -60,12 +62,18 @@ def tune_flash_blocks(batch: int, seq_len: int, heads: int, head_dim: int,
     v = jax.random.normal(ks[2], (batch, seq_len, hkv, head_dim), dtype)
 
     best, best_dt = DEFAULT_BLOCKS, float("inf")
-    # a window caps block_k inside the kernel: candidates above the cap
-    # alias the same compiled kernel — dedup so they are timed once
+    # the kernel clamps blocks to divisors of L (_fit_block) and a window
+    # caps block_k: candidates mapping to the same effective pair alias
+    # the same compiled kernel — dedup so each is timed once (short
+    # sequences, e.g. L=512, collapse several candidates)
     seen = set()
     deduped = []
     for bq, bk in candidates:
-        eff = (bq, _window_cap(bk, window))
+        # mirror the kernel wrapper's composition exactly:
+        # window-cap → pad-to-legal-length → clamp-to-divisor
+        bkc = _window_cap(bk, window)
+        eff = (_fit_block(bq, _padded_len(bq, seq_len)),
+               _fit_block(bkc, _padded_len(bkc, seq_len)))
         if eff not in seen:
             seen.add(eff)
             deduped.append((bq, bk))

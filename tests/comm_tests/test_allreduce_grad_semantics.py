@@ -100,3 +100,56 @@ def test_sum_is_identity_on_invariant_grads():
         )
     )(x)
     np.testing.assert_allclose(np.asarray(out), np.full((n,), x.sum()))
+
+
+@pytest.mark.parametrize("check_vma", [True, False])
+@pytest.mark.parametrize("comm_dtype", [None, jnp.bfloat16])
+def test_bucketed_matches_per_leaf(check_vma, comm_dtype):
+    # dcn_bucket_bytes: flat-packed psum must equal the per-leaf path,
+    # across vma modes and comm dtypes, with buckets small enough to force
+    # several buffers (mixed leaf shapes/dtypes are grouped correctly)
+    plain = chainermn_tpu.create_communicator(
+        "xla", allreduce_grad_dtype=comm_dtype)
+    packed = chainermn_tpu.create_communicator(
+        "xla", allreduce_grad_dtype=comm_dtype, dcn_bucket_bytes=64)
+    grads = {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": {"w": np.ones((7,), np.float32), "s": np.float32(2.0)},
+        "c": np.full((5, 5), 0.25, np.float32),
+    }
+    xspec = P(plain.axis_names[0])
+    n = plain.size
+
+    def make(comm):
+        def f(x):
+            # per-shard grads: scale a fixed pytree by a varying factor
+            scale = (jax.lax.axis_index(comm.axis_names[0]) + 1).astype(
+                jnp.float32)
+            g = jax.tree_util.tree_map(lambda l: l * scale, x)
+            return comm.allreduce_grad(g, "mean")
+
+        return jax.jit(shard_map(
+            f, mesh=comm.mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=check_vma))
+
+    out_plain = make(plain)(grads)
+    out_packed = make(packed)(grads)
+    expect_scale = np.mean(np.arange(1, n + 1))
+    jax.tree_util.tree_map(
+        lambda p, q, ref: (
+            np.testing.assert_allclose(np.asarray(p), np.asarray(q),
+                                       rtol=1e-2),
+            np.testing.assert_allclose(
+                np.asarray(q), np.asarray(ref) * expect_scale, rtol=1e-2),
+        ),
+        out_plain, out_packed, grads)
+
+
+def test_bucketed_convergence():
+    comm = chainermn_tpu.create_communicator(
+        "xla", allreduce_grad_dtype=jnp.bfloat16, dcn_bucket_bytes=4)
+    w = _train(comm, check_vma=True)
+    np.testing.assert_allclose(w, [3.0, 1.0], atol=5e-2)
+
+# the <2-minute parity battery (see pyproject.toml markers)
+pytestmark = pytest.mark.quick

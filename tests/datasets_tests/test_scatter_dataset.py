@@ -59,3 +59,6 @@ def test_create_empty_dataset():
     assert len(ds) == 0
     with pytest.raises(IndexError):
         ds[0]
+
+# the <2-minute parity battery (see pyproject.toml markers)
+pytestmark = pytest.mark.quick

@@ -258,3 +258,6 @@ def test_orbax_backend_round_trip(comm, tmp_path, async_write):
     # GC kept only the rolling window of directory snapshots
     kept = sorted(cp._iters_on_disk())
     assert kept == [3, 4]
+
+# the <2-minute parity battery (see pyproject.toml markers)
+pytestmark = pytest.mark.quick

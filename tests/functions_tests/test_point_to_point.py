@@ -148,3 +148,6 @@ def test_transfer_multi_axis_mesh():
         got = np.asarray(got)
         assert got[2] == 1.0, (axes, got)
         assert got[1] == 0.0 or got[1] != 1.0  # rank 1 got nothing back
+
+# the <2-minute parity battery (see pyproject.toml markers)
+pytestmark = pytest.mark.quick

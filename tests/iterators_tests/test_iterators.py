@@ -52,3 +52,6 @@ def test_synchronized_iterator_reseeds():
     out = create_synchronized_iterator(it, comm)
     batch = out.next()
     assert len(batch) == 4
+
+# the <2-minute parity battery (see pyproject.toml markers)
+pytestmark = pytest.mark.quick

@@ -132,3 +132,6 @@ def test_add_link_requires_rank(comm):
     chain = MultiNodeChainList(comm)
     with pytest.raises(ValueError):
         chain.add_link(Part(4), rank_in=None, rank_out=1)
+
+# the <2-minute parity battery (see pyproject.toml markers)
+pytestmark = pytest.mark.quick

@@ -125,3 +125,6 @@ def test_large_batch_optimizers_compose(comm, base):
     for _ in range(300):
         params, ost, loss = step(params, ost, x, y)
     assert float(loss) < 5e-2, float(loss)
+
+# the <2-minute parity battery (see pyproject.toml markers)
+pytestmark = pytest.mark.quick

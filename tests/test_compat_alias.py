@@ -87,3 +87,6 @@ def test_legacy_communicator_names():
     for name in ("naive", "flat", "pure_nccl", "single_node"):
         comm = chainermn.create_communicator(name)
         assert comm.size >= 1
+
+# the <2-minute parity battery (see pyproject.toml markers)
+pytestmark = pytest.mark.quick
