@@ -1,4 +1,9 @@
 from .expert_parallel import ExpertParallelMLP, switch_dispatch
+from .hetero_pipeline import (
+    HeteroPipeline,
+    hetero_pipeline_1f1b_value_and_grad,
+    hetero_pipeline_apply,
+)
 from .pipeline import (
     build_interleaved_schedule,
     pipeline_1f1b_value_and_grad,
@@ -29,6 +34,9 @@ __all__ = [
     "pipeline_interleaved_1f1b_value_and_grad",
     "build_interleaved_schedule",
     "stack_stage_params",
+    "HeteroPipeline",
+    "hetero_pipeline_1f1b_value_and_grad",
+    "hetero_pipeline_apply",
     "ColumnParallelDense",
     "RowParallelDense",
     "TensorParallelMLP",

@@ -1,0 +1,237 @@
+"""Heterogeneous pipeline stages over the homogeneous 1F1B kernels.
+
+The scheduling kernels in :mod:`chainermn_tpu.parallel.pipeline` move ONE
+activation shape around the ring and ONE stacked parameter structure across
+shards — the homogeneous-pipeline contract. Real models are not homogeneous:
+an LM is embed → N×block → head, with int32 tokens in, [mb, L, D]
+activations between blocks, and [mb, L, vocab] logits out, and per-stage
+parameter pytrees of different structures.
+
+This module lifts that restriction WITHOUT touching the scheduling kernels,
+by compiling heterogeneity away at the edges (reference parity:
+MultiNodeChainList composes arbitrary per-rank chains —
+chainermn/links/multi_node_chain_list.py, SURVEY.md §2.4 — but sequentially;
+here they ride the micro-batched 1F1B schedule):
+
+* **Activation wire**: every inter-stage edge is encoded into one flat
+  ``[W]`` buffer (ravel → cast → zero-pad to the widest edge). Decoding
+  slices, casts and reshapes — all free (layout-only) in XLA. Integer
+  inputs (token ids) round-trip exactly through the float wire for values
+  < 2^24.
+* **Parameter wire**: each stage's param pytree is flattened into a flat
+  f32 vector padded to the widest stage, stacked ``[S, P]`` and sharded
+  over the stage axis — each device materializes ONLY its own stage's
+  (padded) parameters, preserving the pipeline's memory scaling. The
+  pad-to-max cost means trunk devices pay the embed/head stage's padded
+  size; grouping by structure would remove that and is left as a
+  scheduling-neutral optimization.
+* **Stage dispatch**: one ``lax.switch`` on ``lax.axis_index(axis_name)``
+  picks this device's stage function; every branch has the uniform
+  signature ``([P] f32, [W] wire) -> [W] wire``, so the kernels see a
+  shape-preserving homogeneous ``stage_fn``. ``lax.switch`` is
+  differentiable, so the kernels' in-stage remat vjp works unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.parallel.pipeline import (
+    pipeline_1f1b_value_and_grad,
+    pipeline_apply,
+)
+
+
+def _aval(x):
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+class HeteroPipeline:
+    """Codec + dispatch layer turning per-stage (fn, params) pairs into the
+    homogeneous wire-format pipeline the scheduling kernels require.
+
+    Args:
+      stage_defs: ``[(fn_0, params_0), ..., (fn_{S-1}, params_{S-1})]`` —
+        ``fn_s(params_s, x) -> y`` with arbitrary (static) activation
+        shapes; stage s+1 consumes stage s's output. Params must be
+        inexact-dtype pytrees (they are trained).
+      sample_mb: one example micro-batch (array or ShapeDtypeStruct) —
+        stage 0's input, e.g. int32 ``[mb, L]`` tokens.
+      axis_name: the stage mesh axis (the shard_map axis the kernels run
+        over). ``len(stage_defs)`` must equal the axis size at run time.
+      wire_dtype: activation wire dtype; default = the widest dtype among
+        the edges (``jnp.result_type`` over all stage inputs/outputs).
+    """
+
+    def __init__(self, stage_defs: Sequence[Tuple[Callable, Any]],
+                 sample_mb, axis_name: str, wire_dtype=None):
+        self.axis_name = axis_name
+        self.fns = [f for f, _ in stage_defs]
+        self.params = [p for _, p in stage_defs]
+        self.S = len(stage_defs)
+        if self.S < 1:
+            raise ValueError("need at least one stage")
+
+        # ---- activation avals along the chain -------------------------
+        avals = [_aval(sample_mb) if not isinstance(
+            sample_mb, jax.ShapeDtypeStruct) else sample_mb]
+        for fn, p in stage_defs:
+            out = jax.eval_shape(fn, p, avals[-1])
+            if not isinstance(out, jax.ShapeDtypeStruct):
+                raise ValueError(
+                    "each stage must return a single array; got "
+                    f"{jax.tree_util.tree_structure(out)}")
+            avals.append(out)
+        self.in_avals = avals[:-1]   # stage s consumes in_avals[s]
+        self.out_avals = avals[1:]   # stage s produces out_avals[s]
+
+        sizes = [int(np.prod(a.shape, initial=1)) for a in avals]
+        self.wire_elems = max(sizes)
+        if wire_dtype is None:
+            wire_dtype = jnp.result_type(*[a.dtype for a in avals])
+        self.wire_dtype = jnp.dtype(wire_dtype)
+        for a in avals:
+            if (jnp.issubdtype(a.dtype, jnp.integer)
+                    and jnp.issubdtype(self.wire_dtype, jnp.floating)):
+                # int edge riding a float wire: exact only below the
+                # mantissa bound (f32 → 2^24 covers any real vocab;
+                # f16 → 2^11 and bf16 → 2^8 do not)
+                mant = jnp.finfo(self.wire_dtype).nmant
+                if 2 ** (mant + 1) < 2 ** 24:
+                    raise ValueError(
+                        f"integer activations cannot ride a "
+                        f"{self.wire_dtype} wire ({mant}-bit mantissa: "
+                        f"exact only below {2 ** (mant + 1)}); pass "
+                        "wire_dtype=jnp.float32")
+
+        # ---- per-stage flat parameter layout --------------------------
+        # ravel_pytree handles flatten + unravel-with-dtype-restore; this
+        # layer only adds the f32 cast and pad-to-max
+        from jax.flatten_util import ravel_pytree
+
+        self._flat_params: List[jnp.ndarray] = []
+        self._unravel: List[Callable] = []
+        for p in self.params:
+            for l in jax.tree_util.tree_leaves(p):
+                if not jnp.issubdtype(jnp.result_type(l), jnp.floating):
+                    raise ValueError(
+                        "stage params must be floating-point (trainable) "
+                        f"leaves — the param wire is f32; got "
+                        f"{jnp.result_type(l)}")
+            flat, unravel = ravel_pytree(p)
+            # remember ravel's own dtype: unravel expects it back
+            self._flat_params.append(flat)
+            self._unravel.append(unravel)
+        self.param_elems = max(
+            [f.size for f in self._flat_params], default=1) or 1
+
+    # ---- codecs -------------------------------------------------------
+
+    def encode_act(self, x):
+        """ravel → cast → pad to the wire width."""
+        flat = jnp.ravel(x).astype(self.wire_dtype)
+        return jnp.pad(flat, (0, self.wire_elems - flat.size))
+
+    def decode_act(self, wire, aval):
+        n = int(np.prod(aval.shape, initial=1))
+        return wire[:n].astype(aval.dtype).reshape(aval.shape)
+
+    def encode_inputs(self, x_microbatches):
+        """[M, ...] micro-batches → [M, W] wire buffers (stage 0 feed)."""
+        return jax.vmap(self.encode_act)(jnp.asarray(x_microbatches))
+
+    def pack_params(self) -> jnp.ndarray:
+        """[S, P] f32 stack — shard over the stage axis (P(axis_name))."""
+        return jnp.stack([
+            jnp.pad(f.astype(jnp.float32),
+                    (0, self.param_elems - f.size))
+            for f in self._flat_params
+        ])
+
+    def _unflatten(self, s: int, flat):
+        f = self._flat_params[s]
+        return self._unravel[s](flat[:f.size].astype(f.dtype))
+
+    def unpack_grads(self, flat_grads) -> List[Any]:
+        """[S, P] flat gradient stack → per-stage param-pytree grads.
+
+        The parameter wire is f32, so each leaf's gradient comes back as
+        the f32 cotangent of the cast — cast to the leaf dtype here.
+        """
+        out = []
+        for s in range(self.S):
+            out.append(self._unflatten(s, jnp.asarray(flat_grads)[s]))
+        return out
+
+    # ---- in-shard_map pieces ------------------------------------------
+
+    def stage_fn(self, flat_params, wire_h):
+        """The homogeneous ``(params, h) -> h`` the kernels schedule:
+        switch on this device's stage index."""
+        n_ax = lax.axis_size(self.axis_name)  # static at trace time
+        if n_ax != self.S:
+            raise ValueError(
+                f"HeteroPipeline has {self.S} stages but axis "
+                f"{self.axis_name!r} spans {n_ax} devices — lax.switch "
+                "would silently clamp extra devices onto the last stage")
+        branches = []
+        for s in range(self.S):
+            def branch(flat, wire, s=s):
+                x = self.decode_act(wire, self.in_avals[s])
+                y = self.fns[s](self._unflatten(s, flat), x)
+                return self.encode_act(y)
+
+            branches.append(branch)
+        my = lax.axis_index(self.axis_name)
+        return lax.switch(my, branches, flat_params, wire_h)
+
+    def wire_loss_fn(self, loss_fn):
+        """Wrap ``loss_fn(decoded_last_output, tgt)`` for the wire."""
+        last = self.out_avals[-1]
+
+        def f(wire_out, tgt):
+            return loss_fn(self.decode_act(wire_out, last), tgt)
+
+        return f
+
+
+def hetero_pipeline_1f1b_value_and_grad(
+    pipe: HeteroPipeline,
+    loss_fn: Callable,
+    packed_params,
+    x_microbatches_wire,
+    y_microbatches,
+):
+    """1F1B train step over heterogeneous stages — call INSIDE shard_map.
+
+    Args:
+      pipe: the :class:`HeteroPipeline` (built once, outside).
+      loss_fn: ``(last_stage_output, target) -> scalar`` on DECODED outputs.
+      packed_params: THIS shard's ``[P]`` flat stage parameters (shard
+        ``pipe.pack_params()`` with ``P(axis_name)`` and strip the leading
+        axis in-shard, exactly like ``stack_stage_params``).
+      x_microbatches_wire: ``[M, W]`` wire-encoded inputs
+        (``pipe.encode_inputs``), replicated.
+      y_microbatches: ``[M, ...]`` targets, replicated.
+
+    Returns ``(loss, flat_grads [P])`` — decode grads with
+    ``pipe.unpack_grads`` after stacking shards back (out_specs P(axis)).
+    """
+    return pipeline_1f1b_value_and_grad(
+        pipe.stage_fn, pipe.wire_loss_fn(loss_fn), packed_params,
+        x_microbatches_wire, y_microbatches, pipe.axis_name)
+
+
+def hetero_pipeline_apply(pipe: HeteroPipeline, packed_params,
+                          x_microbatches_wire):
+    """GPipe-style forward over heterogeneous stages — call INSIDE
+    shard_map. Returns [M, W] wire outputs; decode with
+    ``pipe.decode_act(out[j], pipe.out_avals[-1])``."""
+    return pipeline_apply(pipe.stage_fn, packed_params,
+                          x_microbatches_wire, pipe.axis_name)
