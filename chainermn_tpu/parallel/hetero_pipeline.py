@@ -67,10 +67,15 @@ class HeteroPipeline:
         over). ``len(stage_defs)`` must equal the axis size at run time.
       wire_dtype: activation wire dtype; default = the widest dtype among
         the edges (``jnp.result_type`` over all stage inputs/outputs).
+      int_bound: exclusive upper bound the caller guarantees for values on
+        integer edges (token ids, …); the wire must represent every value
+        below it exactly or construction fails. Default 2^24 — the f32
+        mantissa bound, enough for any real vocabulary.
     """
 
     def __init__(self, stage_defs: Sequence[Tuple[Callable, Any]],
-                 sample_mb, axis_name: str, wire_dtype=None):
+                 sample_mb, axis_name: str, wire_dtype=None,
+                 int_bound: int = 2 ** 24):
         self.axis_name = axis_name
         self.fns = [f for f, _ in stage_defs]
         self.params = [p for _, p in stage_defs]
@@ -101,14 +106,17 @@ class HeteroPipeline:
                     and jnp.issubdtype(self.wire_dtype, jnp.floating)):
                 # int edge riding a float wire: exact only below the
                 # mantissa bound (f32 → 2^24 covers any real vocab;
-                # f16 → 2^11 and bf16 → 2^8 do not)
+                # f16 → 2^11 and bf16 → 2^9 do not). ``int_bound`` is the
+                # caller's declared exclusive upper bound on integer edge
+                # values (token ids etc.).
                 mant = jnp.finfo(self.wire_dtype).nmant
-                if 2 ** (mant + 1) < 2 ** 24:
+                if 2 ** (mant + 1) < int_bound:
                     raise ValueError(
-                        f"integer activations cannot ride a "
-                        f"{self.wire_dtype} wire ({mant}-bit mantissa: "
-                        f"exact only below {2 ** (mant + 1)}); pass "
-                        "wire_dtype=jnp.float32")
+                        f"integer activations up to int_bound={int_bound} "
+                        f"cannot ride a {self.wire_dtype} wire "
+                        f"({mant}-bit mantissa: exact only below "
+                        f"{2 ** (mant + 1)}); use wire_dtype=jnp.float32 "
+                        "or declare a smaller int_bound")
 
         # ---- per-stage flat parameter layout --------------------------
         # ravel_pytree handles flatten + unravel-with-dtype-restore; this
@@ -119,11 +127,13 @@ class HeteroPipeline:
         self._unravel: List[Callable] = []
         for p in self.params:
             for l in jax.tree_util.tree_leaves(p):
-                if not jnp.issubdtype(jnp.result_type(l), jnp.floating):
+                dt = jnp.result_type(l)
+                if (not jnp.issubdtype(dt, jnp.floating)
+                        or jnp.dtype(dt).itemsize > 4):
                     raise ValueError(
-                        "stage params must be floating-point (trainable) "
-                        f"leaves — the param wire is f32; got "
-                        f"{jnp.result_type(l)}")
+                        "stage params must be <=32-bit floating-point "
+                        f"leaves — the param wire is f32 and would "
+                        f"silently truncate {dt}")
             flat, unravel = ravel_pytree(p)
             # remember ravel's own dtype: unravel expects it back
             self._flat_params.append(flat)
