@@ -74,6 +74,9 @@ def create_communicator(
     comm = XlaCommunicator(
         mesh=mesh, axes=axes, allreduce_grad_dtype=allreduce_grad_dtype,
         dcn_bucket_bytes=dcn_bucket_bytes,
+        # reference parity: NonCudaAwareCommunicator stages driver-level
+        # arrays through host memory (non_cuda_aware_communicator.py)
+        host_staged=(name == "non_cuda_aware"),
     )
     comm.name = name
     return comm

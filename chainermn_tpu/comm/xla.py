@@ -94,6 +94,7 @@ class XlaCommunicator(CommunicatorBase):
         axes: Optional[Sequence[str]] = None,
         allreduce_grad_dtype: Optional[Any] = None,
         dcn_bucket_bytes: Optional[int] = None,
+        host_staged: bool = False,
         _object_plane: Optional[ObjectPlane] = None,
     ):
         if mesh is None:
@@ -105,6 +106,7 @@ class XlaCommunicator(CommunicatorBase):
                 raise ValueError(f"axis {a!r} not in mesh axes {mesh.axis_names}")
         self._grad_dtype = allreduce_grad_dtype
         self._bucket_bytes = dcn_bucket_bytes
+        self._host_staged = host_staged
         self._obj = _object_plane or ObjectPlane()
         self._jit_cache = {}
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -286,7 +288,60 @@ class XlaCommunicator(CommunicatorBase):
             return jax.tree_util.tree_map(
                 lambda l: _reduce_in_graph(l, self._axes, op), x
             )
+        if self._host_staged:
+            return self._host_staged_allreduce(x, op)
         return self._driver(("allreduce", op), x, stacked_in=True)
+
+    def _host_staged_allreduce(self, x, op, comm_dtype=None):
+        """Driver-level allreduce through host memory + the object plane —
+        the reference NonCudaAwareCommunicator's path (device → host
+        staging buffer → MPI → device; non_cuda_aware_communicator.py).
+        Debugging fallback, not a perf path; in-graph collectives stay
+        compiled (there is no host in a compiled program to stage through).
+
+        Stacking contract: single-process, the leading axis is the FULL
+        rank space (``comm.size``, the single-controller driver contract);
+        multi-process, each process stacks only its LOCAL ranks
+        (``size // inter_size``) and the cross-process reduction of the
+        per-process partials rides the object plane — never a compiled
+        collective.
+        """
+        np_ops = {"sum": np.sum, "max": np.max, "min": np.min}
+        if op not in np_ops and op != "mean":
+            raise ValueError(f"unsupported allreduce op: {op!r}")
+        if self.inter_size > 1 and self._size % self.inter_size:
+            raise ValueError(
+                f"host-staged allreduce needs equal per-process rank "
+                f"counts; size {self._size} over {self.inter_size} "
+                "processes")
+        expected = (self._size if self.inter_size == 1
+                    else self._size // self.inter_size)
+        # mean = global sum / global count (a mean of per-process means
+        # would only be correct by the equal-count guarantee; the sum form
+        # is correct by construction)
+        base_op = "sum" if op == "mean" else op
+
+        def one(l):
+            l = np.asarray(l)  # device → host
+            if l.ndim == 0 or l.shape[0] != expected:
+                raise ValueError(
+                    f"host-staged collective expects a stacked array with "
+                    f"leading axis {expected} "
+                    f"({'per-rank' if self.inter_size == 1 else 'LOCAL ranks'}),"
+                    f" got {l.shape}")
+            orig = l.dtype
+            if comm_dtype is not None:
+                l = l.astype(comm_dtype)
+            red = np_ops[base_op](l, axis=0)
+            if self.inter_size > 1:
+                parts = self._obj.allgather_obj(red)  # host transport
+                red = np_ops[base_op](np.stack(parts), axis=0)
+            red = np.asarray(red, orig)  # comm-dtype round-trip ends here
+            if op == "mean":
+                red = np.asarray(red / self._size, orig)
+            return self._replicate(red)  # host → device
+
+        return jax.tree_util.tree_map(one, x)
 
     def bcast(self, x, root: int = 0):
         if _is_tracer(x):
@@ -324,6 +379,12 @@ class XlaCommunicator(CommunicatorBase):
                 x,
             )
         # stacked [size, size, ...]: out[s, r] = in[r, s]
+        if self._host_staged:
+            # host-staged transpose (single-controller stacked form)
+            return jax.tree_util.tree_map(
+                lambda l: self._replicate(np.swapaxes(np.asarray(l), 0, 1)),
+                x,
+            )
         return self._driver(("alltoall",), x, stacked_in=True)
 
     def gather(self, x, root: int = 0):
@@ -585,6 +646,10 @@ class XlaCommunicator(CommunicatorBase):
         if _is_tracer(grads):
             return jax.tree_util.tree_map(_ar, grads)
         # Driver level: stacked per-rank grads (e.g. out of a per-device map).
+        if self._host_staged:
+            # the reference NonCudaAwareCommunicator's actual hot path:
+            # grads staged through host, comm-dtype cast included
+            return self._host_staged_allreduce(grads, op, comm_dtype=cdt)
         return self._driver(("allreduce_grad", op, cdt), grads, stacked_in=True)
 
     def _bucketed_allreduce_grad(self, grads, op, varying_axes_of):

@@ -19,9 +19,13 @@ deadlock class is gone: the schedule is fixed at trace time. Gradients flow
 backward through the reversed permutes automatically.
 
 Memory note: stage parameters are replicated in this executor (every shard
-traces every stage). The memory-scaling path for deep homogeneous pipelines
-is the stacked ``lax.scan`` pipeline (parallel/pipeline.py), which shards
-stage parameters over the mesh axis.
+traces every stage) — parity-true, since the reference schedule is
+sequential anyway. For LINEAR chains, :meth:`MultiNodeChainList.
+to_hetero_pipeline` lowers the same registry onto the micro-batched 1F1B
+pipeline (parallel/hetero_pipeline.py): per-stage parameters sharded over
+the mesh axis (each device holds only its stage) and the fill/drain bubble
+amortized over micro-batches — true memory AND compute scaling, beyond the
+reference. Branching graphs stay on this executor.
 """
 
 from __future__ import annotations
@@ -145,3 +149,57 @@ class MultiNodeChainList:
         return outputs[0] if len(outputs) == 1 else tuple(outputs)
 
     __call__ = apply
+
+    # ------------------------------------------------------------------
+
+    def _check_linear(self):
+        """The chain must be rank 0 → 1 → … → S-1 with no branching."""
+        S = len(self._stages)
+        for i, st in enumerate(self._stages):
+            ok = (st.rank == i
+                  and st.rank_in == (() if i == 0 else (i - 1,))
+                  and st.rank_out == (() if i == S - 1 else (i + 1,)))
+            if not ok:
+                raise ValueError(
+                    f"stage {i} (rank={st.rank}, rank_in={st.rank_in}, "
+                    f"rank_out={st.rank_out}) breaks the linear chain "
+                    "0→1→…→S-1; branching/reordered graphs run on the "
+                    "SPMD apply() executor instead"
+                )
+
+    def to_hetero_pipeline(self, params: Sequence[Any], sample_mb,
+                           **pipe_kwargs):
+        """Lower a LINEAR chain onto the 1F1B pipeline (memory scaling).
+
+        Args:
+          params: the per-stage params from :meth:`init`.
+          sample_mb: one micro-batch example (array or ShapeDtypeStruct)
+            of the chain's input — note this is a MICRO-batch: the 1F1B
+            caller splits its global batch into ``[M, mb, ...]``.
+          pipe_kwargs: forwarded to :class:`HeteroPipeline`
+            (``wire_dtype``, ``int_bound``).
+
+        Returns the :class:`~chainermn_tpu.parallel.HeteroPipeline`:
+        ``pack_params()`` gives the ``[S, P]`` stack to shard over the
+        communicator's axis, and
+        :func:`~chainermn_tpu.parallel.hetero_pipeline_1f1b_value_and_grad`
+        runs the training step inside shard_map. Each device then holds
+        ONLY its own stage's parameters — the scaling the replicated
+        ``apply()`` executor forgoes.
+        """
+        from chainermn_tpu.parallel import HeteroPipeline
+
+        self._check_linear()
+
+        def stage_fn(module):
+            if hasattr(module, "apply"):
+                return lambda p, h: module.apply(p, h)
+            return lambda p, h: module(p if p else None, h)
+
+        stage_defs = [
+            (stage_fn(st.module), p if p is not None else {})
+            for st, p in zip(self._stages, params)
+        ]
+        return HeteroPipeline(stage_defs, sample_mb,
+                              axis_name=self.comm.axis_names[0],
+                              **pipe_kwargs)

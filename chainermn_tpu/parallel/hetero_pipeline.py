@@ -106,7 +106,7 @@ class HeteroPipeline:
                     and jnp.issubdtype(self.wire_dtype, jnp.floating)):
                 # int edge riding a float wire: exact only below the
                 # mantissa bound (f32 → 2^24 covers any real vocab;
-                # f16 → 2^11 and bf16 → 2^9 do not). ``int_bound`` is the
+                # f16 → 2^11 and bf16 → 2^8 do not). ``int_bound`` is the
                 # caller's declared exclusive upper bound on integer edge
                 # values (token ids etc.).
                 mant = jnp.finfo(self.wire_dtype).nmant

@@ -181,6 +181,8 @@ def test_bcast_in_graph_nan_safe(any_comm):
 def test_driver_jit_cache(any_comm):
     # repeated driver collectives must reuse the cached jitted op
     comm = any_comm
+    if getattr(comm, "_host_staged", False):
+        pytest.skip("non_cuda_aware stages through host, no jitted op")
     x = _stacked(comm, (4,), np.float32)
     comm.allreduce(x, "sum")
     cached = comm._jit_cache.get(("allreduce", "sum"))
@@ -382,3 +384,33 @@ def test_split_reordering_key_still_raises():
 
 # the <2-minute parity battery (see pyproject.toml markers)
 pytestmark = pytest.mark.quick
+
+
+def test_non_cuda_aware_host_staged_allreduce():
+    # the host-staged array path: same numbers as the compiled driver
+    # collective, but through host memory (no jitted op cached)
+    comm = chainermn_tpu.create_communicator("non_cuda_aware")
+    ref = chainermn_tpu.create_communicator("xla")
+    x = _stacked(comm, (3, 4), np.float32)
+    for op in ("sum", "mean", "max", "min"):
+        a = np.asarray(comm.allreduce(x, op))
+        b = np.asarray(ref.allreduce(x, op))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    assert ("allreduce", "sum") not in comm._jit_cache  # host path
+    out = comm.allreduce(x, "sum")
+    assert out.sharding.is_fully_replicated  # staged back onto devices
+    # allreduce_grad (the reference NonCudaAware hot path) stages too,
+    # including the comm-dtype round trip
+    comm_bf16 = chainermn_tpu.create_communicator(
+        "non_cuda_aware", allreduce_grad_dtype=jnp.bfloat16)
+    g = {"w": _stacked(comm, (4,), np.float32)}
+    got = comm_bf16.allreduce_grad(g, "mean")
+    np.testing.assert_allclose(
+        np.asarray(got["w"]), g["w"].mean(0), rtol=1e-2)
+    assert not comm_bf16._jit_cache  # nothing compiled
+    # alltoall host transpose
+    n = comm.size
+    a2a = np.arange(n * n * 2, dtype=np.float32).reshape(n, n, 2)
+    np.testing.assert_allclose(
+        np.asarray(comm.alltoall(a2a)), np.swapaxes(a2a, 0, 1))
+    assert not comm._jit_cache

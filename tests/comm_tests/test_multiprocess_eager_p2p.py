@@ -142,3 +142,56 @@ def test_two_process_eager_p2p(tmp_path):
 def test_hierarchical_bf16_bucketed_training(tmp_path):
     procs, outs = run_workers(_HIER_WORKER, tmp_path, timeout=140)
     assert_all_ok(procs, outs)
+
+
+_NCA_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+import jax.numpy as jnp
+import chainermn_tpu
+
+comm = chainermn_tpu.create_communicator(
+    "non_cuda_aware", allreduce_grad_dtype=jnp.bfloat16)
+assert comm.inter_size == 2 and comm.size == 2
+
+# multi-process contract: each process stacks its LOCAL ranks (1 here)
+local = np.asarray([[10.0 * (proc_id + 1), 1.0 + proc_id]], np.float32)
+out = np.asarray(comm.allreduce(local, "sum"))
+np.testing.assert_allclose(out, [30.0, 3.0])
+out = np.asarray(comm.allreduce(local, "mean"))
+np.testing.assert_allclose(out, [15.0, 1.5])
+out = np.asarray(comm.allreduce(local, "max"))
+np.testing.assert_allclose(out, [20.0, 2.0])
+
+# comm-dtype grad path across processes, also host-staged
+g = {"w": np.asarray([[1.0 + proc_id, 4.0]], np.float32)}
+got = comm.allreduce_grad(g, "mean")
+np.testing.assert_allclose(np.asarray(got["w"]), [1.5, 4.0], rtol=1e-2)
+assert not comm._jit_cache  # never compiled a collective
+
+# a full-rank-space stack is the single-controller form: rejected here
+try:
+    comm.allreduce(np.zeros((2, 3), np.float32), "sum")
+except ValueError:
+    pass
+else:
+    raise AssertionError("global stack should be rejected multi-process")
+
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(150)
+def test_two_process_host_staged_allreduce(tmp_path):
+    procs, outs = run_workers(_NCA_WORKER, tmp_path, timeout=140)
+    assert_all_ok(procs, outs)
