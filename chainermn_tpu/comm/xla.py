@@ -250,6 +250,7 @@ class XlaCommunicator(CommunicatorBase):
                     mesh=mesh,
                     allreduce_grad_dtype=self._grad_dtype,
                     dcn_bucket_bytes=self._bucket_bytes,
+                    host_staged=self._host_staged,
                     _object_plane=self._obj,
                 )
         # Re-factor the communicator's device block into a 2-D mesh whose
@@ -269,6 +270,7 @@ class XlaCommunicator(CommunicatorBase):
             axes=owned,
             allreduce_grad_dtype=self._grad_dtype,
             dcn_bucket_bytes=self._bucket_bytes,
+            host_staged=self._host_staged,
             _object_plane=self._obj,
         )
 
@@ -338,7 +340,11 @@ class XlaCommunicator(CommunicatorBase):
                 red = np_ops[base_op](np.stack(parts), axis=0)
             red = np.asarray(red, orig)  # comm-dtype round-trip ends here
             if op == "mean":
-                red = np.asarray(red / self._size, orig)
+                # match the compiled path's promotion: integer means are
+                # float (jnp.mean semantics), float dtypes are preserved
+                res = orig if np.issubdtype(orig, np.floating) \
+                    else np.float32
+                red = np.asarray(red / self._size, res)
             return self._replicate(red)  # host → device
 
         return jax.tree_util.tree_map(one, x)
@@ -380,11 +386,23 @@ class XlaCommunicator(CommunicatorBase):
             )
         # stacked [size, size, ...]: out[s, r] = in[r, s]
         if self._host_staged:
-            # host-staged transpose (single-controller stacked form)
-            return jax.tree_util.tree_map(
-                lambda l: self._replicate(np.swapaxes(np.asarray(l), 0, 1)),
-                x,
-            )
+            if self.inter_size > 1:
+                raise NotImplementedError(
+                    "host-staged alltoall is single-controller only (the "
+                    "stacked [size, size, ...] form); multi-process "
+                    "exchanges go through send_obj/recv_obj or the "
+                    "compiled in-graph alltoall")
+
+            def _a2a(l):
+                l = np.asarray(l)
+                if l.ndim < 2 or l.shape[0] != self._size:
+                    raise ValueError(
+                        f"host-staged alltoall expects a stacked "
+                        f"[{self._size}, {self._size}, ...] array, got "
+                        f"{l.shape}")
+                return self._replicate(np.swapaxes(l, 0, 1))
+
+            return jax.tree_util.tree_map(_a2a, x)
         return self._driver(("alltoall",), x, stacked_in=True)
 
     def gather(self, x, root: int = 0):

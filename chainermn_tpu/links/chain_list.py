@@ -194,7 +194,11 @@ class MultiNodeChainList:
         def stage_fn(module):
             if hasattr(module, "apply"):
                 return lambda p, h: module.apply(p, h)
-            return lambda p, h: module(p if p else None, h)
+            # map the {} no-params sentinel back to the None the callable
+            # was built with (leaf-count check: truthiness of an array /
+            # tracer params pytree would raise)
+            return lambda p, h: module(
+                p if jax.tree_util.tree_leaves(p) else None, h)
 
         stage_defs = [
             (stage_fn(st.module), p if p is not None else {})

@@ -55,10 +55,15 @@ def main():
     if comm.is_master:
         print(f"devices: {comm.size}")
 
-    # variable-length Python objects — the object-plane data path
-    train = synthetic_translation(args.n_train, src_vocab=args.vocab,
-                                  tgt_vocab=args.vocab, seed=0)
-    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
+    # variable-length Python objects — the object-plane data path. Only
+    # the root builds the dataset; the actual pickled samples ship in
+    # chunks over the plane (reference scatter_dataset semantics), so
+    # workers need no access to the root's storage.
+    train = (synthetic_translation(args.n_train, src_vocab=args.vocab,
+                                   tgt_vocab=args.vocab, seed=0)
+             if comm.inter_rank == 0 else None)
+    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0,
+                                          shared_storage=False)
 
     model = Seq2Seq(n_layers=args.layer, n_units=args.unit,
                     src_vocab=args.vocab, tgt_vocab=args.vocab)

@@ -414,3 +414,17 @@ def test_non_cuda_aware_host_staged_allreduce():
     np.testing.assert_allclose(
         np.asarray(comm.alltoall(a2a)), np.swapaxes(a2a, 0, 1))
     assert not comm._jit_cache
+    with pytest.raises(ValueError):
+        comm.alltoall(np.zeros((n + 1, n, 2), np.float32))
+    # integer mean promotes to float like the compiled path
+    xi = _stacked(comm, (3,), np.int32)
+    mi = comm.allreduce(xi, "mean")
+    assert np.asarray(mi).dtype == np.float32
+    np.testing.assert_allclose(np.asarray(mi), xi.mean(0), rtol=1e-6)
+    # sub-communicators keep staging through host
+    sub = comm.split(("block", comm.size // 2))
+    assert sub._host_staged
+    xs = np.arange(sub.size * 2, dtype=np.float32).reshape(sub.size, 2)
+    np.testing.assert_allclose(
+        np.asarray(sub.allreduce(xs, "sum")), xs.sum(0))
+    assert not sub._jit_cache
