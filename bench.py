@@ -26,6 +26,7 @@ Modes:
                 ``tools/bench_loader.py``, numbers in BASELINE.md.
 """
 
+import functools
 import json
 import os
 import sys
@@ -35,14 +36,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+import optax
+
+import chainermn_tpu
+
 
 SCAN_K = 8  # optimizer steps compiled per dispatch (both modes MUST share
 #             one step program — the default-vs-realistic comparison is
 #             meaningless otherwise)
 
 
-def _init_state_and_step(jax, optax, chainermn_tpu, comm, model, image,
-                         mutable):
+def _init_state_and_step(comm, model, image, mutable):
     """Model/optimizer state + the ONE train-step program both modes run.
 
     K=SCAN_K steps per dispatch (lax.scan inside the compiled program):
@@ -70,14 +76,27 @@ def _init_state_and_step(jax, optax, chainermn_tpu, comm, model, image,
     return state, step
 
 
-def _bench_default(jax, jnp, optax, chainermn_tpu, comm, model, image,
-                   per_device_batch, name, mutable):
+def _timed_images_per_sec(one_iter, state, global_batch, n_iters=4):
+    """Warmup-3 + scalar-pull timing shared by both modes (see the
+    warmup/sync rationale in _bench_default)."""
+    for _ in range(3):
+        state, m = one_iter(state)
+        float(m["main/loss"][-1])
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, m = one_iter(state)
+    final_loss = float(m["main/loss"][-1])
+    dt = time.perf_counter() - t0
+    assert final_loss == final_loss, "loss is NaN"
+    return n_iters * SCAN_K * global_batch / dt
+
+
+def _bench_default(comm, model, image, per_device_batch, name, mutable):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n_dev = comm.size
     global_batch = per_device_batch * n_dev
-    state, step = _init_state_and_step(jax, optax, chainermn_tpu, comm,
-                                       model, image, mutable)
+    state, step = _init_state_and_step(comm, model, image, mutable)
     scan_k = SCAN_K
 
     shape = (scan_k, global_batch) + image.shape[1:]
@@ -87,7 +106,7 @@ def _bench_default(jax, jnp, optax, chainermn_tpu, comm, model, image,
     in_dtype = jnp.bfloat16 if name == "resnet50" else jnp.float32
     n_classes = 1000 if name == "resnet50" else 10
 
-    @__import__("functools").partial(jax.jit, out_shardings=(dsh, dsh))
+    @functools.partial(jax.jit, out_shardings=(dsh, dsh))
     def synth(key):
         kx, ky = jax.random.split(key)
         xs = jax.random.uniform(kx, shape, in_dtype)
@@ -96,33 +115,22 @@ def _bench_default(jax, jnp, optax, chainermn_tpu, comm, model, image,
 
     xs, ys = synth(jax.random.PRNGKey(1))
 
-    # warmup (compile) + steady state. Sync by pulling a scalar to host:
-    # block_until_ready has been observed returning early on experimental
-    # platform plugins, which inflates throughput by ~1000x. THREE warmup
-    # dispatches, not one: the tunneled chip defers a multi-second one-time
-    # cost to the second execution (measured: 6s on the first timed batch,
-    # then steady ~120ms), which a single warmup would fold into the average.
-    for _ in range(3):
-        state, m = step(state, xs, ys)
-        float(m["main/loss"][-1])
-    n_iters = 4
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        state, m = step(state, xs, ys)
-    final_loss = float(m["main/loss"][-1])
-    dt = time.perf_counter() - t0
-    assert final_loss == final_loss, "loss is NaN"
-    return n_iters * scan_k * global_batch / dt
+    # warmup (compile) + steady state, via _timed_images_per_sec. Sync by
+    # pulling a scalar to host: block_until_ready has been observed
+    # returning early on experimental platform plugins, which inflates
+    # throughput by ~1000x. THREE warmup dispatches, not one: the tunneled
+    # chip defers a multi-second one-time cost to the second execution
+    # (measured: 6s on the first timed batch, then steady ~120ms), which a
+    # single warmup would fold into the average.
+    return _timed_images_per_sec(
+        lambda st: step(st, xs, ys), state, global_batch)
 
 
-def _bench_realistic(jax, jnp, optax, chainermn_tpu, comm, model, image,
-                     per_device_batch, name, mutable):
+def _bench_realistic(comm, model, image, per_device_batch, name, mutable):
     """Input-pipeline-paying variant: device-resident uint8 dataset,
     host-shuffled indices, an on-device gather+decode program, then the
     EXACT train-step program the default mode benchmarks (two dispatches
     + one ~8 KB index transfer per K-step iteration)."""
-    import functools
-
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = comm.mesh
@@ -144,8 +152,7 @@ def _bench_realistic(jax, jnp, optax, chainermn_tpu, comm, model, image,
                 jax.random.randint(ky, (n_data,), 0, n_classes, jnp.int32))
 
     data_x, data_y = synth_data(jax.random.PRNGKey(2))
-    state, step = _init_state_and_step(jax, optax, chainermn_tpu, comm,
-                                       model, image, mutable)
+    state, step = _init_state_and_step(comm, model, image, mutable)
 
     dsh = NamedSharding(mesh, P(None, ax))
 
@@ -168,26 +175,10 @@ def _bench_realistic(jax, jnp, optax, chainermn_tpu, comm, model, image,
         xs, ys = assemble(data_x, data_y, next_idxs())
         return step(state, xs, ys)
 
-    for _ in range(3):
-        state, m = one_iter(state)
-        float(m["main/loss"][-1])
-    n_iters = 4
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
-        state, m = one_iter(state)
-    final_loss = float(m["main/loss"][-1])
-    dt = time.perf_counter() - t0
-    assert final_loss == final_loss, "loss is NaN"
-    return n_iters * scan_k * global_batch / dt
+    return _timed_images_per_sec(one_iter, state, global_batch)
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    import chainermn_tpu
-
     realistic = "--realistic" in sys.argv
 
     comm = chainermn_tpu.create_communicator("xla")
@@ -215,8 +206,8 @@ def main():
         mutable = None
 
     bench = _bench_realistic if realistic else _bench_default
-    images_per_sec = bench(jax, jnp, optax, chainermn_tpu, comm, model,
-                           image, per_device_batch, name, mutable)
+    images_per_sec = bench(comm, model, image, per_device_batch, name,
+                           mutable)
     per_chip = images_per_sec / n_dev
     suffix = "_realistic" if realistic else ""
     print(json.dumps({

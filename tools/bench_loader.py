@@ -24,10 +24,11 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+from chainermn_tpu.utils import ensure_platform
+
+ensure_platform()  # re-assert JAX_PLATFORMS=cpu over any site hook
+
 import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 import jax.numpy as jnp
 import numpy as np
 import optax
