@@ -1,15 +1,22 @@
 #!/usr/bin/env python
-"""Pipeline-parallel Transformer LM with the interleaved 1F1B schedule.
+"""Pipeline-parallel Transformer LM — two schedules on a real model.
 
-Composition contract (parallel/pipeline.py): embedding runs outside the
-pipeline (its gradient returns through ``input_grads``), TransformerBlocks
-are the homogeneous stages — logical stage v*S+d on device d (virtual
-chunks) — and the LM head trains inside ``loss_fn`` via ``head_params``.
-One optax update covers all three parameter groups.
+Default: the interleaved 1F1B schedule with composition hooks
+(parallel/pipeline.py): embedding runs outside the pipeline (its gradient
+returns through ``input_grads``), TransformerBlocks are the homogeneous
+stages — logical stage v*S+d on device d (virtual chunks) — and the LM
+head trains inside ``loss_fn`` via ``head_params``. One optax update
+covers all three parameter groups.
 
-Beyond the reference's surface: upstream pipeline usage is
+``--hetero``: embedding and head are ORDINARY stages
+(parallel/hetero_pipeline.py) — the int32→[mb,L,D]→[mb,L,vocab] shape
+changes ride the flat activation wire, the whole model's parameters are
+one [S, P] stack sharded over the stage axis, and a single optax.adam on
+that stack is the whole-model optimizer. No hooks anywhere.
+
+Beyond the reference's surface either way: upstream pipeline usage is
 MultiNodeChainList's sequential fill/drain (SURVEY.md §2.6); this example
-is the micro-batched, interleaved schedule on a real LM.
+is the micro-batched schedule on a real LM.
 
 Run (8 virtual devices):
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -38,6 +45,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from chainermn_tpu.models.transformer import TransformerBlock
 from chainermn_tpu.parallel import (
+    HeteroPipeline,
+    hetero_pipeline_1f1b_value_and_grad,
     pipeline_interleaved_1f1b_value_and_grad,
     stack_stage_params,
 )
@@ -65,6 +74,99 @@ class HeadOut(nn.Module):
         return nn.Dense(self.vocab, use_bias=False, name="out")(h)
 
 
+def _train_loop(train_step, params, opt_state, args, M):
+    """Shared synthetic-data generator + timed loop for both modes —
+    cyclic-vocab next-token sequences with learnable structure."""
+    data_rng = np.random.RandomState(0)
+
+    def batch():
+        start = data_rng.randint(0, args.vocab,
+                                 size=(M, args.mb_size, 1))
+        seq = (start + np.arange(args.seq_len + 1)) % args.vocab
+        return (jnp.asarray(seq[..., :-1], jnp.int32),
+                jnp.asarray(seq[..., 1:], jnp.int32))
+
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        toks, tgts = batch()
+        params, opt_state, loss = train_step(params, opt_state, toks, tgts)
+        if step == 0 or (step + 1) % 10 == 0:
+            print(f"step {step + 1:4d}  loss {float(loss):.4f}  "
+                  f"({time.perf_counter() - t0:.1f}s)")
+    print(f"final loss: {float(loss):.4f}")
+    return float(loss)
+
+
+def main_hetero(args):
+    """Embed → blocks → head, every one an ORDINARY pipeline stage.
+
+    No composition hooks: the embedding's int32→[mb,L,D] and the head's
+    [mb,L,D]→[mb,L,vocab] shape changes ride HeteroPipeline's flat wire,
+    and the whole model's parameters live as ONE [S, P] f32 stack sharded
+    over the stage axis — so a single optax.adam over that array IS the
+    whole-model optimizer, with each device updating only its stage's row.
+    """
+    from jax.sharding import NamedSharding
+
+    S = args.n_pipeline or jax.device_count()
+    n_blocks = S - 2
+    if n_blocks < 1:
+        raise SystemExit("--hetero needs S >= 3 (embed + blocks + head)")
+    M = args.microbatches or 2 * S
+    mesh = Mesh(np.array(jax.devices()[:S]), ("stage",))
+    print(f"hetero pipeline: {S} stages = embed + {n_blocks} blocks + "
+          f"head, {M} micro-batches of {args.mb_size}x{args.seq_len}")
+
+    block = TransformerBlock(
+        d_model=args.d_model, n_heads=args.n_heads, d_ff=args.d_ff,
+        attention=args.attention)
+    embed = EmbedIn(args.vocab, args.d_model, args.seq_len)
+    head = HeadOut(args.vocab)
+
+    rng = jax.random.PRNGKey(0)
+    toks0 = np.zeros((args.mb_size, args.seq_len), np.int32)
+    h0 = np.zeros((args.mb_size, args.seq_len, args.d_model), np.float32)
+    stage_defs = [(lambda p, t: embed.apply({"params": p}, t),
+                   embed.init(rng, toks0)["params"])]
+    stage_defs += [
+        (lambda p, h: block.apply({"params": p}, h),
+         block.init(jax.random.fold_in(rng, k), h0)["params"])
+        for k in range(n_blocks)
+    ]
+    stage_defs += [(lambda p, h: head.apply({"params": p}, h),
+                    head.init(jax.random.fold_in(rng, 999), h0)["params"])]
+
+    pipe = HeteroPipeline(
+        stage_defs, jax.ShapeDtypeStruct((args.mb_size, args.seq_len),
+                                         jnp.int32), axis_name="stage")
+    packed = jax.device_put(pipe.pack_params(),
+                            NamedSharding(mesh, P("stage")))
+    opt = optax.adam(args.lr)
+    opt_state = jax.jit(opt.init)(packed)
+
+    def loss_fn(logits, tgt):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    def run(stacked, xw, tgts):
+        my = jax.tree_util.tree_map(lambda l: l[0], stacked)
+        loss, g = hetero_pipeline_1f1b_value_and_grad(
+            pipe, loss_fn, my, xw, tgts)
+        return loss, g[None]
+
+    run_sm = shard_map(run, mesh=mesh, in_specs=(P("stage"), P(), P()),
+                       out_specs=(P(), P("stage")))
+
+    @jax.jit
+    def train_step(packed, opt_state, toks, tgts):
+        xw = pipe.encode_inputs(toks)
+        loss, grads = run_sm(packed, xw, tgts)
+        updates, opt_state = opt.update(grads, opt_state, packed)
+        return optax.apply_updates(packed, updates), opt_state, loss
+
+    return _train_loop(train_step, packed, opt_state, args, M)
+
+
 def main():
     p = argparse.ArgumentParser(
         description="ChainerMN-TPU example: pipeline-parallel LM")
@@ -83,7 +185,15 @@ def main():
                    choices=["flash", "reference"])
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--hetero", action="store_true",
+                   help="run embedding and head as ORDINARY pipeline "
+                        "stages (HeteroPipeline: flat activation/param "
+                        "wires + switch dispatch, classic 1F1B) instead "
+                        "of the head_params/input_grads composition hooks")
     args = p.parse_args()
+
+    if args.hetero:
+        return main_hetero(args)
 
     S = args.n_pipeline or jax.device_count()
     V = args.stages_per_device
@@ -146,26 +256,7 @@ def main():
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    # synthetic next-token data with learnable structure: each sequence
-    # cycles through the vocab from a random start
-    data_rng = np.random.RandomState(0)
-
-    def batch():
-        start = data_rng.randint(0, args.vocab,
-                                 size=(M, args.mb_size, 1))
-        seq = (start + np.arange(args.seq_len + 1)) % args.vocab
-        return (jnp.asarray(seq[..., :-1], jnp.int32),
-                jnp.asarray(seq[..., 1:], jnp.int32))
-
-    t0 = time.perf_counter()
-    for step in range(args.steps):
-        toks, tgts = batch()
-        params, opt_state, loss = train_step(params, opt_state, toks, tgts)
-        if step == 0 or (step + 1) % 10 == 0:
-            print(f"step {step + 1:4d}  loss {float(loss):.4f}  "
-                  f"({time.perf_counter() - t0:.1f}s)")
-    print(f"final loss: {float(loss):.4f}")
-    return float(loss)
+    return _train_loop(train_step, params, opt_state, args, M)
 
 
 if __name__ == "__main__":
