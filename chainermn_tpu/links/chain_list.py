@@ -177,7 +177,12 @@ class MultiNodeChainList:
             of the chain's input — note this is a MICRO-batch: the 1F1B
             caller splits its global batch into ``[M, mb, ...]``.
           pipe_kwargs: forwarded to :class:`HeteroPipeline`
-            (``wire_dtype``, ``int_bound``).
+            (``wire_dtype``, ``int_bound``, ``head_in_loss``). By
+            default (``head_in_loss=True``) the final stage and the
+            caller's ``loss_fn`` run cond-guarded on the last device —
+            so ``loss_fn`` must not contain collectives; pass
+            ``head_in_loss=False`` (the full-width wire format) if it
+            does.
 
         Returns the :class:`~chainermn_tpu.parallel.HeteroPipeline`:
         ``pack_params()`` gives the ``[S, P]`` stack to shard over the

@@ -18,13 +18,30 @@ here they ride the micro-batched 1F1B schedule):
   slices, casts and reshapes — all free (layout-only) in XLA. Integer
   inputs (token ids) round-trip exactly through the float wire for values
   < 2^24.
+* **Final stage in the loss** (``head_in_loss``, default for S ≥ 2): the
+  last stage's OUTPUT never travels the ring — it dies in the local loss
+  on its own device. So the wire is sized by the widest edge that
+  actually travels (``avals[0..S-1]``: the inputs of each stage), the
+  last stage's ``lax.switch`` branch is the identity, and its real
+  compute runs inside the kernels' ``head_params`` loss hook —
+  cond-guarded to the owning device, differentiating THIS shard's packed
+  parameter slot. For an LM (head output = [mb, L, vocab]) this shrinks
+  every ``ppermute`` buffer and every 1F1B activation stash from
+  vocab-width to d_model-width (~vocab/d_model ×, e.g. 42× at
+  vocab=32k, d=768).
 * **Parameter wire**: each stage's param pytree is flattened into a flat
   f32 vector padded to the widest stage, stacked ``[S, P]`` and sharded
   over the stage axis — each device materializes ONLY its own stage's
-  (padded) parameters, preserving the pipeline's memory scaling. The
-  pad-to-max cost means trunk devices pay the embed/head stage's padded
-  size; grouping by structure would remove that and is left as a
-  scheduling-neutral optimization.
+  (padded) parameters, preserving the pipeline's memory scaling.
+  Pad-to-max is OPTIMAL under shard_map's homogeneous-shard rule: every
+  scheme in which each device materializes exactly one stage must give
+  all devices same-shaped shards, so per-device memory is bounded below
+  by max_s P_s, which pad-to-max attains. (Size-class grouping — one
+  stack per class — makes every device hold a row of EVERY class:
+  Σ_classes P_class ≥ max_s P_s. Strictly worse.) The real escape for
+  outlier stages (embed/head tables) is sharding them over a second
+  mesh axis — see ``parallel/tensor_parallel.py`` and the TP×PP
+  composition in ``examples/pipeline_lm``.
 * **Stage dispatch**: one ``lax.switch`` on ``lax.axis_index(axis_name)``
   picks this device's stage function; every branch has the uniform
   signature ``([P] f32, [W] wire) -> [W] wire``, so the kernels see a
@@ -46,6 +63,7 @@ from chainermn_tpu.parallel.pipeline import (
     pipeline_1f1b_value_and_grad,
     pipeline_apply,
 )
+from chainermn_tpu.utils import match_vma
 
 
 def _aval(x):
@@ -66,22 +84,32 @@ class HeteroPipeline:
       axis_name: the stage mesh axis (the shard_map axis the kernels run
         over). ``len(stage_defs)`` must equal the axis size at run time.
       wire_dtype: activation wire dtype; default = the widest dtype among
-        the edges (``jnp.result_type`` over all stage inputs/outputs).
+        the edges that travel the ring (``jnp.result_type`` over them).
       int_bound: exclusive upper bound the caller guarantees for values on
         integer edges (token ids, …); the wire must represent every value
         below it exactly or construction fails. Default 2^24 — the f32
         mantissa bound, enough for any real vocabulary.
+      head_in_loss: run the final stage inside the kernels' loss hook so
+        its output edge never needs a wire slot (see module docstring).
+        Default: True whenever S ≥ 2. With False (or S = 1) every edge
+        including the final output rides the wire — the round-1 format.
     """
 
     def __init__(self, stage_defs: Sequence[Tuple[Callable, Any]],
                  sample_mb, axis_name: str, wire_dtype=None,
-                 int_bound: int = 2 ** 24):
+                 int_bound: int = 2 ** 24, head_in_loss: bool = None):
         self.axis_name = axis_name
         self.fns = [f for f, _ in stage_defs]
         self.params = [p for _, p in stage_defs]
         self.S = len(stage_defs)
         if self.S < 1:
             raise ValueError("need at least one stage")
+        if head_in_loss is None:
+            head_in_loss = self.S >= 2
+        if head_in_loss and self.S < 2:
+            raise ValueError("head_in_loss needs S >= 2 (the ring must "
+                             "have at least one non-head stage)")
+        self.head_in_loss = head_in_loss
 
         # ---- activation avals along the chain -------------------------
         avals = [_aval(sample_mb) if not isinstance(
@@ -96,12 +124,16 @@ class HeteroPipeline:
         self.in_avals = avals[:-1]   # stage s consumes in_avals[s]
         self.out_avals = avals[1:]   # stage s produces out_avals[s]
 
-        sizes = [int(np.prod(a.shape, initial=1)) for a in avals]
+        # edges that ride the ppermute ring: with head_in_loss the final
+        # output (avals[-1], e.g. logits) is consumed locally on the last
+        # device and never encoded
+        ring_avals = avals[:-1] if head_in_loss else avals
+        sizes = [int(np.prod(a.shape, initial=1)) for a in ring_avals]
         self.wire_elems = max(sizes)
         if wire_dtype is None:
-            wire_dtype = jnp.result_type(*[a.dtype for a in avals])
+            wire_dtype = jnp.result_type(*[a.dtype for a in ring_avals])
         self.wire_dtype = jnp.dtype(wire_dtype)
-        for a in avals:
+        for a in ring_avals:
             if (jnp.issubdtype(a.dtype, jnp.integer)
                     and jnp.issubdtype(self.wire_dtype, jnp.floating)):
                 # int edge riding a float wire: exact only below the
@@ -183,32 +215,61 @@ class HeteroPipeline:
 
     def stage_fn(self, flat_params, wire_h):
         """The homogeneous ``(params, h) -> h`` the kernels schedule:
-        switch on this device's stage index."""
+        switch on this device's stage index. With ``head_in_loss`` the
+        final stage's branch is the identity — its input wire flows
+        unchanged to the loss hook (forward) and its cotangent flows
+        unchanged back onto the ring (backward)."""
         n_ax = lax.axis_size(self.axis_name)  # static at trace time
         if n_ax != self.S:
             raise ValueError(
                 f"HeteroPipeline has {self.S} stages but axis "
                 f"{self.axis_name!r} spans {n_ax} devices — lax.switch "
                 "would silently clamp extra devices onto the last stage")
+        n_ring = self.S - 1 if self.head_in_loss else self.S
         branches = []
-        for s in range(self.S):
+        for s in range(n_ring):
             def branch(flat, wire, s=s):
                 x = self.decode_act(wire, self.in_avals[s])
                 y = self.fns[s](self._unflatten(s, flat), x)
                 return self.encode_act(y)
 
             branches.append(branch)
+        if self.head_in_loss:
+            # identity on the wire; match the compute branches' varying
+            # axes (they inherit flat's vma, e.g. under the kernels'
+            # eval_shape probe where the wire aval alone is invariant)
+            branches.append(lambda flat, wire: match_vma(wire, flat))
         my = lax.axis_index(self.axis_name)
         return lax.switch(my, branches, flat_params, wire_h)
 
     def wire_loss_fn(self, loss_fn):
-        """Wrap ``loss_fn(decoded_last_output, tgt)`` for the wire."""
+        """Wrap ``loss_fn(decoded_final_output, tgt)`` for the kernels.
+
+        head_in_loss: returns ``(head_flat, wire, tgt) -> scalar`` for the
+        kernels' ``head_params`` hook — decode the wire as the final
+        stage's INPUT, apply the final stage from its flat param slot,
+        then the user loss. Otherwise: ``(wire, tgt) -> scalar`` decoding
+        the final output directly.
+        """
+        if self.head_in_loss:
+            def f(head_flat, wire_out, tgt):
+                return loss_fn(self.head_apply(head_flat, wire_out), tgt)
+
+            return f
+
         last = self.out_avals[-1]
 
         def f(wire_out, tgt):
             return loss_fn(self.decode_act(wire_out, last), tgt)
 
         return f
+
+    def head_apply(self, flat_params, wire):
+        """Final stage's forward from its flat param slot, on a decoded
+        head-input wire — the driver-side complement of head_in_loss."""
+        s = self.S - 1
+        x = self.decode_act(wire, self.in_avals[s])
+        return self.fns[s](self._unflatten(s, flat_params), x)
 
 
 def hetero_pipeline_1f1b_value_and_grad(
@@ -222,7 +283,9 @@ def hetero_pipeline_1f1b_value_and_grad(
 
     Args:
       pipe: the :class:`HeteroPipeline` (built once, outside).
-      loss_fn: ``(last_stage_output, target) -> scalar`` on DECODED outputs.
+      loss_fn: ``(final_stage_output, target) -> scalar`` on DECODED
+        outputs. Must not contain collectives (with ``head_in_loss`` it
+        runs cond-guarded on the final stage's device).
       packed_params: THIS shard's ``[P]`` flat stage parameters (shard
         ``pipe.pack_params()`` with ``P(axis_name)`` and strip the leading
         axis in-shard, exactly like ``stack_stage_params``).
@@ -232,16 +295,54 @@ def hetero_pipeline_1f1b_value_and_grad(
 
     Returns ``(loss, flat_grads [P])`` — decode grads with
     ``pipe.unpack_grads`` after stacking shards back (out_specs P(axis)).
+    With ``head_in_loss`` the final stage's gradient (computed through the
+    loss hook) is folded into its device's ``flat_grads`` slot here, so
+    the result is identical in shape and meaning either way.
     """
-    return pipeline_1f1b_value_and_grad(
+    if not pipe.head_in_loss:
+        return pipeline_1f1b_value_and_grad(
+            pipe.stage_fn, pipe.wire_loss_fn(loss_fn), packed_params,
+            x_microbatches_wire, y_microbatches, pipe.axis_name)
+
+    # the final stage differentiates THIS shard's param slot through the
+    # loss hook: only its owner runs the real branch (cond in
+    # _head_loss_grads), every other device contributes exact zeros, and
+    # the psum'd aux["head_grads"] is masked back onto the owner's slot
+    loss, grads, aux = pipeline_1f1b_value_and_grad(
         pipe.stage_fn, pipe.wire_loss_fn(loss_fn), packed_params,
-        x_microbatches_wire, y_microbatches, pipe.axis_name)
+        x_microbatches_wire, y_microbatches, pipe.axis_name,
+        head_params=packed_params)
+    my = lax.axis_index(pipe.axis_name)
+    n = lax.axis_size(pipe.axis_name)
+    grads = grads + jnp.where(my == n - 1, aux["head_grads"],
+                              jnp.zeros_like(aux["head_grads"]))
+    return loss, grads
 
 
 def hetero_pipeline_apply(pipe: HeteroPipeline, packed_params,
                           x_microbatches_wire):
     """GPipe-style forward over heterogeneous stages — call INSIDE
-    shard_map. Returns [M, W] wire outputs; decode with
-    ``pipe.decode_act(out[j], pipe.out_avals[-1])``."""
-    return pipeline_apply(pipe.stage_fn, packed_params,
+    shard_map. Returns DECODED final outputs ``[M, *out_avals[-1].shape]``
+    (valid on every shard). With ``head_in_loss`` the ring delivers the
+    final stage's inputs; its forward then runs cond-guarded on its owner
+    device and the result is psum-broadcast."""
+    outs = pipeline_apply(pipe.stage_fn, packed_params,
                           x_microbatches_wire, pipe.axis_name)
+    final = pipe.out_avals[-1]
+    if not pipe.head_in_loss:
+        return jax.vmap(lambda w: pipe.decode_act(w, final))(outs)
+
+    my = lax.axis_index(pipe.axis_name)
+    n = lax.axis_size(pipe.axis_name)
+
+    def _run(_):
+        return jax.vmap(
+            lambda w: pipe.head_apply(packed_params, w)
+        )(outs).astype(final.dtype)
+
+    def _skip(_):
+        return match_vma(
+            jnp.zeros((outs.shape[0],) + final.shape, final.dtype), my)
+
+    ys = lax.cond(my == n - 1, _run, _skip, None)
+    return lax.psum(ys, pipe.axis_name)

@@ -9,10 +9,12 @@ head trains inside ``loss_fn`` via ``head_params``. One optax update
 covers all three parameter groups.
 
 ``--hetero``: embedding and head are ORDINARY stages
-(parallel/hetero_pipeline.py) — the int32→[mb,L,D]→[mb,L,vocab] shape
-changes ride the flat activation wire, the whole model's parameters are
-one [S, P] stack sharded over the stage axis, and a single optax.adam on
-that stack is the whole-model optimizer. No hooks anywhere.
+(parallel/hetero_pipeline.py) — the int32→[mb,L,D] shape changes ride the
+flat activation wire sized by the widest TRAVELING edge (the [mb,L,vocab]
+logits die in the local loss and never touch the ring), the whole model's
+parameters are one [S, P] stack sharded over the stage axis, and a single
+optax.adam on that stack is the whole-model optimizer. No hooks in user
+code — the head-in-loss routing is internal to HeteroPipeline.
 
 Beyond the reference's surface either way: upstream pipeline usage is
 MultiNodeChainList's sequential fill/drain (SURVEY.md §2.6); this example
@@ -100,10 +102,11 @@ def _train_loop(train_step, params, opt_state, args, M):
 def main_hetero(args):
     """Embed → blocks → head, every one an ORDINARY pipeline stage.
 
-    No composition hooks: the embedding's int32→[mb,L,D] and the head's
-    [mb,L,D]→[mb,L,vocab] shape changes ride HeteroPipeline's flat wire,
-    and the whole model's parameters live as ONE [S, P] f32 stack sharded
-    over the stage axis — so a single optax.adam over that array IS the
+    No composition hooks in user code: the embedding's int32→[mb,L,D]
+    shape change rides HeteroPipeline's flat wire — sized mb·L·d_model,
+    because the head's [mb,L,vocab] logits never travel the ring — and
+    the whole model's parameters live as ONE [S, P] f32 stack sharded
+    over the stage axis, so a single optax.adam over that array IS the
     whole-model optimizer, with each device updating only its stage's row.
     """
     from jax.sharding import NamedSharding
@@ -139,6 +142,8 @@ def main_hetero(args):
     pipe = HeteroPipeline(
         stage_defs, jax.ShapeDtypeStruct((args.mb_size, args.seq_len),
                                          jnp.int32), axis_name="stage")
+    # the wire is d_model-wide, not vocab-wide: logits never travel
+    assert pipe.wire_elems == args.mb_size * args.seq_len * args.d_model
     packed = jax.device_put(pipe.pack_params(),
                             NamedSharding(mesh, P("stage")))
     opt = optax.adam(args.lr)
