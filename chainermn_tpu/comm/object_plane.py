@@ -329,9 +329,15 @@ def _is_deadline_error(e: Exception) -> bool:
                 return name.upper() == "DEADLINE_EXCEEDED"
         except Exception:
             pass
+    # No structured status: accept only the canonical status token and
+    # jaxlib's exact key-wait phrasing. Looser matching ("timeout",
+    # "timed out" anywhere) classified CONNECTION-timeout transport
+    # failures as key-wait deadlines, retrying against a dead coordinator
+    # instead of failing fast (bounded by _coordinator_alive, but it
+    # delayed abort by whole probe windows).
     msg = str(e).lower()
-    return ("deadline_exceeded" in msg or "deadline" in msg
-            or "timed out" in msg or "timeout" in msg)
+    return ("deadline_exceeded" in msg
+            or "timed out waiting for key" in msg)
 
 
 def _sliced_get(key: str, timeout_ms: int, raw: bool = False):

@@ -200,23 +200,29 @@ class XlaCommunicator(CommunicatorBase):
         process's rank) — the single-controller escape hatch for driving
         several groups from one script.
 
-        ``key`` (MPI rank-ordering within each group) is honored only in its
-        order-preserving form — ``None`` or monotonically increasing (the
-        ubiquitous ``key=rank`` idiom). Reordering keys would permute shard
-        identities inside a compiled mesh axis, which has no XLA analog.
+        ``key`` (MPI rank-ordering within each group) is fully honored:
+        each group's devices enter its (sub-)mesh sorted by
+        ``(key[rank], rank)`` — exactly ``MPI_Comm_split``'s tie-broken
+        ordering — so a reordering key permutes shard identities by
+        permuting the mesh's device array (device order IS rank order on
+        a mesh; upstream ``CommunicatorBase.split`` → ``MPI_Comm_split``,
+        any key). A scalar key carries no ordering information and is
+        ignored, like MPI's all-equal-keys case.
         """
+        n = self._size
+        keys = None
         if key is not None:
             try:
                 keys = list(key)
             except TypeError:
-                keys = None  # scalar key: no ordering information to violate
-            if keys is not None and keys != sorted(keys):
-                raise NotImplementedError(
-                    "split(key=...) that reorders ranks within a group is "
-                    "not supported on a mesh; use the default rank order "
-                    "(key=None or key=rank)"
-                )
-        n = self._size
+                keys = None  # scalar key: no ordering information
+            if keys is not None and len(keys) != n:
+                raise ValueError(f"need {n} keys, got {len(keys)}")
+
+        def order(members):
+            if keys is None:
+                return list(members)
+            return sorted(members, key=lambda i: (keys[i], i))
         kind = None
         if isinstance(color, tuple) and color[0] in ("block", "stride"):
             kind, k = color
@@ -243,7 +249,8 @@ class XlaCommunicator(CommunicatorBase):
                 r = self.rank if rank is None else rank
                 if not 0 <= r < n:
                     raise ValueError(f"rank {r} out of range [0, {n})")
-                members = [i for i in range(n) if colors[i] == colors[r]]
+                members = order(
+                    [i for i in range(n) if colors[i] == colors[r]])
                 sub = self._comm_devices()[members]
                 mesh = Mesh(sub, (f"{self._axes[0]}_split",))
                 return XlaCommunicator(
@@ -258,12 +265,17 @@ class XlaCommunicator(CommunicatorBase):
         flat = self._comm_devices()
         inter, intra = f"{self._axes[0]}_inter", f"{self._axes[0]}_intra"
         if kind == "block":
-            # group g = ranks [g*k, (g+1)*k): row-major factorization
-            mesh = Mesh(flat.reshape(n // k, k), (inter, intra))
+            # group g = ranks [g*k, (g+1)*k): row-major factorization;
+            # each row walks its group in (key, rank) order
+            rows = [order(range(g * k, (g + 1) * k)) for g in range(n // k)]
+            mesh = Mesh(flat[np.asarray(rows)], (inter, intra))
         else:
             # group c = ranks {c, c+G, c+2G, ...} with G = n//k groups:
-            # element [m, c] of the (k, G) grid is rank m*G + c
-            mesh = Mesh(flat.reshape(k, n // k), (intra, inter))
+            # element [m, c] of the (k, G) grid is group c's m-th member
+            # in (key, rank) order (rank m*G + c when key is None)
+            G = n // k
+            cols = [order(range(c, n, G)) for c in range(G)]
+            mesh = Mesh(flat[np.asarray(cols).T], (intra, inter))
         owned = (intra,)
         return XlaCommunicator(
             mesh=mesh,
@@ -273,6 +285,20 @@ class XlaCommunicator(CommunicatorBase):
             host_staged=self._host_staged,
             _object_plane=self._obj,
         )
+
+    def _require_all_processes(self, what: str) -> None:
+        """Object-plane transports barrier over ALL processes and assume
+        every process contributes — a split() sub-communicator spanning a
+        subset of processes would hang on the absent peers (and their
+        sequence numbers would desynchronize), so refuse up front."""
+        procs = {int(d.process_index) for d in self._comm_devices()}
+        if procs != set(range(jax.process_count())):
+            raise NotImplementedError(
+                f"{what} on a sub-communicator whose devices span only a "
+                "subset of processes are not supported (the object-plane "
+                f"transport barriers over all {jax.process_count()} "
+                "processes); use the compiled in-graph collectives on the "
+                "sub-mesh instead")
 
     def _comm_devices(self) -> np.ndarray:
         """Devices of this communicator's axes, flattened in rank order."""
@@ -311,6 +337,8 @@ class XlaCommunicator(CommunicatorBase):
         np_ops = {"sum": np.sum, "max": np.max, "min": np.min}
         if op not in np_ops and op != "mean":
             raise ValueError(f"unsupported allreduce op: {op!r}")
+        if self.inter_size > 1:
+            self._require_all_processes("host-staged collectives")
         if self.inter_size > 1 and self._size % self.inter_size:
             raise ValueError(
                 f"host-staged allreduce needs equal per-process rank "
@@ -406,11 +434,57 @@ class XlaCommunicator(CommunicatorBase):
         return self._driver(("alltoall",), x, stacked_in=True)
 
     def gather(self, x, root: int = 0):
+        """Reference ``gather`` (mpi_communicator_base.py): root receives
+        the rank-ordered stack, other ranks receive None.
+
+        In-graph the compiled analog is ``all_gather`` (an SPMD program
+        cannot return None on some shards). Driver level: single-process,
+        the stacked-input contract applies and the single controller IS
+        the root — the validated stack comes back replicated; multi-
+        process, each process contributes its LOCAL ranks' stack and only
+        the process owning ``root`` gets the full rank-ordered stack
+        (object-plane transport), everyone else None.
+        """
         if _is_tracer(x):
             return jax.tree_util.tree_map(
                 lambda l: lax.all_gather(l, self._axes), x
             )
-        return self._replicate(x)
+        if not 0 <= root < self._size:
+            raise ValueError(f"root {root} out of range [0, {self._size})")
+        if self.inter_size == 1:
+            def _chk(l):
+                l = jnp.asarray(l)
+                if l.ndim == 0 or l.shape[0] != self._size:
+                    raise ValueError(
+                        f"driver-level gather expects a stacked per-rank "
+                        f"array with leading axis {self._size}, got shape "
+                        f"{l.shape}")
+                return l
+
+            return self._replicate(jax.tree_util.tree_map(_chk, x))
+        self._require_all_processes("driver-level gather")
+        procs = [int(d.process_index) for d in self._comm_devices()]
+        parts = self._obj.gather_obj(
+            jax.tree_util.tree_map(np.asarray, x), root=procs[root])
+        if parts is None:
+            return None
+        # reassemble per-process local stacks into global rank order
+        slot = []
+        seen: dict = {}
+        for p in procs:
+            slot.append((p, seen.get(p, 0)))
+            seen[p] = seen.get(p, 0) + 1
+
+        def _one(*proc_leaves):
+            for p, l in enumerate(proc_leaves):
+                if np.ndim(l) == 0 or np.shape(l)[0] != seen.get(p, 0):
+                    raise ValueError(
+                        f"process {p} must stack its {seen.get(p, 0)} "
+                        f"LOCAL ranks on the leading axis, got "
+                        f"{np.shape(l)}")
+            return np.stack([proc_leaves[p][i] for p, i in slot])
+
+        return jax.tree_util.tree_map(_one, *parts)
 
     def scatter(self, x, root: int = 0):
         if _is_tracer(x):

@@ -94,9 +94,10 @@ def scatter_dataset(
     and receive a materialized :class:`ListDataset`. Variable-length
     Python samples (seq2seq) ship fine — the plane pickles anything.
     ``max_buf_len`` bounds the per-message chunk the root materializes and
-    ships (estimated from the first sample's pickle size, the reference's
-    256 MB default); the transport further slices each message at the
-    KV-store bound.
+    ships (samples are accumulated into a chunk until their summed pickled
+    size reaches the bound — robust to highly variable sample sizes; the
+    reference's 256 MB default); the transport further slices each message
+    at the KV-store bound.
     """
     k = comm.inter_size
     if k == 1:
@@ -125,23 +126,30 @@ def scatter_dataset(
         for r in range(k):
             if r == root:
                 continue
-            plan = plans[r]
-            if len(plan):
-                est = max(1, len(pickle.dumps(
-                    dataset[int(plan[0])], pickle.HIGHEST_PROTOCOL)))
-                per = max(1, min(len(plan), max_buf_len // est))
-            else:
-                per = 1
-            chunks = [plan[i:i + per] for i in range(0, len(plan), per)]
-            comm.send_obj(len(chunks), dest=r, tag=_SCATTER_TAG)
-            for part in chunks:
-                comm.send_obj([dataset[int(i)] for i in part], dest=r,
-                              tag=_SCATTER_TAG)
+            # ship pre-pickled samples, flushing whenever the RUNNING
+            # pickled size reaches max_buf_len — a first-sample size
+            # estimate breaks the root-memory bound on datasets with
+            # highly variable sample sizes. None terminates the stream.
+            buf, sz = [], 0
+            for i in plans[r]:
+                b = pickle.dumps(dataset[int(i)], pickle.HIGHEST_PROTOCOL)
+                buf.append(b)
+                sz += len(b)
+                if sz >= max_buf_len:
+                    comm.send_obj(buf, dest=r, tag=_SCATTER_TAG)
+                    buf, sz = [], 0
+            if buf:
+                comm.send_obj(buf, dest=r, tag=_SCATTER_TAG)
+            comm.send_obj(None, dest=r, tag=_SCATTER_TAG)
         return ListDataset(dataset[int(i)] for i in plans[root])
-    n_chunks = comm.recv_obj(src=root, tag=_SCATTER_TAG)
+    import pickle
+
     samples = []
-    for _ in range(n_chunks):
-        samples.extend(comm.recv_obj(src=root, tag=_SCATTER_TAG))
+    while True:
+        part = comm.recv_obj(src=root, tag=_SCATTER_TAG)
+        if part is None:
+            break
+        samples.extend(pickle.loads(b) for b in part)
     return ListDataset(samples)
 
 

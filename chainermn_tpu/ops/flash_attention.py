@@ -26,7 +26,7 @@ direction.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -43,12 +43,11 @@ _NEG_INF = -1e30  # finite stand-in: -inf breaks max/exp chains on the VPU
 DEFAULT_BLOCKS = (1024, 1024)
 
 
-def _dimsem():
+def _dimsem(dims=("parallel", "parallel", "arbitrary")):
     """Grid dims (batch*heads, tile, tile): the first two are independent,
     only the innermost accumulates — declaring this lets Mosaic pipeline
     the HBM block copies across grid steps instead of serializing
     copy→compute. None when the API is unavailable."""
-    dims = ("parallel", "parallel", "arbitrary")
     for cls_name in ("CompilerParams", "TPUCompilerParams"):
         cls = getattr(pltpu, cls_name, None)
         if cls is not None:
@@ -60,6 +59,11 @@ def _dimsem():
 
 
 _DIMSEM = _dimsem()
+# the fused backward accumulates dk/dv scratch ACROSS the qi grid dim
+# (init at qi==0, flush at qi==nq-1) — a 'parallel' qi would let a
+# megacore split it over TensorCores and silently return one core's
+# partial sums; only the batch*heads dim is truly independent there
+_DIMSEM_FUSED = _dimsem(("parallel", "arbitrary", "arbitrary"))
 
 
 def _window_cap(block_k: int, window) -> int:
@@ -131,6 +135,10 @@ def _tile_scores(q_ref, k_ref, qi, ki, *, scale, causal, bq, bk,
         preferred_element_type=jnp.float32,
     ) * scale                                  # [bq, bk]
     if causal:
+        # NOTE(measured 2026-07-31): specializing interior tiles to skip
+        # this masking via lax.cond on (qi, ki) regressed the LM bench
+        # 96.6k → 84.3k tok/s — Mosaic's traced branch costs more than
+        # the iota/compare/select it saves. Keep the mask unconditional.
         rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         keep = rows >= cols
@@ -388,6 +396,79 @@ def _fa_bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _fa_bwd_fused_kernel(*refs, scale, causal, bq, bk, nq, nk,
+                         has_segs=False, window=None):
+    """One-pass backward: dq, dk, dv from a SINGLE rebuild of the score
+    tile. The split dq/dkv kernels each recompute S, P and dP — 2 of the
+    7 tile dots are pure duplication (plus double HBM reads of q/k/v/do).
+    Here dk/dv accumulate across the qi sweep in whole-Lk VMEM scratch
+    (f32 [Lk, D] each — 512 KB at L=2048/D=64), flushed on the last grid
+    step; dq accumulates per qi exactly like the split kernel. Applicable
+    while the scratch fits VMEM (see _FUSED_BWD_SCRATCH_BYTES); the split
+    kernels remain the long-L path."""
+    if has_segs:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, qs_ref, ks_ref,
+         dq_ref, dk_ref, dv_ref, dq_acc, dk_all, dv_all) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dr_ref, dq_ref, dk_ref,
+         dv_ref, dq_acc, dk_all, dv_all) = refs
+        qs_ref = ks_ref = None
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(jnp.logical_and(qi == 0, ki == 0))
+    def _init_kv():
+        dk_all[:] = jnp.zeros_like(dk_all)
+        dv_all[:] = jnp.zeros_like(dv_all)
+
+    @pl.when(ki == 0)
+    def _init_q():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        s = _tile_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                         bq=bq, bk=bk, qs_ref=qs_ref, ks_ref=ks_ref,
+                         window=window)
+        p = _masked_exp(s, lse_ref[0], has_segs)       # [bq, bk]
+        # native-dtype MXU dots, f32 accumulation (see _tile_scores)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        ds = p * (dp - dr_ref[0]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        sl = pl.dslice(ki * bk, bk)
+        dv_all[sl, :] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+        dk_all[sl, :] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bk, d]
+
+    # traced-predicate gate even when non-causal — see _fa_kernel
+    pl.when(_causal_live(qi, ki, bq, bk, window) if causal
+            else ki >= 0)(_compute)
+
+    @pl.when(ki == nk - 1)
+    def _finalize_q():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+    @pl.when(jnp.logical_and(qi == nq - 1, ki == nk - 1))
+    def _finalize_kv():
+        dk_ref[0] = dk_all[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_all[:].astype(dv_ref.dtype)
+
+
+# dk+dv whole-Lk f32 scratch budget for the fused backward (VMEM is
+# ~16 MB/core; the [bq, bk] tile intermediates need the rest). Empirical
+# boundary on v5e (2026-07-31): Lk=4096 compiles at both D=64 and D=128
+# (4 MB scratch); Lk=8192/D=64 (also 4 MB) exceeds scoped VMEM by 1.5 MB
+# — so gate on BOTH the byte budget and Lk.
+_FUSED_BWD_SCRATCH_BYTES = 4 * 2 ** 20
+_FUSED_BWD_MAX_LK = 4096
+
+
 def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
                   interpret, hq=1, hkv=1, segs=None, window=None):
     """q/do: [B*Hq, Lq, D]; k/v: [B*Hkv, Lk, D]; lse/dr: [B*Hq, Lq] →
@@ -414,6 +495,28 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
     if has_segs:
         in_specs += list(_seg_specs(hq, bq, bk))
         operands += segs
+
+    if (2 * lk * d * 4 <= _FUSED_BWD_SCRATCH_BYTES
+            and lk <= _FUSED_BWD_MAX_LK):
+        dkv_full = pl.BlockSpec((1, lk, d), lambda b, qi, ki: (b, 0, 0),
+                                memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            functools.partial(_fa_bwd_fused_kernel, scale=scale,
+                              causal=causal, bq=bq, bk=bk, nq=nq, nk=nk,
+                              has_segs=has_segs, window=window),
+            grid=(bh, nq, nk),
+            in_specs=in_specs,
+            out_specs=(q_spec, dkv_full, dkv_full),
+            out_shape=(_sds(q, (bh, lq, d), q.dtype, k, v, do),
+                       _sds(k, (bh, lk, d), k.dtype, q, v, do),
+                       _sds(v, (bh, lk, d), v.dtype, q, k, do)),
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                            pltpu.VMEM((lk, d), jnp.float32),
+                            pltpu.VMEM((lk, d), jnp.float32)],
+            interpret=interpret,
+            compiler_params=_DIMSEM_FUSED,
+        )(*operands)
+
     dq = pl.pallas_call(
         functools.partial(_fa_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, has_segs=has_segs,
@@ -470,13 +573,14 @@ def _reference(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 9, 10))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCKS[0],
                     block_k: int = DEFAULT_BLOCKS[1],
                     interpret: Optional[bool] = None,
-                    segment_ids=None, window: Optional[int] = None):
+                    segment_ids=None, window: Optional[int] = None,
+                    bwd_blocks: Optional[Tuple[int, int]] = None):
     """Fused blockwise attention. q: [B, Lq, H, D]; k, v: [B, Lk, Hkv, D]
     → [B, Lq, H, D]. Hkv < H is GQA/MQA (H % Hkv == 0, repeat-interleave
     head sharing) — the shared KV is never replicated in HBM; the sharing
@@ -505,7 +609,7 @@ def flash_attention(q, k, v, causal: bool = False,
     any length works; explicit blocks are only a tuning knob.
     """
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                      segment_ids, window)[0]
+                      segment_ids, window, bwd_blocks)[0]
 
 
 def _to3(x):
@@ -560,7 +664,7 @@ def _apply_padding(q, k, v, segment_ids, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               segment_ids=None, window=None):
+               segment_ids=None, window=None, bwd_blocks=None):
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires "
                          "causal=True")
@@ -585,12 +689,17 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
     return out, (q, k, v, out, lse3, segment_ids)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
-               g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
+               bwd_blocks, res, g):
     # blockwise Pallas backward: P is rebuilt per tile from the forward's
     # logsumexp; [L, L] never touches HBM (the materializing fallback
     # allocated 8 GB f32 score tensors at b=64/L=2048/h=8)
     q, k, v, out, lse3, segment_ids = res
+    if bwd_blocks is not None:
+        # the backward kernels' VMEM/compute balance differs from the
+        # forward's (4 live [bq, bk] f32 intermediates vs 2); let callers
+        # tune them independently
+        block_q, block_k = bwd_blocks
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_k = _window_cap(block_k, window)
@@ -600,6 +709,11 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res,
     qp, kp, vp, segs_eff, pq, pk = _apply_padding(
         q, k, v, segment_ids, block_q, block_k)
     lq_p, lk_p = lq + pq, lk + pk
+    if lse3.shape[1] != lq_p:
+        raise ValueError(
+            f"bwd_blocks pad Lq to {lq_p} but the forward's lse is "
+            f"{lse3.shape[1]} long; pick bwd blocks with the same padded "
+            "length (block-size multiples of the forward's)")
     segs = _norm_segs(segs_eff, lq_p, lk_p)
     gp = _pad_rows(g, pq) if pq else g
     # D_i = Σ_d dO_i · O_i — rowwise, cheap in XLA, f32 for stability

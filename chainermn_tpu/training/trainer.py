@@ -69,9 +69,12 @@ class StandardUpdater:
                         a.shape[0] % n_local != 0):
                     raise ValueError(
                         f"per-process batch size {a.shape[0]} is not "
-                        f"divisible by the {n_local} local devices — pick "
-                        "a global batch size that is a multiple of "
-                        f"{n} (the data-axis size)"
+                        f"divisible by this process's {n_local} local "
+                        "devices — every process must feed a local row "
+                        f"count that is a multiple of {n_local} (and all "
+                        "processes must feed the same count, or "
+                        "make_array_from_process_local_data will raise a "
+                        "shape error)"
                     )
             return tuple(
                 jax.make_array_from_process_local_data(

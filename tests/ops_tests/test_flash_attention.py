@@ -55,6 +55,35 @@ def test_gradients_match():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_fused_and_split_backward_agree(causal, monkeypatch):
+    """The fused one-pass backward (short L) and the split dq/dkv kernels
+    (long L) must produce identical gradients; the split path would
+    otherwise go untested at test-sized lengths."""
+    import importlib
+
+    # the ops package re-exports the flash_attention FUNCTION over the
+    # submodule attribute; go through importlib for the module itself
+    fa_mod = importlib.import_module("chainermn_tpu.ops.flash_attention")
+
+    q, k, v = _qkv(b=2, l=256, h=2, d=32, seed=11)
+
+    def grads(q, k, v):
+        def loss(q, k, v):
+            out = flash_attention(q, k, v, causal, None, 64, 64, True)
+            return jnp.sum(out * jnp.cos(out))
+        return jax.grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+
+    assert 2 * 256 * 32 * 4 <= fa_mod._FUSED_BWD_SCRATCH_BYTES
+    g_fused = grads(q, k, v)
+    monkeypatch.setattr(fa_mod, "_FUSED_BWD_SCRATCH_BYTES", 0)
+    g_split = grads(q, k, v)
+    for a, b in zip(g_fused, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_gradients_multi_block(causal):
     """Backward kernels across several q AND kv tiles (accumulator reuse,
     causal tile skipping)."""
