@@ -180,3 +180,15 @@ def create_empty_dataset(dataset=None):
     """Stub dataset for processes that hold no data (reference:
     create_empty_dataset in chainermn/datasets/__init__.py)."""
     return _EmptyDataset()
+
+
+# real on-disk ingestion (reference examples' input paths)
+from chainermn_tpu.datasets.bpe import (  # noqa: E402
+    BPETokenizer,
+    train_bpe,
+    train_bpe_file,
+)
+from chainermn_tpu.datasets.image_folder import (  # noqa: E402
+    ImageFolderDataset,
+    write_image_folder,
+)

@@ -1,0 +1,38 @@
+#!/usr/bin/env python
+"""Write a local folder-of-JPEG dataset in the standard ImageNet layout
+(``OUT/<class>/*.jpg``) for ``train_imagenet.py --data-dir``.
+
+This environment has no network egress, so the CONTENT is generated
+(class-correlated prototypes + noise, learnable); the FILES are real
+JPEGs and the training path decodes them exactly as it would decode
+ImageNet.
+
+Usage: python make_jpeg_dataset.py OUT [--classes 8] [--per-class 32]
+       [--image-size 256]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from chainermn_tpu.datasets import write_image_folder
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("out")
+    p.add_argument("--classes", type=int, default=8)
+    p.add_argument("--per-class", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=256)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    n = write_image_folder(args.out, args.classes, args.per_class,
+                           image_size=args.image_size, seed=args.seed)
+    print(f"wrote {n} JPEG files under {args.out} "
+          f"({args.classes} classes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,11 @@ def main():
                         "encoder) instead of the conv net")
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--n-train", type=int, default=2048)
+    p.add_argument("--data-dir", default=None, metavar="DIR",
+                   help="train from a folder-of-JPEG dataset "
+                        "(DIR/<class>/*.jpg, real per-access decode) "
+                        "instead of in-memory synthetic arrays; see "
+                        "examples/imagenet/make_jpeg_dataset.py")
     p.add_argument("--loader", action="store_true",
                    help="feed batches through the native double-buffered "
                         "prefetch loader from a file-backed uint8 dataset "
@@ -127,6 +132,20 @@ def main():
         lo = jax.process_index() * shard
         train_len = shard * n_proc
         train = (xs_mm[lo:lo + shard], ys_mm[lo:lo + shard])
+    elif args.data_dir:
+        # standard folder-of-JPEG layout (root/<class>/*.jpg), decoded
+        # per access — the reference example's real-ImageNet input path
+        # (upstream examples/imagenet/train_imagenet.py reads a labeled
+        # image list the same way). Generate a local dataset with
+        # examples/imagenet/make_jpeg_dataset.py.
+        from chainermn_tpu.datasets import ImageFolderDataset
+
+        train = ImageFolderDataset(args.data_dir,
+                                   image_size=args.image_size, train=True)
+        n_classes = len(train.classes)
+        train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True,
+                                              seed=0)
+        train_len = len(train) * n_proc
     else:
         train = synthetic_imagenet(args.n_train, args.image_size)
         train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True,
@@ -134,11 +153,12 @@ def main():
         train_len = len(train) * n_proc
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    num_classes = n_classes if args.data_dir else 1000
     if args.model == "vit":
-        model = ViT(num_classes=1000, dtype=dtype)
+        model = ViT(num_classes=num_classes, dtype=dtype)
         mutable = None
     else:
-        model = ResNet50(num_classes=1000, dtype=dtype)
+        model = ResNet50(num_classes=num_classes, dtype=dtype)
         mutable = ("batch_stats",)
     variables = model.init(
         jax.random.PRNGKey(0),

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Write a local parallel text corpus (``OUT.src`` / ``OUT.tgt``, one
+sentence per line) for ``train_seq2seq.py --src-file/--tgt-file``.
+
+No network egress, so the CONTENT is generated — a pseudo-word language
+whose "translation" reverses word order and applies a deterministic word
+mapping (structure a seq2seq model can learn) — but the FILES are plain
+parallel text, read and tokenized exactly like WMT would be (the
+reference's examples/seq2seq data prep, SURVEY.md §3.4).
+
+Usage: python make_corpus.py OUT [--lines 2000] [--words 200]
+"""
+
+import argparse
+
+import numpy as np
+
+CONSONANTS = "bcdfghjklmnprstvz"
+VOWELS = "aeiou"
+
+
+def word(rng):
+    n = rng.randint(2, 5)
+    return "".join(
+        CONSONANTS[rng.randint(len(CONSONANTS))]
+        + VOWELS[rng.randint(len(VOWELS))]
+        for _ in range(n))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("out")
+    p.add_argument("--lines", type=int, default=2000)
+    p.add_argument("--words", type=int, default=200,
+                   help="source vocabulary size (pseudo-words)")
+    p.add_argument("--max-len", type=int, default=12)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    rng = np.random.RandomState(args.seed)
+    src_words = []
+    seen = set()
+    while len(src_words) < args.words:
+        w = word(rng)
+        if w not in seen:
+            seen.add(w)
+            src_words.append(w)
+    # deterministic word-level "translation": a fixed permutation
+    perm = rng.permutation(args.words)
+    tgt_of = {src_words[i]: src_words[perm[i]] for i in range(args.words)}
+
+    with open(args.out + ".src", "w") as fs, \
+            open(args.out + ".tgt", "w") as ft:
+        for _ in range(args.lines):
+            n = rng.randint(3, args.max_len + 1)
+            ws = [src_words[rng.randint(args.words)] for _ in range(n)]
+            fs.write(" ".join(ws) + "\n")
+            ft.write(" ".join(tgt_of[w] for w in reversed(ws)) + "\n")
+    print(f"wrote {args.lines} parallel lines to "
+          f"{args.out}.src / {args.out}.tgt")
+
+
+if __name__ == "__main__":
+    main()

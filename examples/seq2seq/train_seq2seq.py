@@ -8,8 +8,12 @@ the object plane in scatter_dataset, batches are padded into fixed length
 buckets (static shapes for XLA — the TPU answer to dynamic batching), and
 the masked-loss training step compiles once per bucket shape.
 
-Synthetic reversal-translation data stands in for WMT (no network egress);
-any list of (src_ids, tgt_ids) pairs drops in.
+Data: ``--src-file``/``--tgt-file`` read a REAL parallel text corpus from
+disk and byte-BPE-tokenize it (chainermn_tpu.datasets.bpe — the
+reference's WMT vocabulary step; generate a local corpus with
+examples/seq2seq/make_corpus.py). Without them, synthetic
+reversal-translation id pairs stand in (no network egress); any list of
+(src_ids, tgt_ids) pairs drops in.
 """
 
 import argparse
@@ -49,6 +53,14 @@ def main():
                         "(0 = greedy)")
     p.add_argument("--bucket", type=int, default=32,
                    help="pad lengths to multiples of this")
+    p.add_argument("--src-file", default=None,
+                   help="source-side text file (one sentence per line); "
+                        "tokenized with byte-BPE trained on the corpus")
+    p.add_argument("--tgt-file", default=None,
+                   help="target-side text file (parallel to --src-file)")
+    p.add_argument("--bpe-vocab", type=int, default=512,
+                   help="BPE vocabulary size for --src-file/--tgt-file "
+                        "(specials + bytes + merges)")
     args = p.parse_args()
 
     comm = chainermn_tpu.create_communicator(args.communicator)
@@ -59,14 +71,45 @@ def main():
     # the root builds the dataset; the actual pickled samples ship in
     # chunks over the plane (reference scatter_dataset semantics), so
     # workers need no access to the root's storage.
-    train = (synthetic_translation(args.n_train, src_vocab=args.vocab,
-                                   tgt_vocab=args.vocab, seed=0)
-             if comm.inter_rank == 0 else None)
+    vocab = args.vocab
+    if args.src_file or args.tgt_file:
+        # REAL parallel text from disk, byte-BPE tokenized — the
+        # reference's WMT vocabulary + encode step (upstream
+        # examples/seq2seq/seq2seq.py; SURVEY.md §3.4). The vocabulary
+        # artifact is cached next to the source file.
+        if not (args.src_file and args.tgt_file):
+            raise SystemExit("--src-file and --tgt-file go together")
+        train = None
+        if comm.inter_rank == 0:
+            from chainermn_tpu.datasets import train_bpe
+
+            with open(args.src_file, encoding="utf-8") as f:
+                src_lines = f.read().splitlines()
+            with open(args.tgt_file, encoding="utf-8") as f:
+                tgt_lines = f.read().splitlines()
+            if len(src_lines) != len(tgt_lines):
+                raise SystemExit(
+                    f"parallel corpus length mismatch: {len(src_lines)} "
+                    f"vs {len(tgt_lines)} lines")
+            cache = args.src_file + f".bpe{args.bpe_vocab}.json"
+            tok = train_bpe(src_lines + tgt_lines, args.bpe_vocab,
+                            cache_path=cache)
+            train = [(np.asarray(tok.encode(s), np.int32),
+                      np.asarray(tok.encode(t), np.int32))
+                     for s, t in zip(src_lines, tgt_lines)]
+            vocab = tok.vocab_size
+            print(f"corpus: {len(train)} pairs, BPE vocab {vocab} "
+                  f"({cache})")
+        vocab = comm.bcast_obj(vocab if comm.inter_rank == 0 else None)
+    else:
+        train = (synthetic_translation(args.n_train, src_vocab=args.vocab,
+                                       tgt_vocab=args.vocab, seed=0)
+                 if comm.inter_rank == 0 else None)
     train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0,
                                           shared_storage=False)
 
     model = Seq2Seq(n_layers=args.layer, n_units=args.unit,
-                    src_vocab=args.vocab, tgt_vocab=args.vocab)
+                    src_vocab=vocab, tgt_vocab=vocab)
 
     sample = pad_batch([train[i] for i in range(2)], args.bucket)
     variables = model.init(jax.random.PRNGKey(0), *sample[:3])

@@ -83,6 +83,13 @@ def main():
     p.add_argument("--rope", action="store_true",
                    help="rotary position embeddings instead of a "
                         "learned table")
+    p.add_argument("--text-file", default=None,
+                   help="train from a REAL text file: byte-BPE tokenize "
+                        "(vocab from --bpe-vocab, cached next to the "
+                        "file), concatenate, and chop into --seq-len "
+                        "next-token windows — the standard LM data prep")
+    p.add_argument("--bpe-vocab", type=int, default=512,
+                   help="BPE vocabulary size for --text-file")
     p.add_argument("--out", "-o", default="result_lm")
     args = p.parse_args()
 
@@ -90,7 +97,30 @@ def main():
     if comm.is_master:
         print(f"devices: {comm.size}  mesh axes: {comm.axis_names}")
 
-    train = synthetic_text(args.n_train, args.seq_len, args.vocab, seed=0)
+    if args.text_file:
+        from chainermn_tpu.datasets import BPETokenizer, train_bpe_file
+
+        cache = args.text_file + f".bpe{args.bpe_vocab}.json"
+        tok = train_bpe_file(args.text_file, args.bpe_vocab,
+                             cache_path=cache)
+        with open(args.text_file, encoding="utf-8") as f:
+            ids = np.asarray(tok.encode(f.read(), eos=True), np.int32)
+        args.vocab = tok.vocab_size
+        L = args.seq_len
+        if len(ids) < L + 1:
+            raise SystemExit(
+                f"--text-file encodes to only {len(ids)} tokens — need "
+                f"at least seq_len+1 = {L + 1} for one training window; "
+                "use a longer file or a smaller --seq-len")
+        n_win = (len(ids) - 1) // L
+        train = [(ids[i * L:i * L + L], ids[i * L + 1:i * L + L + 1])
+                 for i in range(n_win)]
+        if comm.is_master:
+            print(f"text: {len(ids)} tokens, BPE vocab {args.vocab}, "
+                  f"{len(train)} windows of {L} ({cache})")
+    else:
+        train = synthetic_text(args.n_train, args.seq_len, args.vocab,
+                               seed=0)
     train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
 
     attention = ("flash" if jax.default_backend() == "tpu"

@@ -1,0 +1,84 @@
+"""Byte-level BPE tokenizer (VERDICT r2 #3): the reference seq2seq
+vocabulary path (upstream examples/seq2seq/seq2seq.py, SURVEY.md §3.4),
+trained and applied on real local text."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.datasets import BPETokenizer, train_bpe, train_bpe_file
+from chainermn_tpu.datasets.bpe import BOS, EOS, PAD, _N_SPECIAL
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog\n",
+    "the quick brown fox\n",
+    "pack my box with five dozen liquor jugs\n",
+    "sphinx of black quartz judge my vow\n",
+] * 8
+
+
+def test_roundtrip_exact():
+    tok = train_bpe(CORPUS, vocab_size=320)
+    for text in ("the quick brown fox", "völlig neue wörter",
+                 "tabs\tand\nnewlines", "emoji \U0001f600 too",
+                 "unseen!!punctuation??"):
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_merges_compress():
+    tok = train_bpe(CORPUS, vocab_size=400)
+    ids = tok.encode("the quick brown fox")
+    raw_len = len("the quick brown fox".encode())
+    assert len(ids) < raw_len  # merges learned on the corpus compress it
+    assert tok.vocab_size <= 400
+
+
+def test_specials_and_ids():
+    tok = train_bpe(CORPUS, vocab_size=300)
+    ids = tok.encode("the fox", bos=True, eos=True)
+    assert ids[0] == BOS and ids[-1] == EOS
+    assert PAD == 0
+    body = ids[1:-1]
+    assert all(_N_SPECIAL <= i < tok.vocab_size for i in body)
+    # decode skips specials
+    assert tok.decode(ids) == "the fox"
+
+
+def test_deterministic():
+    a = train_bpe(CORPUS, vocab_size=350)
+    b = train_bpe(CORPUS, vocab_size=350)
+    assert a.merges == b.merges
+
+
+def test_save_load_and_cache(tmp_path):
+    tok = train_bpe(CORPUS, vocab_size=330)
+    p = tmp_path / "vocab.json"
+    tok.save(str(p))
+    tok2 = BPETokenizer.load(str(p))
+    assert tok2.merges == tok.merges
+    assert tok2.encode("lazy dog") == tok.encode("lazy dog")
+
+    corpus_path = tmp_path / "corpus.txt"
+    corpus_path.write_text("".join(CORPUS))
+    cache = tmp_path / "cache.json"
+    t1 = train_bpe_file(str(corpus_path), 330, cache_path=str(cache))
+    assert cache.exists()
+    t2 = train_bpe_file(str(corpus_path), 330, cache_path=str(cache))
+    assert t1.merges == t2.merges
+
+
+def test_vocab_too_small_raises():
+    with pytest.raises(ValueError):
+        train_bpe(CORPUS, vocab_size=100)
+
+
+def test_encoded_corpus_is_array_ready():
+    tok = train_bpe(CORPUS, vocab_size=300)
+    rows = [tok.encode(t, eos=True) for t in CORPUS[:4]]
+    L = max(len(r) for r in rows)
+    arr = np.full((len(rows), L), PAD, np.int32)
+    for i, r in enumerate(rows):
+        arr[i, :len(r)] = r
+    assert arr.dtype == np.int32 and (arr < tok.vocab_size).all()
+
+
+pytestmark = pytest.mark.quick

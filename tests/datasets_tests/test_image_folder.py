@@ -1,0 +1,88 @@
+"""Folder-of-JPEG ingestion (VERDICT r2 #3): REAL image files on disk,
+decoded per access — the reference ImageNet example's input path
+(upstream examples/imagenet/train_imagenet.py, SURVEY.md §3.1)."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.datasets import ImageFolderDataset, write_image_folder
+
+
+@pytest.fixture(scope="module")
+def image_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("imgs")
+    n = write_image_folder(str(root), n_classes=3, per_class=4,
+                           image_size=64, seed=0)
+    assert n == 12
+    return str(root)
+
+
+def test_scan_and_labels(image_root):
+    ds = ImageFolderDataset(image_root, image_size=48, train=False)
+    assert len(ds) == 12
+    assert ds.classes == ["class_0000", "class_0001", "class_0002"]
+    labels = sorted(int(ds[i][1]) for i in range(len(ds)))
+    assert labels == [0] * 4 + [1] * 4 + [2] * 4
+
+
+def test_decode_shapes_and_range(image_root):
+    ds = ImageFolderDataset(image_root, image_size=48, train=True, seed=3)
+    x, y = ds[0]
+    assert x.shape == (48, 48, 3) and x.dtype == np.float32
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    # train crops are random but per-index deterministic
+    x2, _ = ds[0]
+    np.testing.assert_array_equal(x, x2)
+
+
+def test_center_crop_deterministic(image_root):
+    ds = ImageFolderDataset(image_root, image_size=48, train=False)
+    a, _ = ds[5]
+    b, _ = ds[5]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_content_is_class_correlated(image_root):
+    # JPEG round-trip preserves the class prototypes: same-class images
+    # are closer to each other than cross-class (the learnability the
+    # synthetic generators provided, now through a real decode path)
+    ds = ImageFolderDataset(image_root, image_size=48, train=False)
+    xs = [ds[i][0] for i in range(12)]
+    same = np.mean([np.mean(np.abs(xs[4 * c + a] - xs[4 * c + b]))
+                    for c in range(3) for a in range(4)
+                    for b in range(a + 1, 4)])
+    cross = np.mean([np.mean(np.abs(xs[a] - xs[b]))
+                     for a in range(4) for b in range(4, 12)])
+    assert same < 0.9 * cross, (same, cross)
+
+
+def test_normalization(image_root):
+    mean, std = [0.5, 0.5, 0.5], [0.25, 0.25, 0.25]
+    raw = ImageFolderDataset(image_root, image_size=48, train=False)
+    norm = ImageFolderDataset(image_root, image_size=48, train=False,
+                              mean=mean, std=std)
+    x0 = raw[0][0]
+    x1 = norm[0][0]
+    np.testing.assert_allclose(x1, (x0 - 0.5) / 0.25, rtol=1e-5)
+
+
+def test_composes_with_scatter(image_root):
+    import chainermn_tpu
+
+    comm = chainermn_tpu.create_communicator("naive")
+    ds = ImageFolderDataset(image_root, image_size=48, train=False)
+    shard = chainermn_tpu.scatter_dataset(ds, comm, shuffle=True, seed=1)
+    assert len(shard) == 12  # single process: whole (shuffled) set
+    x, y = shard[0]
+    assert x.shape == (48, 48, 3)
+
+
+def test_missing_root_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ImageFolderDataset(str(tmp_path / "nope"))
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(ValueError):
+        ImageFolderDataset(str(tmp_path / "empty"))
+
+
+pytestmark = pytest.mark.quick
