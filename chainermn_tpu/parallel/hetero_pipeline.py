@@ -334,6 +334,10 @@ def hetero_pipeline_apply(pipe: HeteroPipeline, packed_params,
 
     my = lax.axis_index(pipe.axis_name)
     n = lax.axis_size(pipe.axis_name)
+    # cond branches must agree on varying axes: match the skip zeros to
+    # the union of the stage index's and the params' vma (a second mesh
+    # axis on the packed params would otherwise diverge the types)
+    vref = match_vma(my, packed_params)
 
     def _run(_):
         return jax.vmap(
@@ -342,7 +346,7 @@ def hetero_pipeline_apply(pipe: HeteroPipeline, packed_params,
 
     def _skip(_):
         return match_vma(
-            jnp.zeros((outs.shape[0],) + final.shape, final.dtype), my)
+            jnp.zeros((outs.shape[0],) + final.shape, final.dtype), vref)
 
     ys = lax.cond(my == n - 1, _run, _skip, None)
     return lax.psum(ys, pipe.axis_name)

@@ -75,9 +75,12 @@ def pipeline_apply(
                                  x_microbatches.dtype)
 
     # carry: (current activation, collected outputs) — pcast to varying so
-    # the fori_loop carry matches the per-shard (varying) updates
-    h0 = match_vma(jnp.zeros(mb_shape, act_dtype), my)
-    outs = match_vma(jnp.zeros((m,) + mb_shape, act_dtype), my)
+    # the fori_loop carry matches the per-shard (varying) updates; the
+    # vma reference is the union with the params' axes (TP×PP stages
+    # produce outputs varying on the model axis too — see _vma_ref)
+    vref = _vma_ref(my, stage_params)
+    h0 = match_vma(jnp.zeros(mb_shape, act_dtype), vref)
+    outs = match_vma(jnp.zeros((m,) + mb_shape, act_dtype), vref)
 
     def tick(t, carry):
         h, outs = carry
@@ -119,15 +122,30 @@ def stack_stage_params(params_list):
     )
 
 
-def _head_loss_grads(loss_fn, head_params_v, is_last, y, tgt, my):
+def _vma_ref(my, stage_params):
+    """Carry-vma reference: the stage axis UNION every varying axis of
+    the stage params. Composed TP×PP shards params over a second mesh
+    axis, and values computed from them (a row-parallel block's
+    post-psum bias add, the loss on its output) carry that axis in their
+    vma even where the numbers are equal across it — so every kernel
+    carry and cond branch must be pcast to the union or the fori_loop/
+    cond types diverge. Single-axis pipelines: reduces to ``my``."""
+    ref = my
+    for l in jax.tree_util.tree_leaves(stage_params):
+        ref = match_vma(ref, l)
+    return ref
+
+
+def _head_loss_grads(loss_fn, head_params_v, is_last, y, tgt, vref):
     """Loss value + output/head cotangents for the last stage's tick,
     cond-guarded so the head (an LM's d_model x vocab matmul + backward)
     runs only where the mask is true. ``loss_fn(head, out, tgt)`` must not
-    contain collectives (cond branches diverge per device). The head pytree
-    must already be pcast to varying (``head_params_v``) — differentiating
-    the replicated original would auto-psum every device's masked-out
-    contribution into each device's gradient under shard_map's vma
-    autodiff."""
+    contain collectives over the STAGE axis (cond branches diverge across
+    stages; collectives over an orthogonal mesh axis would be uniform but
+    are safest avoided). The head pytree must already be pcast to varying
+    (``head_params_v``) — differentiating the replicated original would
+    auto-psum every device's masked-out contribution into each device's
+    gradient under shard_map's vma autodiff."""
 
     def _fwd_bwd(yv):
         lj, (dy, dh) = jax.value_and_grad(
@@ -139,9 +157,9 @@ def _head_loss_grads(loss_fn, head_params_v, is_last, y, tgt, my):
         # fresh zeros are axis-invariant; pcast to match the real branch
         return match_vma(
             (jnp.zeros((), jnp.float32), jnp.zeros_like(yv),
-             jax.tree_util.tree_map(jnp.zeros_like, head_params_v)), my)
+             jax.tree_util.tree_map(jnp.zeros_like, head_params_v)), vref)
 
-    return lax.cond(is_last, _fwd_bwd, _skip, y)
+    return lax.cond(is_last, _fwd_bwd, _skip, match_vma(y, vref))
 
 
 def _masked_slot_write(buf, idx, val, valid):
@@ -225,22 +243,23 @@ def pipeline_1f1b_value_and_grad(
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
     bwd_perm = [(i, (i - 1) % n) for i in range(n)]
 
-    h0 = match_vma(jnp.zeros(mb_shape, act_dtype), my)
-    g0 = match_vma(jnp.zeros(mb_shape, act_dtype), my)
-    buf0 = match_vma(jnp.zeros((depth,) + mb_shape, act_dtype), my)
+    vref = _vma_ref(my, stage_params)
+    h0 = match_vma(jnp.zeros(mb_shape, act_dtype), vref)
+    g0 = match_vma(jnp.zeros(mb_shape, act_dtype), vref)
+    buf0 = match_vma(jnp.zeros((depth,) + mb_shape, act_dtype), vref)
     gacc0 = match_vma(
-        jax.tree_util.tree_map(jnp.zeros_like, stage_params), my)
-    lacc0 = match_vma(jnp.zeros((), jnp.float32), my)
+        jax.tree_util.tree_map(jnp.zeros_like, stage_params), vref)
+    lacc0 = match_vma(jnp.zeros((), jnp.float32), vref)
     carry0 = dict(h=h0, g=g0, buf=buf0, gacc=gacc0, lacc=lacc0)
     if head_params is not None:
         carry0["hacc"] = match_vma(
-            jax.tree_util.tree_map(jnp.zeros_like, head_params), my)
+            jax.tree_util.tree_map(jnp.zeros_like, head_params), vref)
         # see the interleaved kernel: differentiate against a varying copy
         # or vma autodiff psums every device's masked-out contribution in
-        head_params_v = match_vma(head_params, my)
+        head_params_v = match_vma(head_params, vref)
     if return_input_grads:
         carry0["dxs"] = match_vma(
-            jnp.zeros((m,) + mb_shape, jnp.float32), my)
+            jnp.zeros((m,) + mb_shape, jnp.float32), vref)
 
     def tick(t, carry):
         h_ring, g_ring, buf = carry["h"], carry["g"], carry["buf"]
@@ -283,7 +302,7 @@ def pipeline_1f1b_value_and_grad(
             loss_j, dldy = jax.value_and_grad(loss_fn)(y_fwd, tgt)
         else:
             loss_j, dldy, dhp = _head_loss_grads(
-                loss_fn, head_params_v, is_last_f, y_fwd, tgt, my)
+                loss_fn, head_params_v, is_last_f, y_fwd, tgt, vref)
             hacc = jax.tree_util.tree_map(lambda a, g: a + g, hacc, dhp)
         lacc = lacc + jnp.where(is_last_f, loss_j, 0.0)
 
@@ -564,8 +583,11 @@ def pipeline_interleaved_1f1b_value_and_grad(
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     bwd_perm = [(i, (i - 1) % S) for i in range(S)]
 
+    vref = _vma_ref(my, stage_params)
+
     def zeros_buf(depth):
-        return match_vma(jnp.zeros((V, depth) + mb_shape, act_dtype), my)
+        return match_vma(jnp.zeros((V, depth) + mb_shape, act_dtype),
+                         vref)
 
     def buf_read(buf, chunk, slot):
         sl = lax.dynamic_slice(
@@ -583,24 +605,24 @@ def pipeline_interleaved_1f1b_value_and_grad(
         fin=zeros_buf(Df),
         bin=zeros_buf(Db),
         act=zeros_buf(Da),
-        y_send=match_vma(jnp.zeros(mb_shape, act_dtype), my),
-        g_send=match_vma(jnp.zeros(mb_shape, act_dtype), my),
+        y_send=match_vma(jnp.zeros(mb_shape, act_dtype), vref),
+        g_send=match_vma(jnp.zeros(mb_shape, act_dtype), vref),
         gacc=match_vma(
-            jax.tree_util.tree_map(jnp.zeros_like, stage_params), my),
-        lacc=match_vma(jnp.zeros((), jnp.float32), my),
+            jax.tree_util.tree_map(jnp.zeros_like, stage_params), vref),
+        lacc=match_vma(jnp.zeros((), jnp.float32), vref),
     )
     if head_params is not None:
         carry0["hacc"] = match_vma(
-            jax.tree_util.tree_map(jnp.zeros_like, head_params), my)
+            jax.tree_util.tree_map(jnp.zeros_like, head_params), vref)
         # pcast to varying BEFORE differentiating: the grad w.r.t. an
         # axis-invariant (replicated) pytree is auto-psummed by shard_map's
         # vma tracking, which would fold every device's (mostly garbage,
         # masked-out) head contribution into each device's dhp before the
         # is_last_f mask can filter them
-        head_params_v = match_vma(head_params, my)
+        head_params_v = match_vma(head_params, vref)
     if return_input_grads:
         carry0["dxs"] = match_vma(
-            jnp.zeros((m,) + mb_shape, jnp.float32), my)
+            jnp.zeros((m,) + mb_shape, jnp.float32), vref)
 
     def chunk_params(c):
         return jax.tree_util.tree_map(
@@ -635,7 +657,7 @@ def pipeline_interleaved_1f1b_value_and_grad(
             loss_j, dldy = jax.value_and_grad(loss_fn)(y_f, tgt)
         else:
             loss_j, dldy, dhp = _head_loss_grads(
-                loss_fn, head_params_v, is_last_f, y_f, tgt, my)
+                loss_fn, head_params_v, is_last_f, y_f, tgt, vref)
             hacc = jax.tree_util.tree_map(
                 lambda a, g: a + g, hacc, dhp)
         lacc = carry["lacc"] + jnp.where(is_last_f, loss_j, 0.0)

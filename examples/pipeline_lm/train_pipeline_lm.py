@@ -176,6 +176,12 @@ def main():
     p = argparse.ArgumentParser(
         description="ChainerMN-TPU example: pipeline-parallel LM")
     p.add_argument("--stages-per-device", "-V", type=int, default=2)
+    p.add_argument("--tp", type=int, default=1, metavar="T",
+                   help="Megatron tensor parallelism INSIDE each "
+                        "pipeline stage on a (stage, model) mesh: "
+                        "column/row-parallel attention + MLP per block, "
+                        "psums over 'model' riding inside the 1F1B "
+                        "schedule (VERDICT r2 #6 composition)")
     p.add_argument("--n-pipeline", "-S", type=int, default=None,
                    help="pipeline depth in devices (default: all)")
     p.add_argument("--microbatches", "-M", type=int, default=None,
@@ -200,17 +206,25 @@ def main():
     if args.hetero:
         return main_hetero(args)
 
-    S = args.n_pipeline or jax.device_count()
+    T = max(args.tp, 1)
+    S = args.n_pipeline or (jax.device_count() // T)
     V = args.stages_per_device
     M = args.microbatches or 2 * S
     N = S * V
-    mesh = Mesh(np.array(jax.devices()[:S]), ("stage",))
-    print(f"pipeline: {S} devices x {V} chunks = {N} blocks, "
-          f"{M} micro-batches of {args.mb_size}x{args.seq_len}")
+    if S < 1 or S * T > jax.device_count():
+        raise SystemExit(f"need SxT = {S}x{T} devices, have "
+                         f"{jax.device_count()}")
+    if T > 1 and args.n_heads % T:
+        raise SystemExit(f"--tp {T} must divide --n-heads {args.n_heads}")
+    mesh = Mesh(np.array(jax.devices()[:S * T]).reshape(S, T),
+                ("stage", "model"))
+    print(f"pipeline: {S} stage devices x {V} chunks = {N} blocks"
+          + (f", TP {T} (mesh stage x model)" if T > 1 else "")
+          + f", {M} micro-batches of {args.mb_size}x{args.seq_len}")
 
     block = TransformerBlock(
         d_model=args.d_model, n_heads=args.n_heads, d_ff=args.d_ff,
-        attention=args.attention)
+        attention=args.attention, tp_axis="model" if T > 1 else None)
     embed = EmbedIn(args.vocab, args.d_model, args.seq_len)
     head = HeadOut(args.vocab)
 
@@ -218,17 +232,41 @@ def main():
     toks0 = np.zeros((args.mb_size, args.seq_len), np.int32)
     h0 = np.zeros((args.mb_size, args.seq_len, args.d_model), np.float32)
     emb_p = embed.init(rng, toks0)["params"]
-    stage_p = stack_stage_params([
-        block.init(jax.random.fold_in(rng, k), h0)["params"]
-        for k in range(N)])
-    stage_p = jax.tree_util.tree_map(
-        lambda q: q.reshape((V, S) + q.shape[1:]), stage_p)
+    if T > 1:
+        # TP params must be initialized per (stage, model) shard — inside
+        # shard_map, same rng along 'model' so REPLICATED leaves
+        # (LayerNorms) start identical across the model axis (the
+        # Megatron f-operator keeps them in sync from there; TP slices
+        # are rng-tied, which only correlates the init, see
+        # tests/parallel_tests/test_tp_transformer.py)
+        def init_stages(h0):
+            s = jax.lax.axis_index("stage")
+            ps = [
+                block.init(jax.random.fold_in(rng, v * S + s), h0)["params"]
+                for v in range(V)
+            ]
+            p = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *ps)
+            return jax.tree_util.tree_map(lambda l: l[:, None, None], p)
+
+        stage_p = jax.jit(shard_map(
+            init_stages, mesh=mesh, in_specs=P(),
+            out_specs=P(None, "stage", "model"), check_vma=False))(
+                jnp.asarray(h0))
+    else:
+        stage_p = stack_stage_params([
+            block.init(jax.random.fold_in(rng, k), h0)["params"]
+            for k in range(N)])
+        stage_p = jax.tree_util.tree_map(
+            lambda q: q.reshape((V, S) + q.shape[1:]), stage_p)
     head_p = head.init(jax.random.fold_in(rng, 999), h0)["params"]
     params = (emb_p, stage_p, head_p)
     opt = optax.adam(args.lr)
     opt_state = opt.init(params)
 
     def head_loss(hp, out, tgt):
+        # full-vocab head, REPLICATED over 'model' (collective-free, the
+        # loss hook's contract); each model duplicate computes the same
+        # loss on the model-invariant pipeline output
         logits = head.apply({"params": hp}, out)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, tgt).mean()
@@ -236,18 +274,35 @@ def main():
     def stage_fn(sp, h):
         return block.apply({"params": sp}, h)
 
+    # stage params stack: [V, S(sharded), ...] — with TP a third
+    # 'model'-sharded axis. In-shard both singleton axes are stripped.
+    n_lead = 2 if T > 1 else 1
+    stage_spec = (P(None, "stage", "model") if T > 1
+                  else P(None, "stage"))
+
     def pipe(sp, hp, x_mb, tgts):
-        sp = jax.tree_util.tree_map(lambda q: q.squeeze(1), sp)
+        for _ in range(n_lead):
+            sp = jax.tree_util.tree_map(lambda q: q.squeeze(1), sp)
         loss, g, aux = pipeline_interleaved_1f1b_value_and_grad(
             stage_fn, head_loss, sp, x_mb, tgts, "stage", V,
             head_params=hp, return_input_grads=True)
-        return (loss, jax.tree_util.tree_map(lambda q: q[:, None], g),
-                aux["head_grads"], aux["input_grads"])
+        hg, dxs = aux["head_grads"], aux["input_grads"]
+        if T > 1:
+            # equal along 'model' by construction (the f-operator psums
+            # input grads; every model duplicate runs the same head);
+            # pmean resolves their vma to invariant for out_specs P()
+            loss = jax.lax.pmean(loss, "model")
+            hg = jax.tree_util.tree_map(
+                lambda q: jax.lax.pmean(q, "model"), hg)
+            dxs = jax.lax.pmean(dxs, "model")
+        for _ in range(n_lead):
+            g = jax.tree_util.tree_map(lambda q: q[:, None], g)
+        return (loss, g, hg, dxs)
 
     pipe_sm = shard_map(
         pipe, mesh=mesh,
-        in_specs=(P(None, "stage"), P(), P(), P()),
-        out_specs=(P(), P(None, "stage"), P(), P()))
+        in_specs=(stage_spec, P(), P(), P()),
+        out_specs=(P(), stage_spec, P(), P()))
 
     @jax.jit
     def train_step(params, opt_state, toks, tgts):

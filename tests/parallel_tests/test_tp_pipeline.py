@@ -1,0 +1,161 @@
+"""TP×PP composition (VERDICT r2 #6): Megatron tensor-parallel
+TransformerBlocks as pipeline stages on a ('stage', 'model') mesh —
+column/row-parallel psums over 'model' riding INSIDE the 1F1B schedule's
+'stage' ring.
+
+Correctness pillars checked here:
+1. the composed step trains (loss decreases) under both the plain and
+   interleaved 1F1B kernels;
+2. leaves that are logically replicated along 'model' (LayerNorms)
+   remain bit-identical across the model axis after optimizer steps —
+   the Megatron f-operator property, now through the pipeline's
+   cond-guarded loss hook and vma-matched carries (_vma_ref);
+3. loss / head grads / input grads come out equal along 'model'
+   (resolved by the driver's pmean), so composition with an outer
+   embedding vjp stays exact.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from chainermn_tpu.models.transformer import TransformerBlock
+from chainermn_tpu.parallel import (
+    pipeline_1f1b_value_and_grad,
+    pipeline_interleaved_1f1b_value_and_grad,
+)
+
+S, T, D, H, FF, L, MB, M = 2, 2, 32, 4, 64, 16, 2, 4
+VOCAB = 48
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:S * T]).reshape(S, T),
+                ("stage", "model"))
+
+
+def _setup(V=1):
+    mesh = _mesh()
+    block = TransformerBlock(d_model=D, n_heads=H, d_ff=FF,
+                             attention="reference", tp_axis="model")
+    rng = jax.random.PRNGKey(0)
+    h0 = jnp.zeros((MB, L, D), jnp.float32)
+
+    def init_stages(h0):
+        s = jax.lax.axis_index("stage")
+        ps = [block.init(jax.random.fold_in(rng, v * S + s),
+                         h0)["params"] for v in range(V)]
+        p = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *ps)
+        return jax.tree_util.tree_map(lambda l: l[:, None, None], p)
+
+    stage_p = jax.jit(shard_map(
+        init_stages, mesh=mesh, in_specs=P(),
+        out_specs=P(None, "stage", "model"), check_vma=False))(h0)
+    head_p = {"w": jnp.asarray(
+        np.random.RandomState(7).randn(D, VOCAB) * 0.1, jnp.float32)}
+
+    def stage_fn(sp, h):
+        return block.apply({"params": sp}, h)
+
+    def head_loss(hp, out, tgt):
+        logits = out @ hp["w"]
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    return mesh, block, stage_p, head_p, stage_fn, head_loss
+
+
+def _data(seed=1):
+    rs = np.random.RandomState(seed)
+    xs = rs.randn(M, MB, L, D).astype(np.float32) * 0.3
+    ys = rs.randint(0, VOCAB, size=(M, MB, L)).astype(np.int32)
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+@pytest.mark.parametrize("kernel", ["plain", "interleaved"])
+def test_tp_pipeline_trains_and_stays_synced(kernel):
+    V = 1 if kernel == "plain" else 2
+    mesh, block, stage_p, head_p, stage_fn, head_loss = _setup(V)
+    xs, ys = _data()
+    spec = P(None, "stage", "model")
+
+    def pipe(sp, hp, x_mb, tgts):
+        sp = jax.tree_util.tree_map(
+            lambda q: q.squeeze(2).squeeze(1), sp)
+        if kernel == "plain":
+            sp = jax.tree_util.tree_map(lambda q: q[0], sp)
+            loss, g, aux = pipeline_1f1b_value_and_grad(
+                stage_fn, head_loss, sp, x_mb, tgts, "stage",
+                head_params=hp, return_input_grads=True)
+            g = jax.tree_util.tree_map(lambda q: q[None], g)
+        else:
+            loss, g, aux = pipeline_interleaved_1f1b_value_and_grad(
+                stage_fn, head_loss, sp, x_mb, tgts, "stage", V,
+                head_params=hp, return_input_grads=True)
+        hg = jax.tree_util.tree_map(
+            lambda q: jax.lax.pmean(q, "model"), aux["head_grads"])
+        dxs = jax.lax.pmean(aux["input_grads"], "model")
+        loss = jax.lax.pmean(loss, "model")
+        g = jax.tree_util.tree_map(lambda q: q[:, None, None], g)
+        return loss, g, hg, dxs
+
+    pipe_sm = jax.jit(shard_map(
+        pipe, mesh=mesh, in_specs=(spec, P(), P(), P()),
+        out_specs=(P(), spec, P(), P())))
+
+    # SGD lr: the V=2 interleaved net is twice as deep — 0.3 diverges
+    # there while the gradient itself is correct (0.05 converges to 0.55)
+    lr, steps = (0.3, 30) if kernel == "plain" else (0.05, 40)
+    losses = []
+    sp, hp = stage_p, head_p
+    for _ in range(steps):
+        loss, g, hg, dxs = pipe_sm(sp, hp, xs, ys)
+        losses.append(float(loss))
+        sp = jax.tree_util.tree_map(lambda p, q: p - lr * q, sp, g)
+        hp = jax.tree_util.tree_map(lambda p, q: p - lr * q, hp, hg)
+    assert losses[-1] < 0.7 * losses[0], losses
+    assert np.isfinite(np.asarray(dxs)).all()
+
+    # logically-replicated leaves stay identical along 'model'
+    flat = jax.tree_util.tree_flatten_with_path(sp)[0]
+    checked = 0
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        if "LayerNorm" in name:
+            a = np.asarray(leaf)  # [V, S, T, ...]
+            np.testing.assert_array_equal(
+                a[:, :, 0], a[:, :, 1],
+                err_msg=f"model-replicated leaf desynced: {name}")
+            checked += 1
+    assert checked >= 2
+
+
+def test_input_grads_equal_along_model():
+    # the f-operator makes stage-0 input cotangents FULL on every model
+    # shard; values must agree across 'model' before the pmean
+    mesh, block, stage_p, head_p, stage_fn, head_loss = _setup(V=1)
+    xs, ys = _data(seed=3)
+    spec = P(None, "stage", "model")
+
+    def pipe(sp, hp, x_mb, tgts):
+        sp = jax.tree_util.tree_map(
+            lambda q: q[0].squeeze(1).squeeze(0), sp)
+        loss, g, aux = pipeline_1f1b_value_and_grad(
+            stage_fn, head_loss, sp, x_mb, tgts, "stage",
+            head_params=hp, return_input_grads=True)
+        # expose the raw per-shard dxs stacked over 'model'
+        return aux["input_grads"][None]
+
+    out = jax.jit(shard_map(
+        pipe, mesh=mesh, in_specs=(spec, P(), P(), P()),
+        out_specs=P("model")))(stage_p, head_p, xs, ys)
+    a = np.asarray(out)
+    np.testing.assert_allclose(a[0], a[1], rtol=1e-6, atol=1e-7)
+
+
+pytestmark = pytest.mark.quick
