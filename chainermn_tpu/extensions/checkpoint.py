@@ -55,9 +55,10 @@ def _flatten_state(state):
     ``leaf_i_s<k>`` array per addressable shard, ordered by device id — no
     process ever holds more than its own shards on the host. Restore
     (``maybe_load``) reassembles them against the template leaf's sharding
-    via ``jax.make_array_from_single_device_arrays``; same-topology
-    restore is the contract, exactly like the reference's per-rank
-    snapshot files (SURVEY.md §3.5).
+    via ``jax.make_array_from_single_device_arrays`` — same-sharding fast
+    path, and RESHARDING onto a different mesh by splicing ranges from
+    the saved index manifests (beyond the reference's rigid per-rank
+    snapshot files, SURVEY.md §3.5; VERDICT r2 #5).
     """
     leaves, treedef = jax.tree_util.tree_flatten(state)
     uniq = {
@@ -93,6 +94,107 @@ def _index_array(index) -> np.ndarray:
         [(s.start if s.start is not None else 0,
           s.stop if s.stop is not None else -1) for s in index],
         np.int64).reshape(len(index), 2)
+
+
+def _bounds(index, gshape):
+    """Concrete (start, stop) per dim from a shard index (tuple of
+    slices; None start/stop mean the full dimension)."""
+    return [(s.start if s.start is not None else 0,
+             s.stop if s.stop is not None else d)
+            for s, d in zip(index, gshape)]
+
+
+class _SpliceTargets:
+    """Resharding-restore assembly for ONE leaf: the ranges THIS process
+    needs (its template shards), filled incrementally from whatever saved
+    pieces intersect them. Shard data is only np.asarray'd (npz is lazy)
+    when a piece actually intersects a needed range, so no process ever
+    materializes shards it does not need — the module's
+    no-global-leaf-on-host contract extends to resharding."""
+
+    def __init__(self, refs, gshape, dtype):
+        self.gshape = gshape
+        self.bounds = [_bounds(r.index, gshape) for r in refs]
+        self.bufs = [
+            np.empty(tuple(b - a for a, b in tb), dtype)
+            for tb in self.bounds
+        ]
+        self.vols = [b.size for b in self.bufs]
+        self.covered = [0] * len(self.bufs)
+        self._seen = set()
+
+    def consume(self, src, i):
+        """Fold leaf ``i``'s pieces from one snapshot file in. Saved
+        shards are a disjoint partition of the global array (replicas
+        deduplicated at save), so coverage is countable by intersection
+        volume; duplicate indices across files are skipped."""
+        if f"leaf_{i}_nshards" not in set(getattr(src, "files", src)):
+            return
+        for k in range(int(src[f"leaf_{i}_nshards"])):
+            idx = np.asarray(src[f"leaf_{i}_idx{k}"])
+            key = idx.tobytes()
+            if key in self._seen:
+                continue
+            sb = [(int(a), int(b) if b != -1 else int(d))
+                  for (a, b), d in zip(idx, self.gshape)]
+            arr = None
+            for t, tb in enumerate(self.bounds):
+                inter = [(max(a1, a2), min(b1, b2))
+                         for (a1, b1), (a2, b2) in zip(tb, sb)]
+                if any(b <= a for a, b in inter):
+                    continue
+                if arr is None:
+                    arr = np.asarray(src[f"leaf_{i}_s{k}"])
+                dst = tuple(slice(a - ta, b - ta)
+                            for (a, b), (ta, _) in zip(inter, tb))
+                srcsl = tuple(slice(a - sa, b - sa)
+                              for (a, b), (sa, _) in zip(inter, sb))
+                self.bufs[t][dst] = arr[srcsl]
+                self.covered[t] += int(np.prod(
+                    [b - a for a, b in inter], initial=1))
+            if arr is not None:
+                self._seen.add(key)
+
+    @property
+    def complete(self) -> bool:
+        return self.covered == self.vols
+
+    def require_complete(self, i):
+        if not self.complete:
+            raise ValueError(
+                f"snapshot leaf {i}: saved shards cover only "
+                f"{self.covered}/{self.vols} elements of this process's "
+                "target ranges — snapshot incomplete (a peer process's "
+                "file is missing?)")
+
+
+class _PeerSnapshots:
+    """Lazy, cached handles on peer processes' snapshot files for one
+    restore — opened only if the local file cannot cover a spliced
+    leaf's ranges, reused across leaves, closed by ``maybe_load``."""
+
+    def __init__(self, path: str, it: int, inter_rank: int,
+                 inter_size: int):
+        self._ranks = [r for r in range(inter_size) if r != inter_rank]
+        self._path = path
+        self._it = it
+        self._open: dict = {}
+
+    def __iter__(self):
+        for r in self._ranks:
+            if r not in self._open:
+                fn = os.path.join(self._path,
+                                  f"snapshot_iter_{self._it}.{r}")
+                self._open[r] = (np.load(fn, allow_pickle=False)
+                                 if os.path.exists(fn) else None)
+            if self._open[r] is not None:
+                yield self._open[r]
+
+    def close(self):
+        for z in self._open.values():
+            if z is not None and hasattr(z, "close"):
+                z.close()
+        self._open = {}
 
 
 def _unique_shards(l):
@@ -383,39 +485,60 @@ class MultiNodeCheckpointer:
         leaves, treedef = jax.tree_util.tree_flatten(state)
         keys = set(getattr(loaded, "files", loaded))
         new_leaves = []
-        for i, ref in enumerate(leaves):
-            if f"leaf_{i}_nshards" in keys:
-                new_leaves.append(self._load_sharded_leaf(loaded, i, ref))
-                continue
-            arr = loaded[f"leaf_{i}"]
-            # honor the reference leaf's sharding only when it was actually
-            # committed — device_put on an uncommitted default-device array
-            # would PIN the restored leaf to one device and clash with
-            # replicated/sharded leaves inside the next jitted step
-            if hasattr(ref, "sharding") and getattr(ref, "committed", False):
-                arr = jax.device_put(arr, ref.sharding)
-            elif hasattr(ref, "dtype"):
-                arr = jnp.asarray(arr, ref.dtype)
-            new_leaves.append(arr)
+        peers = _PeerSnapshots(self.path, it, self.comm.inter_rank,
+                               self.comm.inter_size)
+        try:
+            for i, ref in enumerate(leaves):
+                if f"leaf_{i}_nshards" in keys:
+                    new_leaves.append(
+                        self._load_sharded_leaf(loaded, i, ref, peers))
+                    continue
+                new_leaves.append(self._plain_leaf(loaded, i, ref))
+        finally:
+            peers.close()
         return jax.tree_util.tree_unflatten(treedef, new_leaves), it
 
     @staticmethod
-    def _load_sharded_leaf(loaded, i: int, ref):
+    def _plain_leaf(loaded, i: int, ref):
+        arr = loaded[f"leaf_{i}"]
+        # honor the reference leaf's sharding only when it was actually
+        # committed — device_put on an uncommitted default-device array
+        # would PIN the restored leaf to one device and clash with
+        # replicated/sharded leaves inside the next jitted step
+        if hasattr(ref, "sharding") and getattr(ref, "committed", False):
+            return jax.device_put(arr, ref.sharding)
+        if hasattr(ref, "dtype"):
+            return jnp.asarray(arr, ref.dtype)
+        return arr
+
+    def _load_sharded_leaf(self, loaded, i: int, ref, peers):
         """Reassemble a per-shard-saved leaf onto the template's sharding —
         each process device_puts only its own shards; no host ever sees the
-        global array."""
+        global array.
+
+        Fast path: the template's shard indices match the saved ones
+        (same mesh/sharding) — each index maps to one saved array.
+        RESHARDING path (VERDICT r2 #5): on any index mismatch, each
+        template shard is SPLICED from the overlapping ranges of the
+        saved shards — the per-shard index manifests already on disk
+        describe exactly which global slice every saved array covers, so
+        restoring onto a different mesh (fewer/more devices, different
+        partitioning) is pure interval arithmetic, consulting peer
+        processes' snapshot files only when the local file does not
+        cover a needed range."""
         n = int(loaded[f"leaf_{i}_nshards"])
         gshape = tuple(int(d) for d in loaded[f"leaf_{i}_gshape"])
         if not _is_device_sharded(ref):
             raise ValueError(
                 f"snapshot leaf {i} was saved device-sharded ({n} shards, "
                 f"global shape {gshape}) but the template leaf is not a "
-                "sharded jax.Array — restore with a state whose shardings "
-                "match the saved run (same mesh/topology)")
+                "sharded jax.Array — restore with a state whose leaf is "
+                "device-sharded (any mesh; resharding is supported)")
         if tuple(ref.shape) != gshape:
             raise ValueError(
                 f"snapshot leaf {i}: saved global shape {gshape}, "
-                f"template is {tuple(ref.shape)} — topology mismatch")
+                f"template is {tuple(ref.shape)} — different model, not "
+                "a resharding")
         # index-keyed lookup: replica shards (deduplicated at save) fan the
         # one saved copy back out to every device holding that index
         by_index = {
@@ -424,15 +547,23 @@ class MultiNodeCheckpointer:
             for k in range(n)
         }
         refs = sorted(ref.addressable_shards, key=lambda s: s.device.id)
-        singles = []
-        for r in refs:
-            key = _index_array(r.index).tobytes()
-            if key not in by_index:
-                raise ValueError(
-                    f"snapshot leaf {i}: no saved shard for this "
-                    f"process's shard index {r.index} — topology or "
-                    "sharding mismatch with the saved run")
-            singles.append(jax.device_put(by_index[key], r.device))
+        if all(_index_array(r.index).tobytes() in by_index for r in refs):
+            singles = [
+                jax.device_put(by_index[_index_array(r.index).tobytes()],
+                               r.device)
+                for r in refs
+            ]
+        else:
+            sp = _SpliceTargets(refs, gshape, np.dtype(ref.dtype))
+            sp.consume(loaded, i)
+            if not sp.complete:
+                for z in peers:  # lazy: opened only when actually needed
+                    sp.consume(z, i)
+                    if sp.complete:
+                        break
+            sp.require_complete(i)
+            singles = [jax.device_put(buf, r.device)
+                       for buf, r in zip(sp.bufs, refs)]
         return jax.make_array_from_single_device_arrays(
             gshape, ref.sharding, singles)
 

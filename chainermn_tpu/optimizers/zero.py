@@ -34,6 +34,19 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import PartitionSpec as P
 
 
+def _padded_size(total: int, n: int) -> int:
+    """Flat-vector length after padding for an n-way shard.
+
+    Pads to a device-count-INDEPENDENT quantum when the axis size allows
+    it: any n dividing 256 yields the same padded GLOBAL length, so
+    sharded snapshots reshard across device counts (8 <-> 4 etc.,
+    extensions/checkpoint.py's splicing restore) instead of tripping the
+    global-shape check on pad-length mismatch. One definition on purpose
+    — zero1 and zero2 snapshots must agree."""
+    q = 256 if 256 % n == 0 else n
+    return total + ((-total) % q)
+
+
 def make_zero1_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -82,7 +95,7 @@ def make_zero1_train_step(
 
     flat, unravel = ravel_pytree(params)
     total = flat.size
-    padded = total + ((-total) % n)
+    padded = _padded_size(total, n)
     shard_shape = (padded // n,)
 
     # -- initial state ---------------------------------------------------
@@ -176,7 +189,7 @@ def make_zero2_train_step(
 
     flat, unravel = ravel_pytree(params)
     total = flat.size
-    padded = total + ((-total) % n)
+    padded = _padded_size(total, n)
     shard_shape = (padded // n,)
 
     def init_fn(params):

@@ -337,7 +337,9 @@ def hetero_pipeline_apply(pipe: HeteroPipeline, packed_params,
     # cond branches must agree on varying axes: match the skip zeros to
     # the union of the stage index's and the params' vma (a second mesh
     # axis on the packed params would otherwise diverge the types)
-    vref = match_vma(my, packed_params)
+    from chainermn_tpu.parallel.pipeline import _vma_ref
+
+    vref = _vma_ref(my, packed_params)
 
     def _run(_):
         return jax.vmap(

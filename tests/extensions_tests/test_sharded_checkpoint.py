@@ -92,6 +92,73 @@ def test_fsdp_roundtrip_shard_files(comm, tmp_path):
     assert np.isfinite(float(m["main/loss"]))
 
 
+def test_resharding_restore_8_to_4(comm, tmp_path):
+    """VERDICT r2 #5: FSDP state saved on the 8-device mesh restores onto
+    a 4-device mesh — template shard indices don't match the saved ones,
+    so the splicing path assembles each target range from the saved index
+    manifests. Values bitwise-equal, training continues on the new mesh."""
+    from jax.sharding import Mesh
+    from chainermn_tpu.comm.xla import XlaCommunicator
+
+    if comm.size < 8:
+        pytest.skip("needs 8 devices")
+    step8, state8, x, y = _fsdp_state(comm)
+    state8, _ = step8(state8, x, y)
+    ck8 = chainermn_tpu.create_multi_node_checkpointer(
+        "reshard", comm, path=str(tmp_path))
+    ck8.save(state8, iteration=5)
+
+    comm4 = XlaCommunicator(
+        mesh=Mesh(np.asarray(jax.devices()[:4]), ("r4",)))
+    # SAME model as _fsdp_state (its n_units depend on comm.size=8)
+    model = MLP(n_units=8 * comm.size, n_out=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    step4, template4 = make_fsdp_train_step(
+        model, optax.adam(1e-3), comm4, params, donate=False)
+    # same model: global leaf shapes agree, only SHARD indices differ
+    jax.tree_util.tree_map(
+        lambda a, b: (a.shape, a.dtype) == (b.shape, b.dtype)
+        or pytest.fail(f"{a.shape} vs {b.shape}"), template4, state8)
+
+    ck4 = chainermn_tpu.create_multi_node_checkpointer(
+        "reshard", comm4, path=str(tmp_path))
+    restored, it = ck4.maybe_load(
+        jax.tree_util.tree_map(jnp.zeros_like, template4))
+    assert it == 5
+    # bitwise: the spliced 4-device global equals the 8-device global
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        restored, state8)
+    # and every restored leaf actually lives on the 4-device sharding
+    for leaf in jax.tree_util.tree_leaves(restored):
+        if hasattr(leaf, "sharding"):
+            assert set(leaf.sharding.device_set) == set(jax.devices()[:4])
+    # training continues on the new mesh
+    dsh4 = NamedSharding(comm4.mesh, P("r4"))
+    x4 = jax.device_put(np.asarray(x)[:8], dsh4)
+    y4 = jax.device_put(np.asarray(y)[:8], dsh4)
+    state4, m = step4(restored, x4, y4)
+    assert np.isfinite(float(m["main/loss"]))
+
+
+def test_reshard_wrong_model_still_raises(comm, tmp_path):
+    """A genuinely different model (different global length) is NOT a
+    resharding and must still fail loudly."""
+    step, state, x, y = _fsdp_state(comm)
+    ck = chainermn_tpu.create_multi_node_checkpointer(
+        "wrongmodel", comm, path=str(tmp_path))
+    ck.save(state, iteration=2)
+    model2 = MLP(n_units=8 * comm.size + 8, n_out=4)
+    params2 = model2.init(jax.random.PRNGKey(0),
+                          np.zeros((2, 28, 28), np.float32))["params"]
+    _, template2 = make_fsdp_train_step(
+        model2, optax.adam(1e-3), comm, params2, donate=False)
+    with pytest.raises(ValueError, match="different model|not a"):
+        ck.maybe_load(jax.tree_util.tree_map(jnp.zeros_like, template2))
+
+
 def test_sharded_snapshot_needs_sharded_template(comm, tmp_path):
     step, state, x, y = _fsdp_state(comm)
     ck = chainermn_tpu.create_multi_node_checkpointer(

@@ -84,8 +84,10 @@ def test_zero1_opt_state_is_sharded(comm):
                                         params)
     p_shard, opt_state = state
     n = comm.size
+    from chainermn_tpu.optimizers.zero import _padded_size
+
     flat = jax.flatten_util.ravel_pytree(params)[0]
-    padded = flat.size + ((-flat.size) % n)
+    padded = _padded_size(flat.size, n)
     assert p_shard.shape == (padded,)
     # the vector is sharded over the axis: each device holds padded/n
     shard_sizes = {
