@@ -21,7 +21,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-from .xla import DEFAULT_AXIS, XlaCommunicator
+from .xla import DEFAULT_AXIS, DEFAULT_DCN_BUCKET_BYTES, XlaCommunicator
 
 _COMM_NAMES = (
     "xla",          # the native name
@@ -49,12 +49,19 @@ def create_communicator(
     mesh with two communicators for hybrid parallelism).
     ``dcn_bucket_bytes`` bounds the flat-packed gradient buffers of
     ``allreduce_grad`` — the multi-slice (DCN) overlap-granularity knob.
+    The DCN-facing aliases (hierarchical / two_dimensional) default to
+    ``DEFAULT_DCN_BUCKET_BYTES`` (4 MiB; derivation in
+    docs/scaling_model.md §4); pass an explicit value (or 0/None via a
+    plain 'xla' communicator) to override.
     """
     name = communicator_name
     if name not in _COMM_NAMES:
         raise ValueError(
             f"unknown communicator {name!r}; expected one of {_COMM_NAMES}"
         )
+    if dcn_bucket_bytes is None and name in ("hierarchical",
+                                             "two_dimensional"):
+        dcn_bucket_bytes = DEFAULT_DCN_BUCKET_BYTES
 
     if mesh is None:
         if name == "single_node":

@@ -42,6 +42,33 @@ from .object_plane import ObjectPlane
 
 DEFAULT_AXIS = "r"
 
+# Default gradient bucket for DCN-facing communicators (hierarchical /
+# two_dimensional aliases). Derivation in docs/scaling_model.md §4: a
+# bucket must be (a) large enough that per-collective launch latency
+# (~100 µs over DCN) is <10% of its transfer time at ~25 GB/s per-host
+# DCN bandwidth → ≥ 4 MB, and (b) small enough that a typical model's
+# gradients split into ≥ ~8 buckets so the first reduction can overlap
+# the rest of the backward (ResNet-50 bf16 grads = 51 MB → 13 buckets;
+# the 124M LM = 248 MB → 62). 4 MiB satisfies both ends.
+DEFAULT_DCN_BUCKET_BYTES = 4 * 2 ** 20
+
+
+def plan_buckets(sized_items, bucket_bytes):
+    """Greedy in-order packing of ``(key, nbytes)`` items into buckets of
+    at most ``bucket_bytes`` (an oversized single item gets its own
+    bucket). Returns a list of key-lists. Pure — the unit the scaling
+    model's tests assert against (docs/scaling_model.md §4)."""
+    buckets, cur, cur_bytes = [], [], 0
+    for key, nb in sized_items:
+        if cur and cur_bytes + nb > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(key)
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return buckets
+
 
 def _is_tracer(x) -> bool:
     leaves = jax.tree_util.tree_leaves(x)
@@ -749,8 +776,9 @@ class XlaCommunicator(CommunicatorBase):
 
         Leaves are grouped by (varying axes, dtype-after-cast) — only
         same-typed leaves can share a buffer — then packed greedily in
-        pytree order. Invariant leaves skip communication entirely (they
-        are already global sums under vma tracking)."""
+        pytree order (:func:`plan_buckets`). Invariant leaves skip
+        communication entirely (they are already global sums under vma
+        tracking)."""
         from collections import defaultdict
 
         cdt = self._grad_dtype
@@ -766,16 +794,9 @@ class XlaCommunicator(CommunicatorBase):
             groups[(va, jnp.dtype(comm_dtype))].append(i)
 
         for (va, comm_dtype), idxs in groups.items():
-            buckets, cur, cur_bytes = [], [], 0
-            for i in idxs:
-                nb = leaves[i].size * comm_dtype.itemsize
-                if cur and cur_bytes + nb > self._bucket_bytes:
-                    buckets.append(cur)
-                    cur, cur_bytes = [], 0
-                cur.append(i)
-                cur_bytes += nb
-            if cur:
-                buckets.append(cur)
+            buckets = plan_buckets(
+                [(i, leaves[i].size * comm_dtype.itemsize) for i in idxs],
+                self._bucket_bytes)
             for bucket in buckets:
                 flat = jnp.concatenate(
                     [leaves[i].astype(comm_dtype).ravel() for i in bucket])

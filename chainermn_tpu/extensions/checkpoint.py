@@ -175,20 +175,24 @@ class _PeerSnapshots:
 
     def __init__(self, path: str, it: int, inter_rank: int,
                  inter_size: int):
-        self._ranks = [r for r in range(inter_size) if r != inter_rank]
-        self._path = path
-        self._it = it
+        # enumerate by GLOB, not by the restoring run's inter_size: the
+        # saving run may have had more processes (reshard 2-proc → 1-proc
+        # must still read file .1)
+        import glob as _glob
+
+        self._files = sorted(
+            fn for fn in _glob.glob(os.path.join(
+                path, f"snapshot_iter_{it}.*"))
+            if not fn.endswith(f".{inter_rank}"))
         self._open: dict = {}
 
     def __iter__(self):
-        for r in self._ranks:
-            if r not in self._open:
-                fn = os.path.join(self._path,
-                                  f"snapshot_iter_{self._it}.{r}")
-                self._open[r] = (np.load(fn, allow_pickle=False)
-                                 if os.path.exists(fn) else None)
-            if self._open[r] is not None:
-                yield self._open[r]
+        for fn in self._files:
+            if fn not in self._open:
+                self._open[fn] = (np.load(fn, allow_pickle=False)
+                                  if os.path.exists(fn) else None)
+            if self._open[fn] is not None:
+                yield self._open[fn]
 
     def close(self):
         for z in self._open.values():
@@ -469,7 +473,13 @@ class MultiNodeCheckpointer:
     def maybe_load(self, state: Any, iteration: Optional[int] = None):
         """Restore ``state`` from the newest complete snapshot (or the given
         iteration). Returns (state, iteration) — unchanged state and None if
-        nothing restorable exists."""
+        nothing restorable exists.
+
+        Resharding: a different device MESH restores fine (splicing, see
+        ``_load_sharded_leaf``), including onto FEWER processes (peer
+        files are discovered by glob). Restoring onto MORE processes than
+        saved is not supported — the new ranks have no own snapshot file,
+        so ``latest_common_iteration`` won't see a complete set."""
         self._drain()
         it = iteration if iteration is not None else self.latest_common_iteration()
         if it is None:
@@ -540,17 +550,20 @@ class MultiNodeCheckpointer:
                 f"template is {tuple(ref.shape)} — different model, not "
                 "a resharding")
         # index-keyed lookup: replica shards (deduplicated at save) fan the
-        # one saved copy back out to every device holding that index
-        by_index = {
-            np.asarray(loaded[f"leaf_{i}_idx{k}"]).tobytes():
-                loaded[f"leaf_{i}_s{k}"]
+        # one saved copy back out to every device holding that index. Only
+        # the SMALL idx arrays are read here — shard data stays lazy so
+        # the resharding branch never materializes shards it won't splice
+        saved_idx = {
+            np.asarray(loaded[f"leaf_{i}_idx{k}"]).tobytes(): k
             for k in range(n)
         }
         refs = sorted(ref.addressable_shards, key=lambda s: s.device.id)
-        if all(_index_array(r.index).tobytes() in by_index for r in refs):
+        if all(_index_array(r.index).tobytes() in saved_idx for r in refs):
             singles = [
-                jax.device_put(by_index[_index_array(r.index).tobytes()],
-                               r.device)
+                jax.device_put(
+                    loaded[f"leaf_{i}_s"
+                           f"{saved_idx[_index_array(r.index).tobytes()]}"],
+                    r.device)
                 for r in refs
             ]
         else:

@@ -42,7 +42,12 @@ def _padded_size(total: int, n: int) -> int:
     sharded snapshots reshard across device counts (8 <-> 4 etc.,
     extensions/checkpoint.py's splicing restore) instead of tripping the
     global-shape check on pad-length mismatch. One definition on purpose
-    — zero1 and zero2 snapshots must agree."""
+    — zero1 and zero2 snapshots must agree.
+
+    DELIBERATE compatibility break (2026-07-31): snapshots written with
+    the pre-quantum n-multiple padding have a different global length
+    and fail restore with 'different model'; re-save from a live run.
+    """
     q = 256 if 256 % n == 0 else n
     return total + ((-total) % q)
 

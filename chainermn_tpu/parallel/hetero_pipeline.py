@@ -60,6 +60,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from chainermn_tpu.parallel.pipeline import (
+    _vma_ref,
     pipeline_1f1b_value_and_grad,
     pipeline_apply,
 )
@@ -337,8 +338,6 @@ def hetero_pipeline_apply(pipe: HeteroPipeline, packed_params,
     # cond branches must agree on varying axes: match the skip zeros to
     # the union of the stage index's and the params' vma (a second mesh
     # axis on the packed params would otherwise diverge the types)
-    from chainermn_tpu.parallel.pipeline import _vma_ref
-
     vref = _vma_ref(my, packed_params)
 
     def _run(_):

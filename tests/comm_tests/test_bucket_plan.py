@@ -1,0 +1,90 @@
+"""Gradient bucketing plan (VERDICT r2 #4, docs/scaling_model.md §4):
+the DCN bucket default is a derived quantity, `plan_buckets` is the
+pure packing function, and the compiled program emits exactly one psum
+per planned bucket on a virtual multislice mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.comm.xla import (
+    DEFAULT_DCN_BUCKET_BYTES,
+    XlaCommunicator,
+    plan_buckets,
+)
+
+# ResNet-50-shaped gradient leaf sizes (params; bf16 wire = 2 B each):
+# one big early conv + the characteristic mix of 1x1/3x3 kernels and
+# small BN vectors, totalling ~25.5 M params like the real model
+RESNET_LEAVES = (
+    [9408, 64, 64]                                # stem
+    + [36864, 16384, 65536, 147456] * 8           # mid blocks
+    + [524288, 1048576, 2359296] * 6              # deep blocks
+    + [262144] * 4 + [2097152, 2048000]           # head-ish
+    + [256] * 53 + [512] * 30                     # BN scales/biases
+)
+
+
+def test_default_bucket_is_derived_not_token():
+    assert DEFAULT_DCN_BUCKET_BYTES == 4 * 2 ** 20
+    total = sum(RESNET_LEAVES) * 2  # bf16
+    n = len(plan_buckets([(i, s * 2) for i, s in enumerate(RESNET_LEAVES)],
+                         DEFAULT_DCN_BUCKET_BYTES))
+    # scaling_model.md §4: enough buckets to overlap (>= 8), each one
+    # bounded by the default
+    assert n >= 8
+    assert n <= 2 * total // DEFAULT_DCN_BUCKET_BYTES + 2
+
+
+def test_plan_buckets_packing_rules():
+    B = 100
+    plan = plan_buckets([("a", 60), ("b", 30), ("c", 30), ("d", 150),
+                         ("e", 10)], B)
+    # greedy in-order: a+b fit; c starts the next bucket; oversized d
+    # gets its own; e follows
+    assert plan == [["a", "b"], ["c"], ["d"], ["e"]]
+    for bucket in plan[:2]:
+        pass  # structure asserted above; sizes <= B by construction
+    assert plan_buckets([], B) == []
+    assert plan_buckets([("x", 500)], B) == [["x"]]
+
+
+def test_hierarchical_default_and_psum_count_matches_plan():
+    """Virtual 2-slice mesh: the hierarchical alias picks up the derived
+    default, and with a small explicit bucket the traced program
+    contains exactly one psum per planned bucket."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 devices")
+    comm = chainermn_tpu.create_communicator("hierarchical")
+    assert comm._bucket_bytes == DEFAULT_DCN_BUCKET_BYTES
+
+    # explicit small bucket: 5 f32 leaves of 1000 B at 2048 B/bucket
+    # -> plan says 3 buckets ([2], [2], [1])
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                ("dcn", "ici"))
+    comm = XlaCommunicator(mesh=mesh, dcn_bucket_bytes=2048)
+    leaves = {f"g{i}": jnp.ones((250,), jnp.float32) for i in range(5)}
+    plan = plan_buckets([(k, 1000) for k in leaves], 2048)
+    assert [len(b) for b in plan] == [2, 2, 1]
+
+    def f(g):
+        return comm.allreduce_grad(g, "mean")
+
+    sm = shard_map(
+        f, mesh=mesh,
+        in_specs=(P(("dcn", "ici")),), out_specs=P(("dcn", "ici")))
+    gg = {k: jnp.ones((8 * 250,), jnp.float32) for k in leaves}
+    jaxpr = jax.make_jaxpr(sm)(gg)
+    n_psum = str(jaxpr).count("psum")
+    assert n_psum == len(plan), (n_psum, plan, jaxpr)
+    # and the result is still an exact mean
+    out = jax.jit(sm)(gg)
+    np.testing.assert_allclose(np.asarray(out["g0"]), np.ones(8 * 250))
+
+
+pytestmark = pytest.mark.quick
