@@ -224,3 +224,45 @@ def test_branching_chain_rejects_pipeline_lowering(comm):
     with pytest.raises(ValueError, match="linear"):
         chain.to_hetero_pipeline(
             params, jax.ShapeDtypeStruct((2, 4), jnp.float32))
+
+
+def test_param_budget_branching_guidance(comm):
+    """VERDICT r2 #7: past the replicated-param budget, apply() refuses
+    with actionable guidance instead of silently OOMing — branching
+    graphs are pointed at TP-sharding / an explicit budget raise."""
+    chain = MultiNodeChainList(comm, replicated_param_budget_bytes=64)
+    chain.add_link(Part(feat=4), rank=0, rank_in=None, rank_out=[1, 2])
+    chain.add_link(Part(feat=4), rank=1, rank_in=0, rank_out=3)
+    chain.add_link(Part(feat=4), rank=2, rank_in=0, rank_out=3)
+    chain.add_link(Join(feat=2), rank=3, rank_in=[1, 2], rank_out=None)
+    x0 = np.zeros((2, 4), np.float32)
+    params = chain.init(jax.random.PRNGKey(0), x0)
+    with pytest.raises(ValueError, match="branches|canonical"):
+        chain.apply(params, x0)
+    # scalar Python leaves (plain-callable stages) are counted, not a
+    # crash
+    chain3 = MultiNodeChainList(comm, replicated_param_budget_bytes=64)
+    chain3.add_link(lambda p, h: h * p["s"], rank=0, rank_in=None,
+                    rank_out=None)
+    chain3._stages[0].module = lambda p, h: h * p["s"]
+    assert chain3._check_param_budget([{"s": 2.0}]) is None
+    # an explicitly raised budget is honored
+    chain2 = MultiNodeChainList(
+        comm, replicated_param_budget_bytes=2 ** 30)
+    chain2._stages = chain._stages
+    y = jax.jit(shard_map(
+        lambda x: chain2.apply(params, x), mesh=comm.mesh,
+        in_specs=P(), out_specs=P(), check_vma=False))(x0)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_param_budget_linear_points_at_pipeline(comm):
+    chain = MultiNodeChainList(comm, replicated_param_budget_bytes=64)
+    for i in range(comm.size):
+        chain.add_link(Part(feat=4), rank=i,
+                       rank_in=None if i == 0 else i - 1,
+                       rank_out=None if i == comm.size - 1 else i + 1)
+    x0 = np.zeros((2, 4), np.float32)
+    params = chain.init(jax.random.PRNGKey(0), x0)
+    with pytest.raises(ValueError, match="to_hetero_pipeline"):
+        chain.apply(params, x0)
