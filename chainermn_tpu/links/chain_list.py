@@ -242,7 +242,7 @@ class MultiNodeChainList:
             (``wire_dtype``, ``int_bound``, ``head_in_loss``). By
             default (``head_in_loss=True``) the final stage and the
             caller's ``loss_fn`` run cond-guarded on the last device —
-            so ``loss_fn`` must not contain collectives; pass
+            so ``loss_fn`` must not contain STAGE-axis collectives; pass
             ``head_in_loss=False`` (the full-width wire format) if it
             does.
 

@@ -285,8 +285,9 @@ def hetero_pipeline_1f1b_value_and_grad(
     Args:
       pipe: the :class:`HeteroPipeline` (built once, outside).
       loss_fn: ``(final_stage_output, target) -> scalar`` on DECODED
-        outputs. Must not contain collectives (with ``head_in_loss`` it
-        runs cond-guarded on the final stage's device).
+        outputs. No collectives over the STAGE axis (with
+        ``head_in_loss`` it runs cond-guarded on the final stage's
+        device); collectives over orthogonal mesh axes are fine.
       packed_params: THIS shard's ``[P]`` flat stage parameters (shard
         ``pipe.pack_params()`` with ``P(axis_name)`` and strip the leading
         axis in-shard, exactly like ``stack_stage_params``).

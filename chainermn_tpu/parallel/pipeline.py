@@ -139,13 +139,18 @@ def _vma_ref(my, stage_params):
 def _head_loss_grads(loss_fn, head_params_v, is_last, y, tgt, vref):
     """Loss value + output/head cotangents for the last stage's tick,
     cond-guarded so the head (an LM's d_model x vocab matmul + backward)
-    runs only where the mask is true. ``loss_fn(head, out, tgt)`` must not
-    contain collectives over the STAGE axis (cond branches diverge across
-    stages; collectives over an orthogonal mesh axis would be uniform but
-    are safest avoided). The head pytree must already be pcast to varying
-    (``head_params_v``) — differentiating the replicated original would
-    auto-psum every device's masked-out contribution into each device's
-    gradient under shard_map's vma autodiff."""
+    runs only where the mask is true. ``loss_fn(head, out, tgt)`` must
+    not contain collectives over the STAGE axis (cond branches diverge
+    across stages) — but collectives over ORTHOGONAL mesh axes are fine:
+    the predicate depends on the stage index only, so every member of
+    such a collective takes the same branch (this is what lets a
+    vocab-parallel head + cross-entropy run inside the hook, full logits
+    never materializing). The skip branch mirrors the real branch's
+    exact varying axes via eval_shape, whatever collectives shaped them.
+    The head pytree must already be pcast to varying (``head_params_v``)
+    — differentiating the replicated original would auto-psum every
+    device's masked-out contribution into each device's gradient under
+    shard_map's vma autodiff."""
 
     def _fwd_bwd(yv):
         lj, (dy, dh) = jax.value_and_grad(
@@ -153,13 +158,21 @@ def _head_loss_grads(loss_fn, head_params_v, is_last, y, tgt, vref):
                 yv, head_params_v)
         return lj.astype(jnp.float32), dy, dh
 
-    def _skip(yv):
-        # fresh zeros are axis-invariant; pcast to match the real branch
-        return match_vma(
-            (jnp.zeros((), jnp.float32), jnp.zeros_like(yv),
-             jax.tree_util.tree_map(jnp.zeros_like, head_params_v)), vref)
+    y = match_vma(y, vref)
+    out_avals = jax.eval_shape(_fwd_bwd, y)
 
-    return lax.cond(is_last, _fwd_bwd, _skip, match_vma(y, vref))
+    def _skip(yv):
+        # fresh zeros are axis-invariant; pcast each leaf UP to exactly
+        # the real branch's vma (psums inside loss_fn may have REMOVED
+        # axes there, so a blanket vref match would overshoot)
+        def z(a):
+            buf = jnp.zeros(a.shape, a.dtype)
+            need = tuple(getattr(a, "vma", None) or ())
+            return lax.pcast(buf, need, to="varying") if need else buf
+
+        return jax.tree_util.tree_map(z, out_avals)
+
+    return lax.cond(is_last, _fwd_bwd, _skip, y)
 
 
 def _masked_slot_write(buf, idx, val, valid):
@@ -223,8 +236,11 @@ def pipeline_1f1b_value_and_grad(
       axis_name: the stage mesh axis.
       head_params / return_input_grads: the same composition hooks as
         :func:`pipeline_interleaved_1f1b_value_and_grad` — a loss-side
-        trainable pytree (``loss_fn(head_params, out, tgt)``; ``loss_fn``
-        must not contain collectives) and the stage-0 input cotangents.
+        trainable pytree (``loss_fn(head_params, out, tgt)``; no
+        collectives over the STAGE axis, but collectives over orthogonal
+        mesh axes are supported — e.g. a column-parallel head with
+        vocab-parallel cross-entropy, see ``_head_loss_grads``) and the
+        stage-0 input cotangents.
 
     Returns ``(loss, grads)``, plus an ``aux`` dict (``head_grads``,
     ``input_grads``) when either hook is set: the mean loss (replicated)

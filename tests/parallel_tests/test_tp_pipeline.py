@@ -135,6 +135,71 @@ def test_tp_pipeline_trains_and_stays_synced(kernel):
     assert checked >= 2
 
 
+def test_vocab_parallel_head_in_loss_hook_matches_replicated():
+    """The loss hook admits collectives over axes ORTHOGONAL to the
+    stage axis (the cond predicate is uniform along them): a
+    column-parallel head + vocab-parallel CE inside the hook must give
+    exactly the replicated full-vocab head's loss and gradients — with
+    the full [mb, L, VOCAB] logits never materializing on any device."""
+    from chainermn_tpu.parallel.tensor_parallel import (
+        copy_to_tp_region,
+        vocab_parallel_cross_entropy,
+    )
+
+    mesh, block, stage_p, head_p, stage_fn, head_loss = _setup(V=1)
+    xs, ys = _data(seed=5)
+    spec = P(None, "stage", "model")
+    W = head_p["w"]                   # full [D, VOCAB]
+    VS = VOCAB // T
+
+    def head_loss_vp(hp, out, tgt):
+        # Megatron f-operator: identity fwd, psum('model') bwd — without
+        # it each shard's d(loss)/d(out) is only ITS vocab slice's term
+        out = copy_to_tp_region(out, "model")
+        logits_shard = out @ hp["w"]  # [mb, L, VOCAB/T]
+        return jnp.mean(
+            vocab_parallel_cross_entropy(logits_shard, tgt, "model"))
+
+    def pipe(sp, xs_, ys_, mode):
+        sp = jax.tree_util.tree_map(
+            lambda q: q[0].squeeze(1).squeeze(0), sp)
+        if mode == "vp":
+            t = jax.lax.axis_index("model")
+            hp = {"w": jax.lax.dynamic_slice_in_dim(W, t * VS, VS, 1)}
+        else:
+            hp = {"w": W}
+        loss, g, aux = pipeline_1f1b_value_and_grad(
+            stage_fn, head_loss_vp if mode == "vp" else head_loss,
+            sp, xs_, ys_, "stage", head_params=hp,
+            return_input_grads=True)
+        hg = aux["head_grads"]["w"]   # varying on 'model' in both modes
+        loss = jax.lax.pmean(loss, "model")
+        dxs = jax.lax.pmean(aux["input_grads"], "model")
+        # expose head grads stacked over 'model' for comparison
+        return loss, hg[None], dxs
+
+    outs = {}
+    for mode in ("repl", "vp"):
+        f = jax.jit(shard_map(
+            lambda sp, xs_, ys_, m=mode: pipe(sp, xs_, ys_, m),
+            mesh=mesh, in_specs=(spec, P(), P()),
+            out_specs=(P(), P("model"), P())))
+        outs[mode] = f(stage_p, xs, ys)
+
+    np.testing.assert_allclose(float(outs["vp"][0]),
+                               float(outs["repl"][0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(outs["vp"][2]),
+                               np.asarray(outs["repl"][2]),
+                               rtol=1e-5, atol=1e-7)
+    # head grads: vp returns each shard's slice; replicated returns the
+    # full [D, VOCAB] twice — compare slice-wise
+    full = np.asarray(outs["repl"][1])[0]            # [D, VOCAB]
+    vp = np.asarray(outs["vp"][1])                   # [T, D, VOCAB/T]
+    for t in range(T):
+        np.testing.assert_allclose(vp[t], full[:, t * VS:(t + 1) * VS],
+                                   rtol=1e-5, atol=1e-7)
+
+
 def test_input_grads_equal_along_model():
     # the f-operator makes stage-0 input cotangents FULL on every model
     # shard; values must agree across 'model' before the pmean
