@@ -529,7 +529,7 @@ class XlaCommunicator(CommunicatorBase):
             lambda l: jax.device_put(jnp.asarray(l), sharding), x
         )
 
-    def send(self, x, dest: int, tag: int = 0):
+    def send(self, x, dest: int, tag: int = 0, as_rank: int = None):
         """Eager point-to-point send of concrete arrays.
 
         Reference (mpi_communicator_base.py): mid-script blocking
@@ -539,11 +539,13 @@ class XlaCommunicator(CommunicatorBase):
         chunked object plane → peer process, so reference-shaped eager
         scripts run unchanged.
 
-        Eager P2P is PROCESS-level (the reference's rank IS a process —
-        one MPI rank per GPU): ``dest``/``src`` must be the canonical
-        (first) rank of their process. Finer-than-process addressing would
-        need per-device inboxes that a host plane cannot order; targeting
-        a non-canonical rank of a multi-device process raises.
+        Eager P2P addresses ANY rank (the reference's rank is a process —
+        one MPI rank per GPU; here a process may host several ranks).
+        The object-plane channel is qualified by BOTH endpoint ranks, so
+        messages to co-located ranks of one process ride separate ordered
+        channels and never interleave. A multi-device process sends as
+        its canonical (first) rank by default — ``as_rank`` sends as one
+        of its other local ranks, mirroring ``recv(..., as_rank=...)``.
         """
         if _is_tracer(x):
             raise RuntimeError(
@@ -551,6 +553,7 @@ class XlaCommunicator(CommunicatorBase):
                 "(shard_map) program point-to-point transfers are compiled "
                 "collective-permutes — use chainermn_tpu.functions.send/recv"
             )
+        src_rank = self.rank if as_rank is None else as_rank
         dest_proc = self._rank_process(dest)
         if dest_proc == jax.process_index():
             raise ValueError(
@@ -558,12 +561,17 @@ class XlaCommunicator(CommunicatorBase):
                 "same-process shards exchange data inside the compiled "
                 "program (chainermn_tpu.functions.send/recv)"
             )
+        if self._rank_process(src_rank) != jax.process_index():
+            raise ValueError(
+                f"as_rank {src_rank} is not a local rank of this process")
         payload = jax.tree_util.tree_map(np.asarray, x)  # device_get
-        self._obj.send_obj(payload, dest_proc, tag)
+        self._obj.send_obj(payload, dest_proc,
+                           self._p2p_tag(tag, src_rank, dest))
 
-    def recv(self, src: int, tag: int = 0):
+    def recv(self, src: int, tag: int = 0, as_rank: int = None):
         """Eager point-to-point receive (see :meth:`send`); returns
-        device-committed arrays."""
+        device-committed arrays. ``as_rank``: receive on behalf of a
+        specific local rank of this process (default: canonical)."""
         src_proc = self._rank_process(src)
         if src_proc == jax.process_index():
             raise ValueError(
@@ -571,29 +579,29 @@ class XlaCommunicator(CommunicatorBase):
                 "same-process shards exchange data inside the compiled "
                 "program (chainermn_tpu.functions.send/recv)"
             )
-        obj = self._obj.recv_obj(src_proc, tag)
+        me = self.rank if as_rank is None else as_rank
+        if self._rank_process(me) != jax.process_index():
+            raise ValueError(
+                f"as_rank {me} is not a local rank of this process")
+        obj = self._obj.recv_obj(src_proc, self._p2p_tag(tag, src, me))
         return jax.tree_util.tree_map(
             lambda l: jnp.asarray(l) if isinstance(l, np.ndarray) else l,
             obj,
         )
 
+    @staticmethod
+    def _p2p_tag(tag, src_rank: int, dest_rank: int) -> str:
+        """One ordered channel per (tag, src RANK, dest RANK) — finer
+        than the object plane's per-process channels, so co-located
+        ranks' messages cannot interleave."""
+        return f"{tag}.r{int(src_rank)}.{int(dest_rank)}"
+
     def _rank_process(self, rank: int) -> int:
-        """Owning process of the given rank; eager P2P requires the rank to
-        be its process's canonical (first) rank — see :meth:`send`."""
+        """Owning process of the given rank."""
         if not 0 <= rank < self._size:
             raise ValueError(f"rank {rank} out of range [0, {self._size})")
         procs = [int(d.process_index) for d in self._comm_devices()]
-        proc = procs[rank]
-        first = procs.index(proc)
-        if first != rank:
-            raise ValueError(
-                f"eager P2P rank {rank} is not its process's canonical "
-                f"rank ({first}): the host object plane addresses "
-                "processes, and messages to co-located ranks would share "
-                "one ordered channel — address rank "
-                f"{first} (process {proc}) instead"
-            )
-        return proc
+        return procs[rank]
 
     def _replicate(self, x):
         repl = NamedSharding(self._mesh, P())

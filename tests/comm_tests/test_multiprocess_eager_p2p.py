@@ -195,3 +195,58 @@ print(f"WORKER{proc_id} OK", flush=True)
 def test_two_process_host_staged_allreduce(tmp_path):
     procs, outs = run_workers(_NCA_WORKER, tmp_path, timeout=140)
     assert_all_ok(procs, outs)
+
+
+_NONCANON_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+import chainermn_tpu
+
+comm = chainermn_tpu.create_communicator("xla")
+assert comm.size == 8 and comm.inter_size == 2
+# proc 0 hosts ranks 0-3 (canonical 0), proc 1 hosts 4-7 (canonical 4)
+if proc_id == 0:
+    assert comm.rank == 0
+    # address two NON-CANONICAL ranks of the peer with the SAME tag:
+    # separate per-rank-pair channels must never interleave
+    comm.send(np.float32(60.0), dest=6, tag=3)
+    comm.send(np.float32(50.0), dest=5, tag=3)
+    # and send AS a non-canonical local rank
+    comm.send(np.float32(20.0), dest=4, tag=4, as_rank=2)
+    back = comm.recv(src=7, tag=9, as_rank=1)
+    assert float(back) == 77.0, back
+else:
+    assert comm.rank == 4
+    five = comm.recv(src=0, tag=3, as_rank=5)
+    six = comm.recv(src=0, tag=3, as_rank=6)
+    assert float(five) == 50.0 and float(six) == 60.0, (five, six)
+    as2 = comm.recv(src=2, tag=4)
+    assert float(as2) == 20.0, as2
+    comm.send(np.float32(77.0), dest=1, tag=9, as_rank=7)
+    # a rank this process does not host is rejected
+    try:
+        comm.recv(src=0, tag=0, as_rank=2)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("foreign as_rank should raise")
+
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+
+def test_two_process_noncanonical_rank_p2p(tmp_path):
+    procs, outs = run_workers(
+        _NONCANON_WORKER, tmp_path, timeout=140,
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    assert_all_ok(procs, outs)

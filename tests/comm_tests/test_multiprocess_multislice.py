@@ -80,13 +80,14 @@ comm.send(payload, dest=peer, tag=1)
 got = comm.recv(src=peer, tag=1)
 np.testing.assert_allclose(np.asarray(got),
                            np.full((3, 3), float(2 - proc_id)))
-# non-canonical rank targets are rejected (they share the process channel)
-try:
-    comm.send(payload, dest=5 if proc_id == 0 else 1)
-except ValueError:
-    pass
-else:
-    raise AssertionError("non-canonical rank send should raise")
+# non-canonical rank targets ride their own (tag, src, dest) channel
+# (round-3 upgrade; the dedicated matrix lives in
+# test_multiprocess_eager_p2p.py::test_two_process_noncanonical_rank_p2p)
+nc = 5 if proc_id == 0 else 1
+comm.send(payload * 3.0, dest=nc, tag=2)
+got_nc = comm.recv(src=peer, tag=2, as_rank=me + 1)
+np.testing.assert_allclose(np.asarray(got_nc),
+                           np.full((3, 3), 3.0 * float(2 - proc_id)))
 
 # ---- 3. payload scatter across the slices ------------------------------
 from chainermn_tpu.datasets import ListDataset, scatter_dataset
