@@ -43,7 +43,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 import optax
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.models.transformer import TransformerBlock
 from chainermn_tpu.parallel import (
@@ -68,11 +68,25 @@ class EmbedIn(nn.Module):
 
 
 class HeadOut(nn.Module):
+    """LM head. ONE architecture definition for both the replicated and
+    tensor-parallel paths: under TP, instantiate with ``vocab`` = the
+    LOCAL vocab slice (full_vocab // T) and ``tp_axis`` set — the kernel
+    arrives column-sharded via in_specs (init the FULL kernel with a
+    plain ``HeadOut(full_vocab)``; the param trees match), and the
+    Megatron f-operator at the column-parallel entry makes LayerNorm
+    grads and the input cotangent full per shard."""
+
     vocab: int
+    tp_axis: str = None
 
     @nn.compact
     def __call__(self, h):
         h = nn.LayerNorm()(h)
+        if self.tp_axis is not None:
+            from chainermn_tpu.parallel.tensor_parallel import (
+                copy_to_tp_region)
+
+            h = copy_to_tp_region(h, self.tp_axis)
         return nn.Dense(self.vocab, use_bias=False, name="out")(h)
 
 
@@ -109,7 +123,6 @@ def main_hetero(args):
     over the stage axis, so a single optax.adam over that array IS the
     whole-model optimizer, with each device updating only its stage's row.
     """
-    from jax.sharding import NamedSharding
 
     S = args.n_pipeline or jax.device_count()
     n_blocks = S - 2
@@ -216,6 +229,8 @@ def main():
                          f"{jax.device_count()}")
     if T > 1 and args.n_heads % T:
         raise SystemExit(f"--tp {T} must divide --n-heads {args.n_heads}")
+    if T > 1 and args.vocab % T:
+        raise SystemExit(f"--tp {T} must divide --vocab {args.vocab}")
     mesh = Mesh(np.array(jax.devices()[:S * T]).reshape(S, T),
                 ("stage", "model"))
     print(f"pipeline: {S} stage devices x {V} chunks = {N} blocks"
@@ -226,7 +241,8 @@ def main():
         d_model=args.d_model, n_heads=args.n_heads, d_ff=args.d_ff,
         attention=args.attention, tp_axis="model" if T > 1 else None)
     embed = EmbedIn(args.vocab, args.d_model, args.seq_len)
-    head = HeadOut(args.vocab)
+    head = HeadOut(args.vocab // T if T > 1 else args.vocab,
+                   tp_axis="model" if T > 1 else None)
 
     rng = jax.random.PRNGKey(0)
     toks0 = np.zeros((args.mb_size, args.seq_len), np.int32)
@@ -258,16 +274,40 @@ def main():
             for k in range(N)])
         stage_p = jax.tree_util.tree_map(
             lambda q: q.reshape((V, S) + q.shape[1:]), stage_p)
-    head_p = head.init(jax.random.fold_in(rng, 999), h0)["params"]
+    # init the FULL kernel (same param tree as the TP apply-instance)
+    head_p = HeadOut(args.vocab).init(
+        jax.random.fold_in(rng, 999), h0)["params"]
+    if T > 1:
+        # VOCAB-PARALLEL head: LayerNorm replicated, Dense kernel
+        # column-sharded over 'model' — the full [mb, L, vocab] logits
+        # never materialize; the loss hook admits the psums because the
+        # cond predicate is uniform along 'model' (see
+        # parallel/pipeline.py:_head_loss_grads). shard_map specs are
+        # tree prefixes: one P() covers the LayerNorm subtree.
+        hspec = {"LayerNorm_0": P(), "out": {"kernel": P(None, "model")}}
+        head_p = {
+            "LayerNorm_0": jax.device_put(
+                head_p["LayerNorm_0"], NamedSharding(mesh, P())),
+            "out": {"kernel": jax.device_put(
+                head_p["out"]["kernel"],
+                NamedSharding(mesh, P(None, "model")))},
+        }
+    else:
+        hspec = P()
     params = (emb_p, stage_p, head_p)
     opt = optax.adam(args.lr)
     opt_state = opt.init(params)
 
     def head_loss(hp, out, tgt):
-        # full-vocab head, REPLICATED over 'model' (collective-free, the
-        # loss hook's contract); each model duplicate computes the same
-        # loss on the model-invariant pipeline output
+        # ONE architecture: HeadOut applies the sharded kernel as-is
+        # (logits come back [mb, L, vocab/T] under TP)
         logits = head.apply({"params": hp}, out)
+        if T > 1:
+            from chainermn_tpu.parallel.tensor_parallel import (
+                vocab_parallel_cross_entropy)
+
+            return jnp.mean(
+                vocab_parallel_cross_entropy(logits, tgt, "model"))
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, tgt).mean()
 
@@ -288,12 +328,14 @@ def main():
             head_params=hp, return_input_grads=True)
         hg, dxs = aux["head_grads"], aux["input_grads"]
         if T > 1:
-            # equal along 'model' by construction (the f-operator psums
-            # input grads; every model duplicate runs the same head);
-            # pmean resolves their vma to invariant for out_specs P()
+            # loss/input-grads/LN-grads are equal along 'model' (the
+            # f-operator psums cotangents; vocab-parallel CE psums the
+            # loss terms); pmean resolves their vma to invariant. The
+            # head KERNEL grads are genuinely sharded — left varying.
             loss = jax.lax.pmean(loss, "model")
-            hg = jax.tree_util.tree_map(
-                lambda q: jax.lax.pmean(q, "model"), hg)
+            hg = {"LayerNorm_0": jax.tree_util.tree_map(
+                lambda q: jax.lax.pmean(q, "model"), hg["LayerNorm_0"]),
+                "out": hg["out"]}
             dxs = jax.lax.pmean(dxs, "model")
         for _ in range(n_lead):
             g = jax.tree_util.tree_map(lambda q: q[:, None], g)
@@ -301,8 +343,8 @@ def main():
 
     pipe_sm = shard_map(
         pipe, mesh=mesh,
-        in_specs=(stage_spec, P(), P(), P()),
-        out_specs=(P(), stage_spec, P(), P()))
+        in_specs=(stage_spec, hspec, P(), P()),
+        out_specs=(P(), stage_spec, hspec, P()))
 
     @jax.jit
     def train_step(params, opt_state, toks, tgts):
