@@ -278,6 +278,10 @@ class TransformerLM(nn.Module):
     remat: bool = False                # rematerialize each block's
     #                                    activations in backward (trade
     #                                    FLOPs for HBM at long L)
+    return_hidden: bool = False        # skip the head: return the final
+    #                                    post-LN hidden states (the fused
+    #                                    head+CE loss applies lm_head
+    #                                    itself — ops/fused_ce.py)
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
@@ -310,6 +314,8 @@ class TransformerLM(nn.Module):
                 decode=self.decode, max_len=self.max_len,
                 name=f"block_{i}")(x, pos_offset=pos_offset)
         x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.return_hidden:
+            return x
         if self.lm_head_tp:
             if self.tp_axis is None:
                 raise ValueError("lm_head_tp requires tp_axis")

@@ -1,9 +1,12 @@
 from chainermn_tpu.ops.autotune import tune_flash_blocks
 from chainermn_tpu.ops.flash_attention import flash_attention
+from chainermn_tpu.ops.fused_ce import fused_ce_head, fused_lm_loss
 from chainermn_tpu.ops.rotary import apply_rope, rope_angles
 
 __all__ = [
     "flash_attention",
+    "fused_ce_head",
+    "fused_lm_loss",
     "tune_flash_blocks",
     "apply_rope",
     "rope_angles",
