@@ -272,3 +272,105 @@ def test_partial_replication_dedups_shards(comm, tmp_path):
     assert restored["w"].sharding == sh
     # every replica device got its copy back
     assert len(restored["w"].addressable_shards) == 8
+
+
+_SAVE_ONLY_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+import chainermn_tpu
+
+comm = chainermn_tpu.create_communicator("xla")
+G = 64
+sh = NamedSharding(comm.mesh, P(("dcn", "ici")))
+full = np.arange(G, dtype=np.float32) * 1.5   # keep in sync with the
+local = full[proc_id * (G // 2):(proc_id + 1) * (G // 2)]  # main test
+state = {"w": jax.make_array_from_process_local_data(sh, local),
+         "b": jax.device_put(np.ones((3,), np.float32),
+                             NamedSharding(comm.mesh, P()))}
+out = os.path.join(os.environ["SANDBOX"], "ckpt")
+ck = chainermn_tpu.create_multi_node_checkpointer("x2p", comm, path=out)
+ck.save(state, iteration=9)
+ck.flush()
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(150)
+def test_two_process_save_single_process_reshard(tmp_path):
+    """The headline cross-process resharding: a 2-process run writes two
+    per-rank snapshot files; a SINGLE-process run over 8 devices restores
+    them — the restoring run's inter_size gives no hint that file .1
+    exists (peer files are discovered by glob), and neither file alone
+    covers the 8-way template shards."""
+    procs, outs = run_workers(
+        _SAVE_ONLY_WORKER, tmp_path, timeout=140,
+        env_extra={"SANDBOX": str(tmp_path)})
+    assert_all_ok(procs, outs)
+
+    G = 64
+    full = np.arange(G, dtype=np.float32) * 1.5
+    comm = chainermn_tpu.create_communicator("xla")  # 1 process, 8 devs
+    sh = NamedSharding(comm.mesh, P(comm.axis_names[0]))
+    template = {"w": jax.device_put(jnp.zeros((G,), jnp.float32), sh),
+                "b": jnp.ones((3,), jnp.float32)}
+    ck = chainermn_tpu.create_multi_node_checkpointer(
+        "x2p", comm, path=str(tmp_path / "ckpt"))
+    restored, it = ck.maybe_load(template)
+    assert it == 9
+    np.testing.assert_array_equal(np.asarray(restored["w"]), full)
+    assert len(restored["w"].sharding.device_set) == comm.size
+
+
+def test_zero1_flat_state_reshards_8_to_4(comm, tmp_path):
+    """ZeRO-1's flat [padded] vector (pad quantum device-count
+    independent) saved on 8 devices restores onto 4 — optimizer m/v
+    shards splice along with the params."""
+    from jax.sharding import Mesh
+    from chainermn_tpu.comm.xla import XlaCommunicator
+    from chainermn_tpu.optimizers import make_zero1_train_step
+
+    if comm.size < 8:
+        pytest.skip("needs 8 devices")
+    model = MLP(n_units=16, n_out=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    step8, state8 = make_zero1_train_step(
+        model, optax.adam(1e-3), comm, params, donate=False)
+    dsh = NamedSharding(comm.mesh, P(comm.axis_names[0]))
+    x = jax.device_put(np.random.RandomState(0).rand(16, 28, 28)
+                       .astype(np.float32), dsh)
+    y = jax.device_put(np.random.RandomState(1).randint(
+        0, 4, size=16).astype(np.int32), dsh)
+    state8, _ = step8(state8, x, y)
+    ck = chainermn_tpu.create_multi_node_checkpointer(
+        "zero1rs", comm, path=str(tmp_path))
+    ck.save(state8, iteration=4)
+
+    comm4 = XlaCommunicator(
+        mesh=Mesh(np.asarray(jax.devices()[:4]), ("z4",)))
+    step4, template4 = make_zero1_train_step(
+        model, optax.adam(1e-3), comm4, params, donate=False)
+    ck4 = chainermn_tpu.create_multi_node_checkpointer(
+        "zero1rs", comm4, path=str(tmp_path))
+    restored, it = ck4.maybe_load(
+        jax.tree_util.tree_map(jnp.zeros_like, template4))
+    assert it == 4
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), restored, state8)
+    dsh4 = NamedSharding(comm4.mesh, P("z4"))
+    x4 = jax.device_put(np.asarray(x)[:8], dsh4)
+    y4 = jax.device_put(np.asarray(y)[:8], dsh4)
+    _, m = step4(restored, x4, y4)
+    assert np.isfinite(float(m["main/loss"]))
