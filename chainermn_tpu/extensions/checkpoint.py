@@ -183,7 +183,8 @@ class _PeerSnapshots:
         self._files = sorted(
             fn for fn in _glob.glob(os.path.join(
                 path, f"snapshot_iter_{it}.*"))
-            if not fn.endswith(f".{inter_rank}"))
+            if not fn.endswith(f".{inter_rank}")
+            and not os.path.isdir(fn))  # orbax snapshots are directories
         self._open: dict = {}
 
     def __iter__(self):
@@ -390,6 +391,11 @@ class MultiNodeCheckpointer:
                 self._gc()
             return fn
         arrays, treedef = _flatten_state(state)
+        # saving-run world size: the completeness election checks the
+        # file set against THIS, so a snapshot stays electable after the
+        # process count changes (scale-up/down resharding) while a crash
+        # that lost one rank's file still reads as incomplete
+        arrays["__world__"] = np.int64(self.comm.inter_size)
         if self.async_write:
             self._ensure_writer()
             self._queue.put((arrays, fn))
@@ -459,11 +465,65 @@ class MultiNodeCheckpointer:
 
     # -- resume ---------------------------------------------------------
 
+    def _complete_iters_on_disk(self) -> List[int]:
+        """Iterations whose snapshot FILE SET is complete as seen from
+        this filesystem: all ranks of the SAVING run's world (recorded
+        in each file as ``__world__``) are present — which need not
+        match the restoring run's process count (scale-up/scale-down
+        resharding). Snapshots without the marker (orbax directories,
+        pre-marker files) fall back to rank-suffix contiguity."""
+        by_iter: dict = {}
+        if os.path.isdir(self.path):
+            for f in os.listdir(self.path):
+                m = re.match(r"snapshot_iter_(\d+)\.(\d+)$", f)
+                # regular files only: orbax snapshots are DIRECTORIES a
+                # peer process cannot np.load, so scale-up (which loads
+                # every leaf from peer files) stays npz-territory — an
+                # orbax new-rank simply never elects, gracefully
+                if m and not os.path.isdir(os.path.join(self.path, f)):
+                    by_iter.setdefault(int(m.group(1)), set()).add(
+                        int(m.group(2)))
+        out = []
+        for it, ranks in by_iter.items():
+            world = self._saved_world(it)
+            need = (set(range(world)) if world
+                    else set(range(max(ranks) + 1)))
+            if need <= ranks:
+                out.append(it)
+        return sorted(out)
+
+    def _saved_world(self, it: int) -> Optional[int]:
+        """The saving run's process count, from any file of iteration
+        ``it`` (None when unknowable: orbax directory or no marker)."""
+        fn = os.path.join(self.path, f"snapshot_iter_{it}.0")
+        if not os.path.exists(fn) or os.path.isdir(fn):
+            return None
+        try:
+            with np.load(fn, allow_pickle=False) as z:
+                if "__world__" in z.files:
+                    return int(z["__world__"])
+        except Exception:  # noqa: BLE001 — unreadable file = unknown
+            return None
+        return None
+
     def latest_common_iteration(self) -> Optional[int]:
-        """Consensus election: newest iteration present on ALL processes
-        (reference: allgather of per-rank snapshot inventories)."""
+        """Consensus election (reference: allgather of per-rank snapshot
+        inventories, intersected). Each process's view is the UNION of
+        its OWN files (works on non-shared filesystems, exactly the
+        reference semantics) and the complete smaller-world snapshots it
+        can see (the scale-up path: a rank new since the save has no own
+        file but, on a shared filesystem, sees the saved ranks' complete
+        set). The intersection still rejects snapshots any current
+        OLD rank is missing."""
         self._drain()
-        mine = self._iters_on_disk()
+        # the complete-set view covers peers' files, so a peer's
+        # in-flight save is a race the own-file view never had: barrier
+        # first — every process enters the election only after its own
+        # saves returned, so post-barrier listings see them all
+        if self.comm.inter_size > 1 and hasattr(self.comm, "barrier"):
+            self.comm.barrier()
+        mine = sorted(set(self._iters_on_disk())
+                      | set(self._complete_iters_on_disk()))
         all_lists = self.comm.allgather_obj(mine)
         common = set(all_lists[0])
         for lst in all_lists[1:]:
@@ -476,10 +536,11 @@ class MultiNodeCheckpointer:
         nothing restorable exists.
 
         Resharding: a different device MESH restores fine (splicing, see
-        ``_load_sharded_leaf``), including onto FEWER processes (peer
-        files are discovered by glob). Restoring onto MORE processes than
-        saved is not supported — the new ranks have no own snapshot file,
-        so ``latest_common_iteration`` won't see a complete set."""
+        ``_load_sharded_leaf``), onto FEWER processes (peer files are
+        discovered by glob) and onto MORE (a rank with no own snapshot
+        file loads every leaf from the peers' files). Cross-process
+        resharding is npz-backend territory; orbax snapshots reshard
+        within one process's file set."""
         self._drain()
         it = iteration if iteration is not None else self.latest_common_iteration()
         if it is None:
@@ -488,10 +549,25 @@ class MultiNodeCheckpointer:
             self.path, f"snapshot_iter_{it}.{self.comm.inter_rank}"
         )
         if self.backend == "orbax":
+            if not os.path.exists(fn):
+                raise FileNotFoundError(
+                    f"{fn}: no orbax snapshot for this rank — restoring "
+                    "onto more processes than saved is npz-backend only")
             loaded = self._orbax_ck().restore(
                 os.path.abspath(fn), _leaf_dict(state))
-        else:
+        elif os.path.exists(fn):
             loaded = np.load(fn, allow_pickle=False)
+        else:
+            # scale-up: this rank did not exist in the saving run — every
+            # leaf comes from the peers' files. Only COMPLETE snapshots
+            # qualify: a file set short of its saved world means a rank's
+            # file is missing, not a smaller saving run, and loading a
+            # peer's copy would silently hand this rank wrong state.
+            if it not in self._complete_iters_on_disk():
+                raise FileNotFoundError(
+                    f"{fn}: no snapshot file for this rank and iteration "
+                    f"{it} is not a complete smaller-world snapshot")
+            loaded = {}
         leaves, treedef = jax.tree_util.tree_flatten(state)
         keys = set(getattr(loaded, "files", loaded))
         new_leaves = []
@@ -502,11 +578,27 @@ class MultiNodeCheckpointer:
                 if f"leaf_{i}_nshards" in keys:
                     new_leaves.append(
                         self._load_sharded_leaf(loaded, i, ref, peers))
-                    continue
-                new_leaves.append(self._plain_leaf(loaded, i, ref))
+                elif f"leaf_{i}" in keys:
+                    new_leaves.append(self._plain_leaf(loaded, i, ref))
+                else:
+                    new_leaves.append(
+                        self._leaf_from_peers(i, ref, peers, it))
         finally:
             peers.close()
         return jax.tree_util.tree_unflatten(treedef, new_leaves), it
+
+    def _leaf_from_peers(self, i: int, ref, peers, it: int):
+        """Load leaf ``i`` when this process's own snapshot file lacks it
+        (a rank that did not exist in the saving run)."""
+        for z in peers:
+            zk = set(getattr(z, "files", z))
+            if f"leaf_{i}_nshards" in zk:
+                return self._load_sharded_leaf(z, i, ref, peers)
+            if f"leaf_{i}" in zk:
+                return self._plain_leaf(z, i, ref)
+        raise ValueError(
+            f"snapshot iteration {it}: leaf {i} appears in no snapshot "
+            "file — incomplete snapshot set")
 
     @staticmethod
     def _plain_leaf(loaded, i: int, ref):

@@ -408,3 +408,85 @@ def test_orbax_backend_resharding_8_to_4(comm, tmp_path):
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b)), restored, state8)
+
+
+_SCALEUP_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=3,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+import chainermn_tpu
+
+comm = chainermn_tpu.create_communicator("xla")
+G = 60
+full = np.arange(G, dtype=np.float32) * 1.5  # matches the writer fixture
+sh = NamedSharding(comm.mesh, P(("dcn", "ici")))
+local = full[proc_id * (G // 3):(proc_id + 1) * (G // 3)]
+out = os.path.join(os.environ["SANDBOX"], "ckpt")
+ck = chainermn_tpu.create_multi_node_checkpointer("x2p3", comm, path=out)
+template = {"w": jax.make_array_from_process_local_data(
+    sh, np.zeros_like(local)),
+    "b": jax.device_put(np.zeros((3,), np.float32),
+                        NamedSharding(comm.mesh, P()))}
+restored, it = ck.maybe_load(template)
+assert it == 11, it
+np.testing.assert_array_equal(
+    np.asarray(restored["w"].addressable_shards[0].data), local)
+np.testing.assert_array_equal(np.asarray(restored["b"]), np.ones(3))
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+_SCALEUP_SAVER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+import chainermn_tpu
+
+comm = chainermn_tpu.create_communicator("xla")
+G = 60
+full = np.arange(G, dtype=np.float32) * 1.5
+sh = NamedSharding(comm.mesh, P(("dcn", "ici")))
+local = full[proc_id * (G // 2):(proc_id + 1) * (G // 2)]
+state = {"w": jax.make_array_from_process_local_data(sh, local),
+         "b": jax.device_put(np.ones((3,), np.float32),
+                             NamedSharding(comm.mesh, P()))}
+out = os.path.join(os.environ["SANDBOX"], "ckpt")
+ck = chainermn_tpu.create_multi_node_checkpointer("x2p3", comm, path=out)
+ck.save(state, iteration=11)
+ck.flush()
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(300)
+def test_scale_up_2_to_3_processes(tmp_path):
+    """Restoring onto MORE processes than saved: process 2 has no own
+    snapshot file — the glob-based completeness election still elects
+    iteration 11 and every leaf loads from the peers' files."""
+    procs, outs = run_workers(
+        _SCALEUP_SAVER, tmp_path, timeout=140,
+        env_extra={"SANDBOX": str(tmp_path)})
+    assert_all_ok(procs, outs)
+    procs, outs = run_workers(
+        _SCALEUP_WORKER, tmp_path, n=3, timeout=140,
+        env_extra={"SANDBOX": str(tmp_path)})
+    assert_all_ok(procs, outs)
