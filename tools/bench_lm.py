@@ -5,7 +5,9 @@ Secondary benchmark (the driver's recorded metric is bench.py's ResNet-50):
 a GPT-small-ish causal LM on the flash-attention path, bf16 compute,
 data-parallel step factory. Prints one JSON line per config.
 
-Usage: python tools/bench_lm.py [d_model n_layers seq_len batch]
+Usage: python tools/bench_lm.py [d_model n_layers seq_len batch [loss]]
+  loss: 'unfused' (default) or 'fused' — the fused head+CE Pallas kernel
+  (ops/fused_ce.py; measured throughput-neutral, −2 GB logits memory)
 """
 
 import json
@@ -32,6 +34,7 @@ def main():
     n_layers = int(sys.argv[2]) if len(sys.argv) > 2 else 12
     seq_len = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
     batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    loss_kind = sys.argv[5] if len(sys.argv) > 5 else "unfused"
 
     comm = chainermn_tpu.create_communicator("xla")
     model = TransformerLM(
@@ -49,8 +52,14 @@ def main():
     # dispatch round-trip (same methodology as bench.py; the token stack
     # reuses ONE device batch K times to avoid the ~10 MB/s tunnel)
     scan_k = 4
+    if loss_kind == "fused":
+        from chainermn_tpu.ops import fused_lm_loss
+
+        lf = lambda m, p, x, y, **kw: fused_lm_loss(m, p, x, y)
+    else:
+        lf = lm_loss_with_aux
     step = make_data_parallel_train_step(
-        model, opt, comm, loss_fn=lm_loss_with_aux, scan_steps=scan_k)
+        model, opt, comm, loss_fn=lf, scan_steps=scan_k)
     state = (params, opt.init(params))
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -83,7 +92,8 @@ def main():
         "unit": "tokens/sec/chip",
         "config": {"d_model": d_model, "n_layers": n_layers,
                    "seq_len": seq_len, "batch_per_chip": batch,
-                   "params_m": round(n_params / 1e6, 1)},
+                   "params_m": round(n_params / 1e6, 1),
+                   "loss": loss_kind},
     }))
 
 
