@@ -35,6 +35,9 @@ def main():
     seq_len = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
     batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
     loss_kind = sys.argv[5] if len(sys.argv) > 5 else "unfused"
+    if loss_kind not in ("unfused", "fused"):
+        raise SystemExit(f"loss must be 'unfused' or 'fused', got "
+                         f"{loss_kind!r}")
 
     comm = chainermn_tpu.create_communicator("xla")
     model = TransformerLM(
@@ -55,7 +58,7 @@ def main():
     if loss_kind == "fused":
         from chainermn_tpu.ops import fused_lm_loss
 
-        lf = lambda m, p, x, y, **kw: fused_lm_loss(m, p, x, y)
+        lf = fused_lm_loss
     else:
         lf = lm_loss_with_aux
     step = make_data_parallel_train_step(
