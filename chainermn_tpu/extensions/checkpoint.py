@@ -630,17 +630,43 @@ class MultiNodeCheckpointer:
         cover a needed range."""
         n = int(loaded[f"leaf_{i}_nshards"])
         gshape = tuple(int(d) for d in loaded[f"leaf_{i}_gshape"])
-        if not _is_device_sharded(ref):
+        if not hasattr(ref, "dtype") or not hasattr(ref, "shape"):
             raise ValueError(
                 f"snapshot leaf {i} was saved device-sharded ({n} shards, "
-                f"global shape {gshape}) but the template leaf is not a "
-                "sharded jax.Array — restore with a state whose leaf is "
-                "device-sharded (any mesh; resharding is supported)")
+                f"global shape {gshape}) but the template leaf is not an "
+                "array")
         if tuple(ref.shape) != gshape:
             raise ValueError(
                 f"snapshot leaf {i}: saved global shape {gshape}, "
                 f"template is {tuple(ref.shape)} — different model, not "
                 "a resharding")
+
+        def splice(targets):
+            sp = _SpliceTargets(targets, gshape, np.dtype(ref.dtype))
+            sp.consume(loaded, i)
+            if not sp.complete:
+                for z in peers:  # lazy: opened only when actually needed
+                    sp.consume(z, i)
+                    if sp.complete:
+                        break
+            sp.require_complete(i)
+            return sp.bufs
+
+        if not _is_device_sharded(ref):
+            # REPLICATED template: the caller asks for the whole leaf on
+            # every device, so assembling the global range on host is the
+            # requested behavior, not a contract breach (sharded→
+            # replicated resharding)
+            import types
+
+            full = types.SimpleNamespace(
+                index=tuple(slice(0, d) for d in gshape))
+            (buf,) = splice([full])
+            if (hasattr(ref, "sharding")
+                    and getattr(ref, "committed", False)):
+                return jax.device_put(buf, ref.sharding)
+            return jnp.asarray(buf, ref.dtype)
+
         # index-keyed lookup: replica shards (deduplicated at save) fan the
         # one saved copy back out to every device holding that index. Only
         # the SMALL idx arrays are read here — shard data stays lazy so
@@ -659,16 +685,8 @@ class MultiNodeCheckpointer:
                 for r in refs
             ]
         else:
-            sp = _SpliceTargets(refs, gshape, np.dtype(ref.dtype))
-            sp.consume(loaded, i)
-            if not sp.complete:
-                for z in peers:  # lazy: opened only when actually needed
-                    sp.consume(z, i)
-                    if sp.complete:
-                        break
-            sp.require_complete(i)
             singles = [jax.device_put(buf, r.device)
-                       for buf, r in zip(sp.bufs, refs)]
+                       for buf, r in zip(splice(refs), refs)]
         return jax.make_array_from_single_device_arrays(
             gshape, ref.sharding, singles)
 
