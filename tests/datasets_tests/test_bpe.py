@@ -82,3 +82,29 @@ def test_encoded_corpus_is_array_ready():
 
 
 pytestmark = pytest.mark.quick
+
+
+def test_roundtrip_fuzz_random_unicode():
+    """Property: byte-level BPE round-trips ANY text exactly, merges or
+    not — random unicode from several planes, random whitespace."""
+    import random
+
+    tok = train_bpe(CORPUS, vocab_size=330)
+    rng = random.Random(0)
+    pools = [
+        (0x20, 0x7E),      # ascii
+        (0xA0, 0x2FF),     # latin supplement
+        (0x400, 0x4FF),    # cyrillic
+        (0x4E00, 0x4FFF),  # CJK
+        (0x1F300, 0x1F5FF),  # emoji
+    ]
+    for _ in range(50):
+        n = rng.randint(0, 40)
+        chars = []
+        for _ in range(n):
+            lo, hi = pools[rng.randrange(len(pools))]
+            chars.append(chr(rng.randint(lo, hi)))
+            if rng.random() < 0.2:
+                chars.append(rng.choice(" \t\n"))
+        text = "".join(chars)
+        assert tok.decode(tok.encode(text)) == text, repr(text)

@@ -71,7 +71,8 @@ def _data(m, seed=1):
     return xs, ys
 
 
-def _sequential_value_and_grad(stage_defs, xs, ys):
+def _sequential_value_and_grad(stage_defs, xs, ys, loss_fn=None):
+    loss_fn = loss_fn or _loss_fn
     params = [p for _, p in stage_defs]
     fns = [f for f, _ in stage_defs]
 
@@ -81,7 +82,7 @@ def _sequential_value_and_grad(stage_defs, xs, ys):
             h = xs[j]
             for fn, p in zip(fns, params):
                 h = fn(p, h)
-            total = total + _loss_fn(h, ys[j])
+            total = total + loss_fn(h, ys[j])
         return total / xs.shape[0]
 
     return jax.value_and_grad(loss)(params)
@@ -267,3 +268,66 @@ def test_axis_size_mismatch_raises():
         jax.jit(shard_map(
             run, mesh=mesh, in_specs=(P("stage"), P(), P()),
             out_specs=P()))(packed, pipe.encode_inputs(xs), ys)
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_1f1b_fuzz_random_stage_graphs(seed):
+    """Property: random heterogeneous chains (random stage count, random
+    inner widths/activation shapes, random param structures) match the
+    sequential oracle — loss and every stage's grads."""
+    rs = np.random.RandomState(seed)
+    S = int(rs.choice([3, 4]))
+    mb, l0 = 2, int(rs.choice([4, 8]))
+    dims = [int(rs.choice([8, 12, 16])) for _ in range(S)]
+
+    def mk_stage(din, dout, kind):
+        if kind == 0:      # affine + tanh
+            p = {"w": jnp.asarray(rs.randn(din, dout) * 0.3, jnp.float32),
+                 "b": jnp.asarray(rs.randn(dout) * 0.1, jnp.float32)}
+            return (lambda p, h: jnp.tanh(h @ p["w"] + p["b"]), p)
+        if kind == 1:      # gated two-matrix
+            p = {"a": jnp.asarray(rs.randn(din, dout) * 0.3, jnp.float32),
+                 "g": jnp.asarray(rs.randn(din, dout) * 0.3, jnp.float32)}
+            return (lambda p, h: (h @ p["a"]) * jax.nn.sigmoid(h @ p["g"]),
+                    p)
+        # nested-pytree mixer
+        p = {"m": [jnp.asarray(rs.randn(din, dout) * 0.3, jnp.float32),
+                   {"s": jnp.asarray(rs.rand(dout) + 0.5, jnp.float32)}]}
+        return (lambda p, h: (h @ p["m"][0]) * p["m"][1]["s"], p)
+
+    widths = [l0] + dims
+    stage_defs = [mk_stage(widths[i], widths[i + 1], int(rs.choice(3)))
+                  for i in range(S)]
+    m = 2 * S
+    xs = jnp.asarray(rs.randn(m, mb, l0) * 0.5, jnp.float32)
+    ys = jnp.asarray(rs.randn(m, mb, dims[-1]) * 0.5, jnp.float32)
+
+    def loss_fn(out, tgt):
+        return jnp.mean((out - tgt) ** 2)
+
+    pipe = HeteroPipeline(
+        stage_defs, jax.ShapeDtypeStruct((mb, l0), jnp.float32),
+        axis_name="stage")
+    mesh = Mesh(np.asarray(jax.devices()[:S]), ("stage",))
+
+    def run(stacked, xw, ys):
+        my = jax.tree_util.tree_map(lambda l: l[0], stacked)
+        loss, g = hetero_pipeline_1f1b_value_and_grad(
+            pipe, loss_fn, my, xw, ys)
+        return loss, g[None]
+
+    loss, flat_grads = jax.jit(shard_map(
+        run, mesh=mesh, in_specs=(P("stage"), P(), P()),
+        out_specs=(P(), P("stage"))))(
+            pipe.pack_params(), pipe.encode_inputs(xs), ys)
+
+    ref, ref_grads = _sequential_value_and_grad(
+        stage_defs, np.asarray(xs), np.asarray(ys), loss_fn=loss_fn)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+    for s, (got, want) in enumerate(zip(pipe.unpack_grads(flat_grads),
+                                        ref_grads)):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=1e-6,
+                err_msg=f"seed {seed} stage {s}"),
+            got, want)
