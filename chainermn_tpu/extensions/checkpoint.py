@@ -152,6 +152,17 @@ class _SpliceTargets:
                 self.bufs[t][dst] = arr[srcsl]
                 self.covered[t] += int(np.prod(
                     [b - a for a, b in inter], initial=1))
+                if self.covered[t] > self.vols[t]:
+                    # volume accounting assumes saved shards are a
+                    # DISJOINT partition (replicas deduplicated at save);
+                    # partially-overlapping shards would double-count and
+                    # make `complete` lie in both directions
+                    raise ValueError(
+                        f"snapshot leaf {i}: saved shards overlap "
+                        f"(covered {self.covered[t]} > {self.vols[t]} "
+                        f"elements of target range {t}) — snapshot files "
+                        "are not a disjoint partition; was the snapshot "
+                        "written by mixed runs?")
             if arr is not None:
                 self._seen.add(key)
 
@@ -636,10 +647,21 @@ class MultiNodeCheckpointer:
                 f"global shape {gshape}) but the template leaf is not an "
                 "array")
         if tuple(ref.shape) != gshape:
+            hint = ""
+            if (len(gshape) == 1 and len(ref.shape) == 1
+                    and abs(gshape[0] - ref.shape[0]) < 256):
+                # a flat-vector leaf off by less than one padding quantum:
+                # almost certainly a ZeRO snapshot from before the
+                # 2026-07-31 device-count-independent padding change
+                # (optimizers/zero.py _padded_size), not a model change
+                hint = (" (a flat ZeRO-1/2 vector off by <256 elements "
+                        "suggests a pre-quantum-padding snapshot — "
+                        "re-save from a live run; see "
+                        "optimizers/zero.py:_padded_size)")
             raise ValueError(
                 f"snapshot leaf {i}: saved global shape {gshape}, "
                 f"template is {tuple(ref.shape)} — different model, not "
-                "a resharding")
+                f"a resharding{hint}")
 
         def splice(targets):
             sp = _SpliceTargets(targets, gshape, np.dtype(ref.dtype))

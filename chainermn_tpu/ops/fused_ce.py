@@ -300,6 +300,12 @@ def fused_lm_loss(model, params, x, y, train=True, mutable=None,
             "fused_lm_loss drops the MoE load-balancing aux (the 'losses' "
             "collection is not made mutable here) — experts would collapse "
             "silently; use lm_loss_with_aux for MoE models")
+    if mutable:
+        raise ValueError(
+            "fused_lm_loss does not thread mutable collections through "
+            f"apply (mutable={mutable!r} would be silently dropped); use "
+            "lm_loss_with_aux for models with mutable state")
+    del train  # TransformerLM has no train-dependent state (no dropout/BN)
     variables = {"params": params, **(extra_vars or {})}
     hidden = model.clone(return_hidden=True).apply(
         variables, x, rngs=rngs)                    # [B, L, D]

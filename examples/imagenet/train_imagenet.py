@@ -140,11 +140,19 @@ def main():
         # examples/imagenet/make_jpeg_dataset.py.
         from chainermn_tpu.datasets import ImageFolderDataset
 
-        train = ImageFolderDataset(args.data_dir,
-                                   image_size=args.image_size, train=True)
-        n_classes = len(train.classes)
+        # root-only build (scatter_dataset ships the samples over the
+        # object plane, so workers need no access to the root's storage —
+        # same contract train_seq2seq.py relies on)
+        if comm.inter_rank == 0:
+            train = ImageFolderDataset(args.data_dir,
+                                       image_size=args.image_size,
+                                       train=True)
+            n_classes = len(train.classes)
+        else:
+            train, n_classes = None, None
+        n_classes = comm.bcast_obj(n_classes)
         train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True,
-                                              seed=0)
+                                              seed=0, shared_storage=False)
         train_len = len(train) * n_proc
     else:
         train = synthetic_imagenet(args.n_train, args.image_size)

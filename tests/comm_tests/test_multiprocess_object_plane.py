@@ -88,3 +88,47 @@ print(f"WORKER{proc_id} OK", flush=True)
 def test_two_process_object_plane(tmp_path):
     procs, outs = run_workers(_WORKER, tmp_path, timeout=110)
     assert_all_ok(procs, outs)
+
+
+_DEADLINE_PIN_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=1,
+    process_id=0)
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from chainermn_tpu.comm.object_plane import _is_deadline_error
+
+# Pin against the INSTALLED jaxlib: a blocking get on a never-published
+# key must raise an error _is_deadline_error classifies as a key-wait
+# deadline (retry), not a transport failure (abort). If a jaxlib upgrade
+# changes the message/status shape, this fails loudly instead of the
+# plane silently demoting deadlines to aborts.
+client = jax._src.distributed.global_state.client
+try:
+    client.blocking_key_value_get("never-published-key", 200)
+except Exception as e:
+    assert _is_deadline_error(e), (
+        "installed jaxlib's key-wait timeout no longer classifies as a "
+        f"deadline: {type(e).__name__}: {e}")
+else:
+    raise AssertionError("blocking_key_value_get did not time out")
+
+# and a transport-ish error must NOT classify as a deadline
+assert not _is_deadline_error(RuntimeError(
+    "failed to connect to all addresses; last error: UNAVAILABLE"))
+
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(120)
+def test_deadline_error_pins_installed_jaxlib(tmp_path):
+    procs, outs = run_workers(_DEADLINE_PIN_WORKER, tmp_path, n=1,
+                              timeout=110)
+    assert_all_ok(procs, outs)

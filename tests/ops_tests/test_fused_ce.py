@@ -71,6 +71,18 @@ def test_nondivisible_vocab_raises():
         fused_ce_head(h, w, y, BR, 100)
 
 
+def test_fused_lm_loss_rejects_mutable():
+    """A model with mutable state must not silently drop its updates
+    (the guard mirrors the MoE 'losses' refusal)."""
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab=BV, d_model=D, n_heads=2, n_layers=1,
+                          d_ff=32, max_len=8)
+    x = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="mutable"):
+        fused_lm_loss(model, {}, x, x, mutable=("batch_stats",))
+
+
 def test_fused_lm_loss_end_to_end():
     """Step-factory path: same loss/acc/grads as lm_loss_with_aux on a
     real TransformerLM, and a few SGD steps actually learn."""
