@@ -192,3 +192,11 @@ from chainermn_tpu.datasets.image_folder import (  # noqa: E402
     ImageFolderDataset,
     write_image_folder,
 )
+from chainermn_tpu.datasets.standard_formats import (  # noqa: E402
+    load_cifar,
+    load_idx,
+    load_mnist,
+    save_cifar,
+    save_idx,
+    save_mnist,
+)

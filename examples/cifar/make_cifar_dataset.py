@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Generate a local CIFAR binary-layout dataset (``train.bin``/``test.bin``
+for CIFAR-100, ``data_batch_*.bin`` for CIFAR-10) with class-separable
+synthetic images.
+
+No network egress in this environment, so this writes the REAL binary
+batch format locally; ``train_cifar.py`` then *parses* it exactly as it
+would parse the genuine files.
+
+    python examples/cifar/make_cifar_dataset.py /tmp/cifar --n-train 4096
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from chainermn_tpu.datasets.standard_formats import save_cifar
+
+
+def synth_uint8(n, n_classes, seed):
+    protos = np.random.RandomState(54321).rand(n_classes, 32, 32, 3)
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, n_classes, size=n)
+    xs = protos[ys] + 0.3 * rng.randn(n, 32, 32, 3)
+    xs = np.clip(xs, 0.0, 1.5) / 1.5
+    return (xs * 255).astype(np.uint8), ys.astype(np.uint8)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("out")
+    p.add_argument("--n-classes", type=int, default=100, choices=[10, 100])
+    p.add_argument("--n-train", type=int, default=4096)
+    p.add_argument("--n-test", type=int, default=1024)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    xs, ys = synth_uint8(args.n_train, args.n_classes, args.seed)
+    save_cifar(args.out, xs, ys, n_classes=args.n_classes, train=True)
+    xs, ys = synth_uint8(args.n_test, args.n_classes, args.seed + 1)
+    save_cifar(args.out, xs, ys, n_classes=args.n_classes, train=False)
+    print(f"wrote CIFAR-{args.n_classes} binary batches ({args.n_train} "
+          f"train / {args.n_test} test) under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

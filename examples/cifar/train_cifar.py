@@ -22,7 +22,7 @@ from chainermn_tpu.utils import ensure_platform
 
 ensure_platform()
 
-from chainermn_tpu.datasets.toy import synthetic_cifar
+from chainermn_tpu.datasets.standard_formats import load_cifar
 from chainermn_tpu.iterators import SerialIterator
 from chainermn_tpu.models.resnet import CifarResNet
 from chainermn_tpu.training import LogReport, PrintReport, StandardUpdater, Trainer
@@ -39,6 +39,12 @@ def main():
     p.add_argument("--n-train", type=int, default=4096)
     p.add_argument("--no-multi-node-bn", action="store_true",
                    help="use per-replica BN statistics instead")
+    p.add_argument("--data-dir", default=None, metavar="DIR",
+                   help="CIFAR binary-layout directory (train.bin for "
+                        "CIFAR-100). Default: generate a local binary "
+                        "dataset under --out and parse THAT — the "
+                        "executed input path is always the real-format "
+                        "parser")
     p.add_argument("--out", "-o", default="result")
     args = p.parse_args()
 
@@ -47,8 +53,25 @@ def main():
         print(f"devices: {comm.size}  multi-node BN: "
               f"{not args.no_multi_node_bn}")
 
-    train = synthetic_cifar(args.n_train, seed=0)
-    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
+    # real-format input path: parse CIFAR binary batches, generating them
+    # locally first when no directory was given. Root-only build; samples
+    # ship over the object plane.
+    if comm.inter_rank == 0:
+        data_dir = args.data_dir
+        if data_dir is None:
+            data_dir = os.path.join(args.out, "cifar-data")
+            if not os.path.exists(os.path.join(data_dir, "train.bin")):
+                from make_cifar_dataset import synth_uint8
+                from chainermn_tpu.datasets.standard_formats import (
+                    save_cifar)
+
+                xs, ys = synth_uint8(args.n_train, 100, seed=0)
+                save_cifar(data_dir, xs, ys, n_classes=100, train=True)
+        train = load_cifar(data_dir, n_classes=100, train=True)
+    else:
+        train = None
+    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0,
+                                          shared_storage=False)
 
     model = CifarResNet(
         num_classes=100, depth=args.depth,

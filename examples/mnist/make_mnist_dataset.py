@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Generate a local MNIST-layout dataset: standard IDX files
+(``train-images-idx3-ubyte`` etc.) with class-separable synthetic digits.
+
+No network egress in this environment, so this writes the REAL on-disk
+format locally; ``train_mnist.py`` then *parses* it exactly as it would
+parse the genuine LeCun files (upstream examples/mnist/train_mnist.py
+consumes the same layout via chainer.datasets.get_mnist).
+
+    python examples/mnist/make_mnist_dataset.py /tmp/mnist --n-train 4096
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from chainermn_tpu.datasets.standard_formats import save_mnist
+
+
+def synth_uint8(n, seed):
+    """Same prototype recipe as datasets/toy.py, quantized to uint8."""
+    protos = np.random.RandomState(12345).rand(10, 28, 28)
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, 10, size=n)
+    xs = protos[ys] + 0.3 * rng.randn(n, 28, 28)
+    xs = np.clip(xs, 0.0, 1.5) / 1.5
+    return (xs * 255).astype(np.uint8), ys.astype(np.uint8)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("out")
+    p.add_argument("--n-train", type=int, default=4096)
+    p.add_argument("--n-test", type=int, default=1024)
+    p.add_argument("--gz", action="store_true",
+                   help="write the gzipped spellings (*.gz) like the "
+                        "distributed files")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    xs, ys = synth_uint8(args.n_train, args.seed)
+    save_mnist(args.out, xs, ys, train=True, gz=args.gz)
+    xs, ys = synth_uint8(args.n_test, args.seed + 1)
+    save_mnist(args.out, xs, ys, train=False, gz=args.gz)
+    print(f"wrote MNIST IDX files ({args.n_train} train / "
+          f"{args.n_test} test) under {args.out}")
+
+
+if __name__ == "__main__":
+    main()

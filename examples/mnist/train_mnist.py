@@ -26,7 +26,7 @@ import chainermn_tpu
 from chainermn_tpu.utils import ensure_platform
 
 ensure_platform()  # make JAX_PLATFORMS=cpu work even under site hooks
-from chainermn_tpu.datasets.toy import synthetic_mnist
+from chainermn_tpu.datasets.standard_formats import load_mnist
 from chainermn_tpu.iterators import SerialIterator
 from chainermn_tpu.models import MLP
 from chainermn_tpu.training import (
@@ -48,6 +48,12 @@ def main():
     p.add_argument("--communicator", type=str, default="xla")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--n-train", type=int, default=4096)
+    p.add_argument("--data-dir", default=None, metavar="DIR",
+                   help="MNIST-layout directory (train-images-idx3-ubyte "
+                        "etc., plain or .gz). Default: generate a local "
+                        "IDX dataset under --out and parse THAT — the "
+                        "executed input path is always the real-format "
+                        "parser (reference: chainer.datasets.get_mnist)")
     p.add_argument("--out", "-o", default="result")
     args = p.parse_args()
 
@@ -55,10 +61,31 @@ def main():
     if comm.is_master:
         print(f"devices: {comm.size}  mesh axes: {comm.axis_names}")
 
-    # data (synthetic stand-in; see chainermn_tpu/datasets/toy.py)
-    train = synthetic_mnist(args.n_train, seed=0)
-    test = synthetic_mnist(1024, seed=1)
-    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0)
+    # real-format input path: parse IDX files (the reference's MNIST
+    # layout) from --data-dir, generating them locally first when no
+    # directory was given. Root-only build; samples ship over the
+    # object plane.
+    if comm.inter_rank == 0:
+        data_dir = args.data_dir
+        if data_dir is None:
+            data_dir = os.path.join(args.out, "mnist-data")
+            if not os.path.exists(
+                    os.path.join(data_dir, "train-images-idx3-ubyte")):
+                from make_mnist_dataset import synth_uint8
+                from chainermn_tpu.datasets.standard_formats import (
+                    save_mnist)
+
+                xs, ys = synth_uint8(args.n_train, seed=0)
+                save_mnist(data_dir, xs, ys, train=True)
+                xs, ys = synth_uint8(1024, seed=1)
+                save_mnist(data_dir, xs, ys, train=False)
+        train = load_mnist(data_dir, train=True)
+        test = load_mnist(data_dir, train=False)
+    else:
+        train, test = None, None
+    train = chainermn_tpu.scatter_dataset(train, comm, shuffle=True, seed=0,
+                                          shared_storage=False)
+    test = comm.bcast_obj(test)
 
     model = MLP(n_units=args.unit, n_out=10)
     params = model.init(jax.random.PRNGKey(0),
