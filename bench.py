@@ -210,12 +210,32 @@ def main():
                            mutable)
     per_chip = images_per_sec / n_dev
     suffix = "_realistic" if realistic else ""
-    print(json.dumps({
+    record = {
         "metric": f"{name}_train_images_per_sec_per_chip{suffix}",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": 1.0,
-    }))
+    }
+
+    # LM regression gate, folded into the SAME json line (extra keys are
+    # harmless to any parser of the headline metric): the flash-attention
+    # + fused-CE LM path at its measured optimum (b=4, BASELINE.md) must
+    # stay above the 100k tok/s/chip floor — a kernel regression can no
+    # longer land with all driver-visible artifacts green. TPU-only: the
+    # Pallas kernels don't run on the CPU mesh.
+    if "--no-lm" not in sys.argv and jax.default_backend() != "cpu":
+        lm_floor = 100_000.0
+        try:
+            from tools.bench_lm import measure
+
+            lm_per_chip, _ = measure(batch=4, loss_kind="fused")
+            record["lm_tokens_per_sec_per_chip"] = round(lm_per_chip, 1)
+            record["lm_floor_tokens_per_sec"] = lm_floor
+            record["lm_gate_ok"] = bool(lm_per_chip >= lm_floor)
+        except Exception as e:  # never sink the headline metric
+            record["lm_gate_ok"] = False
+            record["lm_error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,13 +1,16 @@
 #!/usr/bin/env python
 """Transformer-LM training throughput (tokens/sec) on the available chips.
 
-Secondary benchmark (the driver's recorded metric is bench.py's ResNet-50):
-a GPT-small-ish causal LM on the flash-attention path, bf16 compute,
-data-parallel step factory. Prints one JSON line per config.
+Secondary benchmark (the driver's recorded metric is bench.py's ResNet-50,
+which also folds this number into its JSON line as the LM regression
+gate): a GPT-small-ish causal LM on the flash-attention path, bf16
+compute, data-parallel step factory. Prints one JSON line per config.
 
-Usage: python tools/bench_lm.py [d_model n_layers seq_len batch [loss]]
+Usage: python tools/bench_lm.py [d_model n_layers seq_len batch [loss [d_head]]]
   loss: 'unfused' (default) or 'fused' — the fused head+CE Pallas kernel
   (ops/fused_ce.py; measured throughput-neutral, −2 GB logits memory)
+  d_head: head dim (default 64; 128 halves the QK^T MXU inefficiency the
+  roofline attributes to d=64 — docs/lm_roofline.md)
 """
 
 import json
@@ -21,27 +24,28 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 import numpy as np
 
 
-def main():
+def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
+            loss_kind="unfused", d_head=64, scan_k=4, n_iters=6):
+    """Measure LM training throughput; returns (tokens_per_sec_per_chip,
+    config dict). Importable — bench.py reuses this as its LM gate."""
     import jax
     import jax.numpy as jnp
     import optax
 
     import chainermn_tpu
-    from chainermn_tpu.models.transformer import TransformerLM, lm_loss_with_aux
+    from chainermn_tpu.models.transformer import (TransformerLM,
+                                                  lm_loss_with_aux)
     from chainermn_tpu.training.step import make_data_parallel_train_step
 
-    d_model = int(sys.argv[1]) if len(sys.argv) > 1 else 768
-    n_layers = int(sys.argv[2]) if len(sys.argv) > 2 else 12
-    seq_len = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
-    batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
-    loss_kind = sys.argv[5] if len(sys.argv) > 5 else "unfused"
     if loss_kind not in ("unfused", "fused"):
-        raise SystemExit(f"loss must be 'unfused' or 'fused', got "
+        raise ValueError(f"loss must be 'unfused' or 'fused', got "
                          f"{loss_kind!r}")
+    if d_model % d_head:
+        raise ValueError(f"d_head {d_head} must divide d_model {d_model}")
 
     comm = chainermn_tpu.create_communicator("xla")
     model = TransformerLM(
-        vocab=32768, d_model=d_model, n_heads=d_model // 64,
+        vocab=32768, d_model=d_model, n_heads=d_model // d_head,
         n_layers=n_layers, d_ff=4 * d_model, max_len=seq_len,
         pos_emb="rope", attention="flash", dtype=jnp.bfloat16)
 
@@ -54,7 +58,6 @@ def main():
     # K steps per dispatch: measures the device, not the tunnel's ~100 ms
     # dispatch round-trip (same methodology as bench.py; the token stack
     # reuses ONE device batch K times to avoid the ~10 MB/s tunnel)
-    scan_k = 4
     if loss_kind == "fused":
         from chainermn_tpu.ops import fused_lm_loss
 
@@ -79,7 +82,6 @@ def main():
     for _ in range(3):
         state, m = step(state, xs, ys)
         float(m["main/loss"][-1])
-    n_iters = 6
     t0 = time.perf_counter()
     for _ in range(n_iters):
         state, m = step(state, xs, ys)
@@ -89,14 +91,31 @@ def main():
 
     tokens_per_sec = n_iters * scan_k * batch * comm.size * seq_len / dt
     n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    config = {"d_model": d_model, "n_layers": n_layers,
+              "seq_len": seq_len, "batch_per_chip": batch,
+              "d_head": d_head,
+              "params_m": round(n_params / 1e6, 1),
+              "loss": loss_kind}
+    return tokens_per_sec / comm.size, config
+
+
+def main():
+    d_model = int(sys.argv[1]) if len(sys.argv) > 1 else 768
+    n_layers = int(sys.argv[2]) if len(sys.argv) > 2 else 12
+    seq_len = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+    batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+    loss_kind = sys.argv[5] if len(sys.argv) > 5 else "unfused"
+    d_head = int(sys.argv[6]) if len(sys.argv) > 6 else 64
+    try:
+        per_chip, config = measure(d_model, n_layers, seq_len, batch,
+                                   loss_kind, d_head)
+    except ValueError as e:
+        raise SystemExit(str(e))
     print(json.dumps({
         "metric": "transformer_lm_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec / comm.size, 1),
+        "value": round(per_chip, 1),
         "unit": "tokens/sec/chip",
-        "config": {"d_model": d_model, "n_layers": n_layers,
-                   "seq_len": seq_len, "batch_per_chip": batch,
-                   "params_m": round(n_params / 1e6, 1),
-                   "loss": loss_kind},
+        "config": config,
     }))
 
 
