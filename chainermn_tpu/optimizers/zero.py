@@ -28,6 +28,7 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax, shard_map
 from jax.flatten_util import ravel_pytree
@@ -52,6 +53,83 @@ def _padded_size(total: int, n: int) -> int:
     return total + ((-total) % q)
 
 
+class _BucketLayout:
+    """Bucket-major ZeRO layout: parameter leaves greedily packed into
+    buckets of ≤ ``bucket_bytes`` (comm/xla.py's ``plan_buckets``), each
+    bucket padded and sharded independently.
+
+    Why: with ONE flat vector, the backward's full gradient must exist
+    as a single padded buffer before the one big ``psum_scatter`` — peak
+    live gradient = full model (the r2/r3 ZeRO-1 wart). With buckets,
+    each full-size bucket gradient is reduce-scattered the moment its
+    leaves exist and DIES there; backward produces leaves in
+    reverse-layer order, so late buckets scatter while early layers are
+    still differentiating. Peak live gradient ≈ leaves-in-flight + one
+    bucket (evidenced by compiled buffer-assignment stats in the tests).
+
+    State layout is a TUPLE of per-bucket flat vectors, each padded and
+    ``P(ax)``-sharded independently (optax transforms run element-wise
+    over the tuple pytree). Each bucket's GLOBAL vector is plain bucket
+    content — device-count-independent — so sharded snapshots reshard
+    across device counts exactly like the unbucketed single vector
+    (quantum padding, extensions/checkpoint.py splicing), per bucket
+    leaf. The bucket plan is a pure function of (leaf sizes,
+    bucket_bytes), so the layout reconstructs deterministically for
+    :func:`zero1_params`. NOT interchangeable with the unbucketed
+    layout: snapshots written one way must be restored the same way.
+    """
+
+    def __init__(self, params, n: int, bucket_bytes: int):
+        from chainermn_tpu.comm.xla import plan_buckets
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.shapes = [jnp.shape(l) for l in leaves]
+        self.sizes = [int(np.prod(s, initial=1)) for s in self.shapes]
+        dtypes = {jnp.asarray(l).dtype for l in leaves}
+        if len(dtypes) != 1:
+            raise ValueError(
+                f"ZeRO flat layouts need a single param dtype, got "
+                f"{sorted(str(d) for d in dtypes)}")
+        (self.dtype,) = dtypes
+        self.buckets = plan_buckets(
+            [(i, self.sizes[i] * self.dtype.itemsize)
+             for i in range(len(leaves))], bucket_bytes)
+        self.totals = [sum(self.sizes[i] for i in b) for b in self.buckets]
+        self.padded = [_padded_size(t, n) for t in self.totals]
+        self.shard_lens = [p // n for p in self.padded]
+        self.shard_offs = list(np.cumsum([0] + self.shard_lens[:-1]))
+        self.shard_len = sum(self.shard_lens)
+        self.n = n
+
+    def pack_buckets(self, tree):
+        """Leaves → one padded flat vector per bucket."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        out = []
+        for b, padded in zip(self.buckets, self.padded):
+            parts = [leaves[i].reshape(-1) for i in b]
+            total = sum(self.sizes[i] for i in b)
+            if padded != total:
+                parts.append(jnp.zeros((padded - total,),
+                                       parts[0].dtype))
+            out.append(jnp.concatenate(parts) if len(parts) > 1
+                       else parts[0])
+        return out
+
+    def unpack_full(self, bucket_fulls):
+        """Per-bucket FULL vectors → the parameter pytree."""
+        leaves = []
+        for b, full, total in zip(self.buckets, bucket_fulls, self.totals):
+            off = 0
+            for i in b:
+                leaves.append(
+                    lax.slice_in_dim(full, off, off + self.sizes[i])
+                    .reshape(self.shapes[i]))
+                off += self.sizes[i]
+        # leaves arrive in bucket order == leaf order (buckets partition
+        # the leaf sequence in order)
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
 def make_zero1_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -59,6 +137,7 @@ def make_zero1_train_step(
     params,
     loss_fn: Optional[Callable] = None,
     donate: bool = True,
+    bucket_bytes: Optional[int] = None,
 ) -> Tuple[Callable, Tuple]:
     """Build a jitted ZeRO-1 data-parallel train step and its initial state.
 
@@ -88,6 +167,15 @@ def make_zero1_train_step(
     The gradient reduction op is ``mean`` (the reference's
     ``allreduce_grad`` contract); do NOT additionally wrap ``optimizer`` in
     ``create_multi_node_optimizer``.
+
+    ``bucket_bytes``: pack parameter leaves into independent reduction
+    buckets (:class:`_BucketLayout`). The backward's full-size gradient
+    then never exists as one buffer — each bucket is reduce-scattered as
+    soon as its leaves are produced and freed immediately, so peak live
+    gradient drops from full-model to ≈ one bucket. Numerics are
+    identical; the STATE LAYOUT is not — pass the same ``bucket_bytes``
+    to :func:`zero1_params` and keep it fixed across snapshot
+    save/restore.
     """
     from chainermn_tpu.training.step import classifier_loss
 
@@ -97,6 +185,10 @@ def make_zero1_train_step(
     n = comm.size
     axes = comm.axis_names
     dspec = P(ax)
+
+    if bucket_bytes is not None:
+        return _make_zero1_bucketed(model, optimizer, comm, params, lf,
+                                    donate, bucket_bytes)
 
     flat, unravel = ravel_pytree(params)
     total = flat.size
@@ -135,6 +227,11 @@ def make_zero1_train_step(
             return loss, acc
 
         (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(p)
+        # the full flat gradient exists transiently here (one
+        # model-size buffer feeding one scatter); pass bucket_bytes to
+        # reduce-scatter per bucket instead — peak live gradient drops
+        # to ≈ one bucket (evidence: compiled buffer-assignment stats,
+        # tests/optimizers_tests/test_zero.py)
         g = ravel_pytree(grads)[0]
         if padded != total:
             g = jnp.concatenate([g, jnp.zeros((padded - total,), g.dtype)])
@@ -152,6 +249,84 @@ def make_zero1_train_step(
             local_step, mesh=mesh,
             in_specs=((P(ax), opt_specs), dspec, dspec),
             out_specs=((P(ax), opt_specs), P()),
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+    return step, state
+
+
+def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
+                         bucket_bytes):
+    """Bucketed ZeRO-1 (see ``make_zero1_train_step(bucket_bytes=...)``).
+
+    Per step, per bucket: ``psum_scatter`` the bucket's padded gradient
+    (mean) → concatenate the per-bucket shards into the flat aligned
+    shard vector → one element-wise ``optimizer.update``. The per-bucket
+    ``all_gather`` on the forward side re-assembles parameters with the
+    same layout. XLA's liveness analysis frees each full-size bucket
+    gradient at its scatter, and its latency-hiding scheduler can start
+    late-layer buckets' collectives while early layers are still in
+    backward (tests/comm_tests/test_overlap_schedule.py asserts the
+    schedule interleaving for the DP path)."""
+    mesh = comm.mesh
+    ax = comm.axis_name
+    n = comm.size
+    axes = comm.axis_names
+    dspec = P(ax)
+
+    layout = _BucketLayout(params, n, bucket_bytes)
+    shard_shapes = {(ln,) for ln in layout.shard_lens}
+
+    def init_fn(params):
+        i = lax.axis_index(ax)
+        shards = tuple(
+            lax.dynamic_slice_in_dim(v, i * ln, ln)
+            for v, ln in zip(layout.pack_buckets(params),
+                             layout.shard_lens)
+        )
+        return shards, optimizer.init(shards)
+
+    abs_shards = tuple(
+        jax.ShapeDtypeStruct((ln,), layout.dtype)
+        for ln in layout.shard_lens)
+    abs_opt = jax.eval_shape(optimizer.init, abs_shards)
+    opt_specs = jax.tree_util.tree_map(
+        lambda l: P(ax) if l.shape in shard_shapes else P(), abs_opt)
+    shard_specs = tuple(P(ax) for _ in layout.buckets)
+
+    state = jax.jit(shard_map(
+        init_fn, mesh=mesh, in_specs=(P(),),
+        out_specs=(shard_specs, opt_specs), check_vma=False,
+    ))(params)
+
+    def local_step(state, x, y):
+        p_shards, opt_state = state
+        fulls = [lax.all_gather(s, ax, tiled=True) for s in p_shards]
+        p = layout.unpack_full(fulls)
+
+        def f(p):
+            loss, (acc, _) = lf(model, p, x, y, train=True)
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(p)
+        g_shards = tuple(
+            lax.psum_scatter(g, ax, tiled=True) / n
+            for g in layout.pack_buckets(grads)
+        )
+        updates, opt_state = optimizer.update(g_shards, opt_state,
+                                              p_shards)
+        p_shards = optax.apply_updates(p_shards, updates)
+        metrics = {
+            "main/loss": lax.pmean(loss, axes),
+            "main/accuracy": lax.pmean(acc, axes),
+        }
+        return (p_shards, opt_state), metrics
+
+    step = jax.jit(
+        shard_map(
+            local_step, mesh=mesh,
+            in_specs=((shard_specs, opt_specs), dspec, dspec),
+            out_specs=((shard_specs, opt_specs), P()),
         ),
         donate_argnums=(0,) if donate else (),
     )
@@ -271,12 +446,33 @@ def make_zero2_train_step(
     return step, state
 
 
-def zero1_params(state, like_params):
+def zero1_params(state, like_params, bucket_bytes=None):
     """Re-assemble the full parameter pytree from a ZeRO-1 state (driver
-    level — for checkpointing, eval, or export)."""
-    flat, unravel = ravel_pytree(like_params)
-    full = jnp.asarray(state[0]).reshape(-1)[: flat.size]
-    return unravel(full)
+    level — for checkpointing, eval, or export). Pass the SAME
+    ``bucket_bytes`` the step was built with — the bucketed state layout
+    is shard-major (:class:`_BucketLayout`) and silently permutes if
+    read with the wrong plan."""
+    if bucket_bytes is None:
+        flat, unravel = ravel_pytree(like_params)
+        full = jnp.asarray(state[0]).reshape(-1)[: flat.size]
+        return unravel(full)
+    buckets = state[0]
+    if not isinstance(buckets, (tuple, list)):
+        raise ValueError(
+            "bucket_bytes given but the state holds a single flat vector "
+            "— it was built WITHOUT bucket_bytes; the two layouts are "
+            "not interchangeable")
+    # n is irrelevant to the layout here (each bucket's global vector is
+    # plain bucket content); any value reproduces the same plan
+    layout = _BucketLayout(like_params, 1, bucket_bytes)
+    if len(buckets) != len(layout.buckets):
+        raise ValueError(
+            f"state has {len(buckets)} buckets but bucket_bytes="
+            f"{bucket_bytes} plans {len(layout.buckets)} — pass the "
+            "bucket_bytes the step was built with")
+    fulls = [jnp.asarray(b).reshape(-1)[:t]
+             for b, t in zip(buckets, layout.totals)]
+    return layout.unpack_full(fulls)
 
 
 # ---------------------------------------------------------------------------
