@@ -1,4 +1,5 @@
 from .collective import allgather, allreduce, alltoall, bcast, gather, scatter
+from .eager_p2p import eager_recv, eager_send
 from .point_to_point import DelegateVariable, pseudo_connect, recv, send, transfer
 
 __all__ = [
@@ -13,4 +14,6 @@ __all__ = [
     "transfer",
     "pseudo_connect",
     "DelegateVariable",
+    "eager_send",
+    "eager_recv",
 ]

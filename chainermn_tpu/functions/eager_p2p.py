@@ -1,0 +1,193 @@
+"""Eager differentiable point-to-point communication.
+
+Reference: chainermn/functions/point_to_point_communication.py (SURVEY.md
+§2.3, §7 hard-part #1). There, ``send``/``recv`` run EAGERLY mid-forward
+under define-by-run autograd — blocking MPI calls with data-dependent
+Python control flow between them — and each Function's ``backward`` runs
+the reverse transport.
+
+The compiled path (:mod:`chainermn_tpu.functions.point_to_point`) covers
+the traced world with ``ppermute``. This module covers the reference's
+*eager* world: ``eager_send``/``eager_recv`` are ``jax.custom_vjp``
+functions whose forward is an **ordered** ``io_callback`` into the
+driver-level object-plane transport (``comm.send``/``comm.recv`` —
+device→host→KV-store→peer), and whose backward runs the REVERSE
+transport on a dedicated gradient channel: ``eager_send``'s vjp receives
+the output-gradient from the destination, ``eager_recv``'s vjp sends the
+incoming gradient back to the source. A reference script that
+differentiates through an eager send loop now has a working path.
+
+Contracts carried over from the reference (they are transport truths,
+not API accidents):
+
+- **Global order discipline.** Every process must issue its sends/recvs
+  in a globally consistent order, or the transports deadlock — same
+  contract as MPI (SURVEY.md §3.3). Autodiff replays the reverse order
+  in backward, so a consistent forward order implies a consistent
+  backward order (the reference's mirror schedule).
+- **Known shapes.** ``eager_recv`` needs ``shape``/``dtype`` spelled out
+  (or a ``like=`` example): a traced program cannot negotiate avals at
+  runtime the way the reference's `_MessageType` header exchange did.
+- **Cross-process only.** Same-process shards exchange data inside the
+  compiled program (``chainermn_tpu.functions.send/recv``); the eager
+  channel raises for same-process endpoints, like ``comm.send`` itself.
+- **Anchoring (functional-autodiff deviation, enforced).** Chainer's
+  define-by-run backward visits EVERY node reachable from the loss, so
+  a Recv always sends its gradient back even when the receiving rank
+  has no parameters behind it. JAX's transpose only walks paths from
+  differentiated INPUTS to outputs — a received value used purely as
+  data (``loss = f(my_params, h)`` where ``h`` came off the wire) is a
+  constant w.r.t. ``my_params`` and its vjp would silently never run,
+  deadlocking the sender's backward. ``eager_recv`` therefore requires
+  ``anchor=``: any value on your differentiation path (a parameter, a
+  prior delegate token); the transfer is threaded through it so
+  backward provably visits the reverse transport.
+
+Works both fully eagerly (``jax.grad`` of a host-level function — the
+callbacks fire during trace/execute) and inside ``jit`` (the callbacks
+become host round-trips at execution time; keep them off hot paths).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_GRAD_NS = "eagergrad"
+
+
+def _grad_tag(tag) -> str:
+    """Backward messages ride their own ordered channel so a reverse
+    transfer can never interleave with forward messages of the same
+    tag."""
+    return f"{_GRAD_NS}.{tag}"
+
+
+def _io_callback(fn, result_shape, *args):
+    from jax.experimental import io_callback
+
+    return io_callback(fn, result_shape, *args, ordered=True)
+
+
+@functools.lru_cache(maxsize=None)
+def _send_fn(comm, dest: int, tag, avals):
+    """Build (and cache) the custom_vjp send for one (comm, dest, tag,
+    aval-signature). The aval signature is closed over so the backward
+    knows the gradient's shapes without carrying residual arrays."""
+
+    shapes = tuple(jax.ShapeDtypeStruct(s, d) for (s, d) in avals)
+
+    @jax.custom_vjp
+    def _send(*leaves):
+        def _do(*concrete):
+            comm.send(list(concrete), dest, tag=tag)
+            return jnp.zeros((), jnp.float32)
+
+        return _io_callback(_do, jax.ShapeDtypeStruct((), jnp.float32),
+                            *leaves)
+
+    def _fwd(*leaves):
+        return _send(*leaves), None
+
+    def _bwd(_, g_token):
+        del g_token  # the real gradient comes from the peer
+
+        def _do():
+            gl = comm.recv(dest, tag=_grad_tag(tag))
+            return tuple(jnp.asarray(g) for g in gl)
+
+        return _io_callback(_do, shapes)
+
+    _send.defvjp(_fwd, _bwd)
+    return _send
+
+
+@functools.lru_cache(maxsize=None)
+def _recv_fn(comm, src: int, tag, avals):
+    shapes = tuple(jax.ShapeDtypeStruct(s, d) for (s, d) in avals)
+
+    @jax.custom_vjp
+    def _recv(anchor):
+        del anchor  # differentiation-path anchor; value unused
+
+        def _do():
+            got = comm.recv(src, tag=tag)
+            return tuple(jnp.asarray(g) for g in got)
+
+        return _io_callback(_do, shapes)
+
+    def _fwd(anchor):
+        return _recv(anchor), jnp.zeros_like(anchor)
+
+    def _bwd(zero, gs):
+        def _do(*concrete):
+            comm.send(list(concrete), src, tag=_grad_tag(tag))
+            return jnp.zeros((), jnp.float32)
+
+        tok = _io_callback(_do, jax.ShapeDtypeStruct((), jnp.float32),
+                           *gs)
+        # the anchor's cotangent is numerically zero, but runs through
+        # the transport's token so the send cannot be pruned
+        return (zero + (tok * 0.0).astype(zero.dtype),)
+
+    _recv.defvjp(_fwd, _bwd)
+    return _recv
+
+
+def _aval_sig(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    return tuple(
+        (tuple(jnp.shape(l)), jnp.result_type(l)) for l in leaves)
+
+
+def eager_send(x, communicator, rank: int, tag=0):
+    """Differentiable eager send of pytree ``x`` to ``rank``.
+
+    Returns a scalar *delegate token* carrying the autograd edge — tie it
+    into your local loss (add it, or via
+    :func:`~chainermn_tpu.functions.pseudo_connect`-style summation) so
+    backward visits the transfer; its forward value is 0.0. In backward,
+    the matching ``eager_recv``'s vjp on the peer sends the output
+    gradient back and this token's vjp delivers it to ``x``'s producers.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    fn = _send_fn(communicator, int(rank), tag, _aval_sig(x))
+    return fn(*leaves)
+
+
+def eager_recv(communicator, rank: int, shape=None, dtype=None,
+               like=None, anchor=None, tag=0):
+    """Differentiable eager receive from ``rank``.
+
+    Declare the incoming value: either ``shape``+``dtype`` for a single
+    array or ``like=`` an example pytree (only shapes/dtypes are read).
+
+    ``anchor`` (REQUIRED for gradients to flow): any array on your
+    differentiation path — a parameter, an upstream activation, or the
+    token from a prior :func:`eager_send`. The transfer is threaded
+    through it so ``jax.grad`` provably visits the vjp (which sends the
+    incoming gradient back to ``rank`` on a dedicated channel); its
+    value is not read and its cotangent contribution is zero. Without
+    an anchor the receive is FORWARD-ONLY — fine for eval/serving
+    loops, but differentiating around it silently treats the received
+    value as a constant (JAX transposes only input→output paths) and
+    the sending rank's backward will deadlock waiting for a gradient
+    that never comes. MIGRATION.md covers the pattern.
+    """
+    if like is None:
+        if shape is None or dtype is None:
+            raise ValueError(
+                "eager_recv needs the incoming aval: pass shape= and "
+                "dtype=, or like= an example pytree (the reference's "
+                "runtime _MessageType negotiation has no traced-world "
+                "equivalent)")
+        like = jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    fn = _recv_fn(communicator, int(rank), tag, _aval_sig(like))
+    anchor = jnp.zeros((), jnp.float32) if anchor is None \
+        else jnp.asarray(anchor)
+    out = fn(anchor)
+    return jax.tree_util.tree_unflatten(treedef, list(out))

@@ -1,0 +1,133 @@
+"""Eager differentiable P2P: gradient round-trip across REAL processes.
+
+Reference: chainermn/functions/point_to_point_communication.py run under
+``mpiexec -n 2`` (SURVEY.md §4) — rank 0 sends a mid-forward activation,
+rank 1 computes the loss, and ``loss.backward()`` transports the
+gradient back. Here the same script shape runs under ``jax.grad`` with
+the custom_vjp/io_callback eager path (functions/eager_p2p.py)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mp_harness import assert_all_ok, run_workers
+
+_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax.numpy as jnp
+import numpy as np
+import chainermn_tpu
+from chainermn_tpu.functions import eager_recv, eager_send
+
+comm = chainermn_tpu.create_communicator("xla")
+assert comm.inter_size == 2
+
+x = jnp.asarray(np.arange(1.0, 7.0, dtype=np.float32).reshape(2, 3))
+
+# -- the reference's model-parallel MNIST shape: rank 0 owns the first
+# half of the model, rank 1 the second; one eager send forward, one
+# gradient transport backward ------------------------------------------
+
+if proc_id == 0:
+    def f(w):
+        h = w * x                       # "first half of the model"
+        token = eager_send(h, comm, rank=1)
+        return token                    # local loss = dangling delegate
+
+    w = jnp.float32(3.0)
+    loss, dw = jax.value_and_grad(f)(w)
+    # d(loss1)/dh = 2h/n = 2*w*x/6 ; dw = sum(2*w*x*x)/6
+    expect = float(np.sum(2.0 * 3.0 * np.asarray(x) ** 2) / x.size)
+    np.testing.assert_allclose(float(dw), expect, rtol=1e-6)
+    assert float(loss) == 0.0  # the token's forward value is zero
+else:
+    def g(scale):
+        h = eager_recv(comm, rank=0, shape=(2, 3), dtype=jnp.float32,
+                       anchor=scale)
+        return jnp.mean((scale * h) ** 2)
+
+    scale = jnp.float32(1.0)
+    loss, dscale = jax.value_and_grad(g)(scale)
+    hval = 3.0 * np.asarray(x)
+    np.testing.assert_allclose(float(loss), float(np.mean(hval ** 2)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        float(dscale), float(np.mean(2.0 * hval ** 2)), rtol=1e-6)
+
+# -- round 2: same channel reused (sequence numbers advance), pytree
+# payload, recv declared via like= --------------------------------------
+
+tree = {"a": jnp.ones((2,), jnp.float32),
+        "b": jnp.full((1, 2), 2.0, jnp.float32)}
+if proc_id == 0:
+    def f2(s):
+        scaled = jax.tree_util.tree_map(lambda l: s * l, tree)
+        return eager_send(scaled, comm, rank=1)
+
+    _, ds = jax.value_and_grad(f2)(jnp.float32(2.0))
+    # peer loss = sum of all leaves; d/ds = sum(tree leaves) = 2 + 4
+    np.testing.assert_allclose(float(ds), 6.0, rtol=1e-6)
+else:
+    def g2(a):
+        got = eager_recv(comm, rank=0, like=tree, anchor=a)
+        return sum(jnp.sum(l) for l in jax.tree_util.tree_leaves(got))
+
+    loss2 = jax.value_and_grad(g2)(jnp.float32(0.0))[0]
+    # s=2 scaled tree: a -> 2*[1,1] (sum 4), b -> 2*[[2,2]] (sum 8)
+    np.testing.assert_allclose(float(loss2), 12.0, rtol=1e-6)
+
+# -- bidirectional exchange (the reference suite's deadlock-regression
+# pattern): 0 sends to 1 AND receives from 1, globally consistent order -
+
+me, peer = proc_id, 1 - proc_id
+val = jnp.float32([float(me + 1)] * 4)
+
+def h(v):
+    if me == 0:
+        tok = eager_send(v, comm, rank=1)
+        other = eager_recv(comm, rank=1, shape=(4,), dtype=jnp.float32,
+                           anchor=tok)
+    else:
+        other = eager_recv(comm, rank=0, shape=(4,), dtype=jnp.float32,
+                           anchor=v)
+        tok = eager_send(v, comm, rank=0)
+        other = other + tok  # tie the dangling send into the loss
+    return jnp.sum(other * v)
+
+lossb, dv = jax.value_and_grad(h)(val)
+# loss_me = sum(other*v): d/dv_me = other + (grad from peer's recv of my
+# value) = peer_val + peer_val
+np.testing.assert_allclose(
+    np.asarray(dv), np.full((4,), 2.0 * (peer + 1)), rtol=1e-6)
+
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(120)
+def test_eager_p2p_grad_round_trip(tmp_path):
+    procs, outs = run_workers(_WORKER, tmp_path, timeout=110)
+    assert_all_ok(procs, outs)
+
+
+def test_eager_recv_requires_aval():
+    import chainermn_tpu
+    from chainermn_tpu.functions import eager_recv
+
+    comm = chainermn_tpu.create_communicator("xla")
+    with pytest.raises(ValueError, match="shape"):
+        eager_recv(comm, rank=1)
