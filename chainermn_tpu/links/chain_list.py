@@ -25,10 +25,12 @@ to_hetero_pipeline` lowers the same registry onto the micro-batched 1F1B
 pipeline (parallel/hetero_pipeline.py): per-stage parameters sharded over
 the mesh axis (each device holds only its stage) and the fill/drain bubble
 amortized over micro-batches — true memory AND compute scaling, beyond the
-reference. Branching graphs stay on this executor, behind an EXPLICIT
-replicated-parameter budget: past it, ``apply`` refuses with guidance
-(lower linearly, TP-shard the big stages, or raise the budget knowingly)
-instead of becoming the silent OOM (VERDICT r2 #7).
+reference. BRANCHING graphs lower with :meth:`MultiNodeChainList.
+to_branching_pipeline` onto the DAG schedule (parallel/branching.py):
+same per-device stage params, same-depth branches computing in the same
+tick. The replicated executor keeps an EXPLICIT parameter budget: past
+it, ``apply`` refuses and points at the matching lowering instead of
+becoming the silent OOM (VERDICT r2 #7).
 """
 
 from __future__ import annotations
@@ -201,8 +203,10 @@ class MultiNodeChainList:
             "this graph is not in canonical linear 0→1→…→S-1 form: if "
             "it is actually a reordered linear chain, relabel the ranks "
             "and lower with to_hetero_pipeline(); if it genuinely "
-            "branches, the 1F1B lowering does not apply — shard the "
-            "large stages over a second mesh axis "
+            "branches, lower it with to_branching_pipeline() — the DAG "
+            "schedule gives each device only its own stage's params "
+            "(parallel/branching.py); alternatively TP-shard the large "
+            "stages over a second mesh axis "
             "(parallel/tensor_parallel.py) or raise the budget "
             "explicitly via MultiNodeChainList(comm, "
             "replicated_param_budget_bytes=...) if replication is "
@@ -274,3 +278,52 @@ class MultiNodeChainList:
         return HeteroPipeline(stage_defs, sample_mb,
                               axis_name=self.comm.axis_names[0],
                               **pipe_kwargs)
+
+    def to_branching_pipeline(self, params: Sequence[Any], sample_mb,
+                              **pipe_kwargs):
+        """Lower a BRANCHING (DAG) chain graph onto the scheduled
+        pipeline executor — per-device stage parameters for the graphs
+        ``to_hetero_pipeline`` rejects.
+
+        Requirements (checked): stage ``i`` declared with ``rank=i``
+        (device ``i`` runs stage ``i``; relabel if needed — the
+        declaration order is already topological because
+        ``_stage_inputs`` demands producers come first); exactly one
+        output stage (``rank_out=()``) — its output feeds the caller's
+        ``loss_fn``; every stage is multi-input-capable via its module's
+        ``apply(p, *xs)``.
+
+        Returns a :class:`~chainermn_tpu.parallel.BranchingPipeline`:
+        shard ``pack_params()`` over the communicator's axis and run
+        :func:`~chainermn_tpu.parallel.branching_pipeline_value_and_grad`
+        inside shard_map. Each device materializes ONLY its own stage —
+        the memory scaling the replicated ``apply()`` budget-refuses
+        (reference: branching MultiNodeChainList graphs,
+        chainermn/links/multi_node_chain_list.py).
+        """
+        from chainermn_tpu.parallel import BranchingPipeline
+
+        for i, st in enumerate(self._stages):
+            if st.rank != i:
+                raise ValueError(
+                    f"stage {i} declared rank {st.rank}: the pipeline "
+                    "lowering places stage i on device i — relabel ranks "
+                    "to the declaration order")
+        rank_to_idx = {st.rank: i for i, st in enumerate(self._stages)}
+        preds = []
+        for st in self._stages:
+            preds.append(tuple(rank_to_idx[r] for r in st.rank_in))
+
+        def stage_fn(module):
+            if hasattr(module, "apply"):
+                return lambda p, *xs: module.apply(p, *xs)
+            return lambda p, *xs: module(
+                p if jax.tree_util.tree_leaves(p) else None, *xs)
+
+        stage_defs = [
+            (stage_fn(st.module), p if p is not None else {}, pr)
+            for st, p, pr in zip(self._stages, params, preds)
+        ]
+        return BranchingPipeline(stage_defs, sample_mb,
+                                 axis_name=self.comm.axis_names[0],
+                                 **pipe_kwargs)

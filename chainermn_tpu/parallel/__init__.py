@@ -1,3 +1,8 @@
+from .branching import (
+    BranchingPipeline,
+    branching_pipeline_apply,
+    branching_pipeline_value_and_grad,
+)
 from .expert_parallel import ExpertParallelMLP, switch_dispatch
 from .hetero_pipeline import (
     HeteroPipeline,
@@ -37,6 +42,9 @@ __all__ = [
     "HeteroPipeline",
     "hetero_pipeline_1f1b_value_and_grad",
     "hetero_pipeline_apply",
+    "BranchingPipeline",
+    "branching_pipeline_value_and_grad",
+    "branching_pipeline_apply",
     "ColumnParallelDense",
     "RowParallelDense",
     "TensorParallelMLP",

@@ -1,0 +1,261 @@
+"""Branching (DAG) pipeline: per-device stage params for tree graphs.
+
+Oracle (reference suite style): the scheduled DAG pipeline must match a
+sequential walk of the same graph — loss AND per-stage gradients —
+including fan-out (one producer, two consumers), fan-in (a join with two
+inputs), and uneven branch depths (a skip edge exercising the delay
+lines). Reference: branching MultiNodeChainList graphs
+(chainermn/links/multi_node_chain_list.py, SURVEY.md §2.4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.parallel import (
+    BranchingPipeline,
+    branching_pipeline_apply,
+    branching_pipeline_value_and_grad,
+)
+
+MB, DIN = 2, 6
+
+
+def _lin(name_seed, din, dout, scale=0.4):
+    rs = np.random.RandomState(name_seed)
+    return {"w": jnp.asarray(rs.randn(din, dout) * scale, jnp.float32),
+            "b": jnp.asarray(rs.randn(dout) * 0.1, jnp.float32)}
+
+
+def _lin_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _join_fn(p, a, b):
+    return jnp.tanh(a @ p["wa"] + b @ p["wb"])
+
+
+def _loss_fn(y, tgt):
+    return jnp.mean((y - tgt) ** 2)
+
+
+def _diamond(seed=0):
+    """s0 root → (s1 [wide], s2 [narrow]) → s3 join."""
+    rs = np.random.RandomState(seed)
+    s0 = _lin(seed + 1, DIN, 8)
+    s1 = _lin(seed + 2, 8, 10)           # wider branch
+    s2 = _lin(seed + 3, 8, 4)            # narrower branch
+    s3 = {"wa": jnp.asarray(rs.randn(10, 3) * 0.3, jnp.float32),
+          "wb": jnp.asarray(rs.randn(4, 3) * 0.3, jnp.float32)}
+    return [
+        (_lin_fn, s0, ()),
+        (_lin_fn, s1, (0,)),
+        (_lin_fn, s2, (0,)),
+        (_join_fn, s3, (1, 2)),
+    ]
+
+
+def _uneven(seed=0):
+    """root → a → b ─┐
+       root ─────→ c ─┴→ join   (edge c→join has slack 2: delay line)."""
+    rs = np.random.RandomState(seed)
+    s0 = _lin(seed + 1, DIN, 8)
+    sa = _lin(seed + 2, 8, 8)
+    sb = _lin(seed + 3, 8, 6)
+    sc = _lin(seed + 4, 8, 5)
+    sj = {"wa": jnp.asarray(rs.randn(6, 3) * 0.3, jnp.float32),
+          "wb": jnp.asarray(rs.randn(5, 3) * 0.3, jnp.float32)}
+    return [
+        (_lin_fn, s0, ()),
+        (_lin_fn, sa, (0,)),
+        (_lin_fn, sc, (0,)),     # shallow branch, waits for deep one
+        (_lin_fn, sb, (1,)),
+        (_join_fn, sj, (3, 2)),
+    ]
+
+
+def _data(m, dout=3, seed=1):
+    rs = np.random.RandomState(seed)
+    xs = rs.randn(m, MB, DIN).astype(np.float32)
+    ys = rs.randn(m, MB, dout).astype(np.float32)
+    return jnp.asarray(xs), jnp.asarray(ys)
+
+
+def _sequential_value_and_grad(stage_defs, xs, ys):
+    fns = [f for f, _, _ in stage_defs]
+    preds = [pr for _, _, pr in stage_defs]
+    head = [s for s in range(len(stage_defs))
+            if all(s not in p for p in preds)][-1]
+
+    def forward(params, x):
+        outs = {}
+        for s, (fn, pr) in enumerate(zip(fns, preds)):
+            ins = [x] if not pr else [outs[p] for p in pr]
+            outs[s] = fn(params[s], *ins)
+        return outs[head]
+
+    def loss(params):
+        per = jax.vmap(lambda x, y: _loss_fn(forward(params, x), y))(
+            xs, ys)
+        return jnp.mean(per)
+
+    params = [p for _, p, _ in stage_defs]
+    return jax.value_and_grad(loss)(params)
+
+
+def _run_pipeline(pipe, stage_defs, xs, ys, n_dev):
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("stage",))
+    packed = pipe.pack_params()
+    xs_wire = pipe.encode_inputs(xs)
+
+    def run(stacked, xw, ys):
+        my = jax.tree_util.tree_map(lambda l: l[0], stacked)
+        loss, flat_grads = branching_pipeline_value_and_grad(
+            pipe, _loss_fn, my, xw, ys)
+        return loss, flat_grads[None]
+
+    loss, flat_grads = jax.jit(shard_map(
+        run, mesh=mesh, in_specs=(P("stage"), P(), P()),
+        out_specs=(P(), P("stage"))))(packed, xs_wire, ys)
+    return loss, pipe.unpack_grads(flat_grads)
+
+
+@pytest.mark.parametrize("m", [3, 6])
+def test_diamond_matches_sequential(m):
+    stage_defs = _diamond()
+    xs, ys = _data(m)
+    pipe = BranchingPipeline(
+        stage_defs, jax.ShapeDtypeStruct((MB, DIN), jnp.float32),
+        axis_name="stage")
+    assert pipe.depth == [0, 1, 1, 2]       # branches overlap
+    assert pipe.head == 3 and pipe.K == 2
+    loss, grads = _run_pipeline(pipe, stage_defs, xs, ys, 4)
+    ref_loss, ref_grads = _sequential_value_and_grad(stage_defs, xs, ys)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for g, rg in zip(grads, ref_grads):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+            g, rg)
+
+
+def test_uneven_depths_use_delay_lines():
+    stage_defs = _uneven()
+    xs, ys = _data(4)
+    pipe = BranchingPipeline(
+        stage_defs, jax.ShapeDtypeStruct((MB, DIN), jnp.float32),
+        axis_name="stage")
+    assert pipe.depth == [0, 1, 1, 2, 3]
+    assert pipe.max_slack == 2              # c→join crosses two levels
+    loss, grads = _run_pipeline(pipe, stage_defs, xs, ys, 5)
+    ref_loss, ref_grads = _sequential_value_and_grad(stage_defs, xs, ys)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for g, rg in zip(grads, ref_grads):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+            g, rg)
+
+
+def test_forward_apply_matches_sequential():
+    stage_defs = _diamond()
+    xs, _ = _data(5)
+    pipe = BranchingPipeline(
+        stage_defs, jax.ShapeDtypeStruct((MB, DIN), jnp.float32),
+        axis_name="stage")
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("stage",))
+    packed = pipe.pack_params()
+    xs_wire = pipe.encode_inputs(xs)
+
+    def run(stacked, xw):
+        my = jax.tree_util.tree_map(lambda l: l[0], stacked)
+        return branching_pipeline_apply(pipe, my, xw)
+
+    outs = jax.jit(shard_map(
+        run, mesh=mesh, in_specs=(P("stage"), P()),
+        out_specs=P()))(packed, xs_wire)
+
+    fns = [f for f, _, _ in stage_defs]
+    params = [p for _, p, _ in stage_defs]
+    for j in range(xs.shape[0]):
+        h0 = fns[0](params[0], xs[j])
+        ref = _join_fn(params[3], fns[1](params[1], h0),
+                       fns[2](params[2], h0))
+        np.testing.assert_allclose(np.asarray(outs[j]), np.asarray(ref),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_validation_errors():
+    defs = _diamond()
+    sd = jax.ShapeDtypeStruct((MB, DIN), jnp.float32)
+    # two sinks
+    bad = [defs[0], defs[1], defs[2]]
+    with pytest.raises(ValueError, match="exactly one output"):
+        BranchingPipeline(bad, sd, axis_name="stage")
+    # forward reference
+    bad = [(defs[0][0], defs[0][1], (1,)), defs[1], defs[2], defs[3]]
+    with pytest.raises(ValueError, match="topological"):
+        BranchingPipeline(bad, sd, axis_name="stage")
+
+
+def test_chain_list_budget_refusal_then_branching_lowering():
+    """THE VERDICT r4 #3 criterion: a branching MultiNodeChainList whose
+    params exceed the replicated budget refuses apply() with guidance,
+    then TRAINS via to_branching_pipeline with per-device stage params,
+    matching the sequential oracle."""
+    from chainermn_tpu.comm.xla import XlaCommunicator
+    from chainermn_tpu.links import MultiNodeChainList
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("stage",))
+    comm = XlaCommunicator(mesh=mesh)
+    # tiny budget so the test stays fast while exercising the real path
+    cl = MultiNodeChainList(comm, replicated_param_budget_bytes=512)
+
+    class _Mod:
+        def __init__(self, fn, p):
+            self.fn, self.p = fn, p
+
+        def init(self, rng, *xs):
+            return self.p
+
+        def apply(self, p, *xs):
+            return self.fn(p, *xs)
+
+    defs = _diamond()
+    total = sum(l.size * 4 for _, p, _ in defs
+                for l in jax.tree_util.tree_leaves(p))
+    assert total > 512, "params must exceed the budget"
+    cl.add_link(_Mod(defs[0][0], defs[0][1]), rank=0, rank_in=None,
+                rank_out=(1, 2))
+    cl.add_link(_Mod(defs[1][0], defs[1][1]), rank=1, rank_in=0,
+                rank_out=3)
+    cl.add_link(_Mod(defs[2][0], defs[2][1]), rank=2, rank_in=0,
+                rank_out=3)
+    cl.add_link(_Mod(defs[3][0], defs[3][1]), rank=3, rank_in=(1, 2),
+                rank_out=None)
+    params = [p for _, p, _ in defs]
+
+    # the replicated executor refuses, pointing at the branching lowering
+    with pytest.raises(ValueError, match="to_branching_pipeline"):
+        cl.apply(params, jnp.zeros((MB, DIN), jnp.float32))
+
+    # the lowering trains and matches the oracle
+    pipe = cl.to_branching_pipeline(
+        params, jax.ShapeDtypeStruct((MB, DIN), jnp.float32))
+    xs, ys = _data(4)
+    loss, grads = _run_pipeline(pipe, defs, xs, ys, 4)
+    ref_loss, ref_grads = _sequential_value_and_grad(defs, xs, ys)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for g, rg in zip(grads, ref_grads):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+            g, rg)
+
+    # and the state is genuinely sharded: each device's slot is one
+    # stage's padded params
+    assert pipe.pack_params().shape == (4, pipe.param_elems)
