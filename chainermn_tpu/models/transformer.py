@@ -43,7 +43,7 @@ from chainermn_tpu.parallel.tensor_parallel import (
     vocab_parallel_cross_entropy,
 )
 from chainermn_tpu.parallel.ulysses import ulysses_attention
-from chainermn_tpu.ops.rotary import apply_rope
+from chainermn_tpu.ops.rotary import apply_rope, apply_rope_bhld
 
 __all__ = ["TransformerLM", "TransformerBlock", "generate",
            "lm_loss_with_aux", "tp_lm_loss"]
@@ -80,6 +80,14 @@ class TransformerBlock(nn.Module):
     moe_top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
     decode: bool = False               # single-token KV-cache decoding
     max_len: int = 2048                # cache capacity when decode=True
+    qkv_layout: str = "blhd"           # 'bhld': head-major attention
+    #                                    tensors end to end — projection
+    #                                    einsums emit [B, H, L, D], the
+    #                                    flash kernels consume it as a free
+    #                                    reshape, and the ~15 ms/step of
+    #                                    layout-pivot copies disappear
+    #                                    (docs/lm_roofline.md §5; flash
+    #                                    path only, no decode/tp)
 
     @nn.compact
     def __call__(self, x, pos_offset=0):
@@ -88,6 +96,9 @@ class TransformerBlock(nn.Module):
 
         h = nn.LayerNorm(dtype=self.dtype)(x)
         hkv = self.n_kv_heads or self.n_heads
+        if self.qkv_layout == "bhld":
+            x = self._bhld_attention(x, h, b, l, d, dh, hkv, pos_offset)
+            return self._ffn(x, b, l, d)
         n_heads, n_kv = self.n_heads, hkv  # per-shard head counts below
         if self.tp_axis is not None:
             # Megatron attention: heads sharded over the model axis —
@@ -217,7 +228,53 @@ class TransformerBlock(nn.Module):
         else:
             x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype,
                              name="attn_out")(att)
+        return self._ffn(x, b, l, d)
 
+    def _bhld_attention(self, x, h, b, l, d, dh, hkv, pos_offset):
+        """Head-major attention: projections emit [B, H, L, Dh] directly
+        (XLA folds the permutation into the matmul — measured free,
+        2026-07-31), the flash kernel consumes/produces that layout with
+        zero-cost reshapes, and the output projection contracts (h, e)
+        straight back to [B, L, D]. No transpose copy exists anywhere on
+        the attention path, forward or backward."""
+        if (self.decode or self.tp_axis is not None
+                or self.attention != "flash"):
+            raise ValueError(
+                "qkv_layout='bhld' supports the plain flash attention "
+                "path (no decode, no tp_axis); use the default 'blhd' "
+                "layout elsewhere")
+        init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", in_axis=0)
+        hdt = h.astype(self.dtype)
+        if hkv == self.n_heads:
+            w = self.param("qkv_bhld", init,
+                           (d, 3, self.n_heads, dh), jnp.float32)
+            y = jnp.einsum("bld,dthe->tbhle", hdt, w.astype(self.dtype))
+            q, k, v = y[0], y[1], y[2]
+        else:
+            wq = self.param("q_bhld", init,
+                            (d, self.n_heads, dh), jnp.float32)
+            wkv = self.param("kv_bhld", init,
+                             (d, 2, hkv, dh), jnp.float32)
+            q = jnp.einsum("bld,dhe->bhle", hdt, wq.astype(self.dtype))
+            ykv = jnp.einsum("bld,dthe->tbhle", hdt,
+                             wkv.astype(self.dtype))
+            k, v = ykv[0], ykv[1]
+        if self.pos_emb == "rope":
+            pos = pos_offset + jnp.arange(l)
+            q = apply_rope_bhld(q, pos, self.rope_theta)
+            k = apply_rope_bhld(k, pos, self.rope_theta)
+        bq, bk = self.attention_blocks or DEFAULT_BLOCKS
+        att = flash_attention(q, k, v, causal=True, block_q=bq,
+                              block_k=bk, window=self.attention_window,
+                              layout="bhld")
+        wo = self.param("attn_out_bhld", nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", in_axis=(0, 1)),
+            (self.n_heads, dh, d), jnp.float32)
+        return x + jnp.einsum("bhle,hed->bld", att.astype(self.dtype),
+                              wo.astype(self.dtype))
+
+    def _ffn(self, x, b, l, d):
         h = nn.LayerNorm(dtype=self.dtype)(x)
         if self.tp_axis is not None:
             x = x + TensorParallelMLP(self.d_ff, self.d_model, self.tp_axis,
@@ -275,6 +332,8 @@ class TransformerLM(nn.Module):
     capacity_factor: float = 1.25
     moe_top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
     decode: bool = False               # single-token KV-cache decoding
+    qkv_layout: str = "blhd"           # 'bhld': pivot-free head-major
+    #                                    attention (see TransformerBlock)
     remat: bool = False                # rematerialize each block's
     #                                    activations in backward (trade
     #                                    FLOPs for HBM at long L)
@@ -312,6 +371,7 @@ class TransformerLM(nn.Module):
                 capacity_factor=self.capacity_factor,
                 moe_top_k=self.moe_top_k,
                 decode=self.decode, max_len=self.max_len,
+                qkv_layout=self.qkv_layout,
                 name=f"block_{i}")(x, pos_offset=pos_offset)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.return_hidden:

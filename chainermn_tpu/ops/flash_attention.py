@@ -577,18 +577,31 @@ def _reference(q, k, v, causal, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 9, 10, 11))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCKS[0],
                     block_k: int = DEFAULT_BLOCKS[1],
                     interpret: Optional[bool] = None,
                     segment_ids=None, window: Optional[int] = None,
-                    bwd_blocks: Optional[Tuple[int, int]] = None):
+                    bwd_blocks: Optional[Tuple[int, int]] = None,
+                    layout: str = "blhd"):
     """Fused blockwise attention. q: [B, Lq, H, D]; k, v: [B, Lk, Hkv, D]
     → [B, Lq, H, D]. Hkv < H is GQA/MQA (H % Hkv == 0, repeat-interleave
     head sharing) — the shared KV is never replicated in HBM; the sharing
     lives in the kernel's block index maps.
+
+    ``layout="bhld"``: q [B, H, Lq, D]; k, v [B, Hkv, Lk, D] →
+    [B, H, Lq, D] — the PIVOT-FREE wire format. The kernels natively
+    consume [B*H, L, D]; from bhld that is a zero-cost reshape, whereas
+    from the default blhd layout every call transposes q/k/v in and the
+    output (plus all four gradients) back out — ~15 ms/step of HBM
+    copies on the 135M LM (docs/lm_roofline.md §5). A model that keeps
+    its attention tensors head-major (projection einsums emit
+    [B, H, L, D] directly — XLA folds the permutation into the matmul
+    for free, measured 2026-07-31) pays zero layout traffic end to end;
+    see ``TransformerLM(qkv_layout="bhld")``.
 
     ``segment_ids`` enables packed-sequence masking (the TPU-native answer
     to the reference seq2seq's variable-length batching — static shapes,
@@ -613,7 +626,7 @@ def flash_attention(q, k, v, causal: bool = False,
     any length works; explicit blocks are only a tuning knob.
     """
     return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                      segment_ids, window, bwd_blocks)[0]
+                      segment_ids, window, bwd_blocks, layout)[0]
 
 
 def _to3(x):
@@ -641,12 +654,14 @@ def _pad_rows(x, n):
     return jnp.pad(x, ((0, 0), (0, n)) + ((0, 0),) * (x.ndim - 2))
 
 
-def _apply_padding(q, k, v, segment_ids, block_q, block_k):
+def _apply_padding(q, k, v, segment_ids, block_q, block_k, batch=None):
     """Pad Lq/Lk to TPU-legal block lengths, masking the tail with
     segment ids (query pad −1, kv pad −2: matches nothing, including each
     other). Returns (q, k, v, effective_segment_ids, lq_pad, lk_pad) with
-    the ORIGINAL arrays when no padding is needed."""
-    b, lq = q.shape[0], q.shape[1]
+    the ORIGINAL arrays when no padding is needed. Works on blhd 4D
+    arrays or (with ``batch`` given, since dim 0 is then B*H) on the
+    kernel-native 3D [B*H, L, D] arrays — dim 1 is L either way."""
+    b, lq = (batch if batch is not None else q.shape[0]), q.shape[1]
     lk = k.shape[1]
     lq_p, lk_p = _padded_len(block_q, lq), _padded_len(block_k, lk)
     if lq_p == lq and lk_p == lk:
@@ -668,14 +683,37 @@ def _apply_padding(q, k, v, segment_ids, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               segment_ids=None, window=None, bwd_blocks=None):
+               segment_ids=None, window=None, bwd_blocks=None,
+               layout="blhd"):
     if window is not None and not causal:
         raise ValueError("window (sliding-window attention) requires "
                          "causal=True")
+    if layout not in ("blhd", "bhld"):
+        raise ValueError(f"layout must be 'blhd' or 'bhld', got "
+                         f"{layout!r}")
     block_k = _window_cap(block_k, window)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if layout == "bhld":
+        # head-major wire format: [B, H, L, D] ↔ [B*H, L, D] is a free
+        # reshape — the transpose copies of the blhd path never happen
+        b, h, lq, d = q.shape
+        hk = k.shape[1]
+        if h % hk:
+            raise ValueError(
+                f"query heads ({h}) must be a multiple of kv heads ({hk})")
+        qp, kp, vp, segs_eff, _, _ = _apply_padding(
+            q.reshape(b * h, lq, d), k.reshape(b * hk, -1, d),
+            v.reshape(b * hk, -1, d), segment_ids, block_q, block_k,
+            batch=b)
+        segs = _norm_segs(segs_eff, qp.shape[1], kp.shape[1])
+        out3, lse3 = _flash_fwd_3d(
+            qp, kp, vp,
+            causal=causal, scale=scale, block_q=block_q, block_k=block_k,
+            interpret=interpret, hq=h, hkv=hk, segs=segs, window=window)
+        out = out3.reshape(b, h, qp.shape[1], d)[:, :, :lq]
+        return out, (q, k, v, out, lse3, segment_ids)
     b, lq, h, d = q.shape
     hk = k.shape[2]
     if h % hk:
@@ -694,7 +732,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
-               bwd_blocks, res, g):
+               bwd_blocks, layout, res, g):
     # blockwise Pallas backward: P is rebuilt per tile from the forward's
     # logsumexp; [L, L] never touches HBM (the materializing fallback
     # allocated 8 GB f32 score tensors at b=64/L=2048/h=8)
@@ -708,6 +746,42 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
         interpret = jax.default_backend() != "tpu"
     block_k = _window_cap(block_k, window)
     sc = scale if scale is not None else q.shape[-1] ** -0.5
+    if layout == "bhld":
+        # head-major: reshapes only, no transposes anywhere in backward
+        b, h, lq, d = q.shape
+        lk, hk = k.shape[2], k.shape[1]
+        qp, kp, vp, segs_eff, pq, pk = _apply_padding(
+            q.reshape(b * h, lq, d), k.reshape(b * hk, lk, d),
+            v.reshape(b * hk, lk, d), segment_ids, block_q, block_k,
+            batch=b)
+        lq_p, lk_p = lq + pq, lk + pk
+        if lse3.shape[1] != lq_p:
+            raise ValueError(
+                f"bwd_blocks pad Lq to {lq_p} but the forward's lse is "
+                f"{lse3.shape[1]} long; pick bwd blocks with the same "
+                "padded length (block-size multiples of the forward's)")
+        segs = _norm_segs(segs_eff, lq_p, lk_p)
+        g3 = g.reshape(b * h, lq, d)
+        gp = _pad_rows(g3, pq) if pq else g3
+        # D_i = Σ_d dO_i · O_i — rowwise, already head-major: no pivot
+        dr3 = jnp.sum(g3.astype(jnp.float32)
+                      * out.reshape(b * h, lq, d).astype(jnp.float32),
+                      axis=-1)
+        if pq:
+            dr3 = _pad_rows(dr3, pq)
+        dq3, dk3, dv3 = _flash_bwd_3d(
+            qp, kp, vp, gp, lse3, dr3,
+            causal=causal, scale=sc, block_q=block_q, block_k=block_k,
+            interpret=interpret, hq=h, hkv=hk, segs=segs, window=window)
+        if hk < h:
+            grp = h // hk
+            dk3 = dk3.reshape(b * hk, grp, lk_p, d).sum(1)
+            dv3 = dv3.reshape(b * hk, grp, lk_p, d).sum(1)
+        dsegs = jax.tree_util.tree_map(
+            lambda s: np.zeros(s.shape, jax.dtypes.float0), segment_ids)
+        return (dq3[:, :lq].reshape(b, h, lq, d),
+                dk3[:, :lk].reshape(b, hk, lk, d),
+                dv3[:, :lk].reshape(b, hk, lk, d), dsegs)
     b, lq, h, d = q.shape
     lk, hk = k.shape[1], k.shape[2]
     qp, kp, vp, segs_eff, pq, pk = _apply_padding(

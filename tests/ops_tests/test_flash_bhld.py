@@ -1,0 +1,170 @@
+"""bhld (head-major, pivot-free) flash wire format vs the default blhd.
+
+The two layouts share every kernel, grid, and tile schedule — bhld just
+skips the [B,L,H,D] ↔ [B*H,L,D] transpose copies (a free reshape from
+[B,H,L,D]). Outputs and gradients must agree to float-exactness on every
+feature: causal, GQA, segment packing, padded illegal lengths, sliding
+windows, and both backward kernel families. The per-head strided 4D
+BlockSpec alternative is REJECTED by the Pallas TPU lowering (last-two
+block dims must be (8,128)-divisible or equal to the array dims — H
+cannot be tiled to 1), which is why the pivot-free format is head-major
+rather than kernel-native 4D; see docs/lm_roofline.md §5."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.ops.flash_attention import flash_attention
+
+B, L, H, D = 2, 256, 4, 32
+BQ = BK = 128
+
+
+def _hm(x):
+    return jnp.transpose(x, (0, 2, 1, 3))  # [B,L,H,D] -> [B,H,L,D]
+
+
+def _qkv(hkv=H, lk=L, seed=0, dtype=jnp.float32):
+    rs = np.random.RandomState(seed)
+    q = jnp.asarray(rs.randn(B, L, H, D), dtype)
+    k = jnp.asarray(rs.randn(B, lk, hkv, D), dtype)
+    v = jnp.asarray(rs.randn(B, lk, hkv, D), dtype)
+    return q, k, v
+
+
+def _assert_fwd_and_grads_agree(q, k, v, rtol=1e-5, atol=1e-5, **kw):
+    o1 = flash_attention(q, k, v, block_q=BQ, block_k=BK, **kw)
+    o2 = flash_attention(_hm(q), _hm(k), _hm(v), block_q=BQ, block_k=BK,
+                         layout="bhld", **kw)
+    np.testing.assert_allclose(np.asarray(_hm(o2)), np.asarray(o1),
+                               rtol=rtol, atol=atol)
+
+    def loss1(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, block_q=BQ, block_k=BK, **kw) ** 2)
+
+    def loss2(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, block_q=BQ, block_k=BK, layout="bhld", **kw) ** 2)
+
+    g1 = jax.grad(loss1, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss2, argnums=(0, 1, 2))(_hm(q), _hm(k), _hm(v))
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(_hm(b)), np.asarray(a),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fwd_and_grads_agree(causal):
+    q, k, v = _qkv(seed=1)
+    _assert_fwd_and_grads_agree(q, k, v, causal=causal)
+
+
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_gqa_agrees(hkv):
+    q, k, v = _qkv(hkv=hkv, seed=2)
+    _assert_fwd_and_grads_agree(q, k, v, causal=True)
+
+
+def test_split_backward_agrees(monkeypatch):
+    """Push past the fused-backward VMEM gate so the split dq/dkv pair
+    runs under bhld too."""
+    import importlib
+
+    fa = importlib.import_module("chainermn_tpu.ops.flash_attention")
+    monkeypatch.setattr(fa, "_FUSED_BWD_MAX_LK", 0)
+    q, k, v = _qkv(seed=3)
+    _assert_fwd_and_grads_agree(q, k, v, causal=True)
+
+
+def test_segments_and_padding_agree():
+    # L=100 forces the padding path; segment ids force the packed mask
+    lq = 100
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(B, lq, H, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, lq, H, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, lq, H, D), jnp.float32)
+    segs = jnp.asarray(rs.randint(0, 3, size=(B, lq)), jnp.int32)
+    _assert_fwd_and_grads_agree(q, k, v, causal=True, segment_ids=segs)
+
+
+def test_sliding_window_agrees():
+    q, k, v = _qkv(seed=5)
+    _assert_fwd_and_grads_agree(q, k, v, causal=True, window=64)
+
+
+def test_bf16_agrees():
+    q, k, v = _qkv(seed=6, dtype=jnp.bfloat16)
+    o1 = flash_attention(q, k, v, causal=True, block_q=BQ, block_k=BK)
+    o2 = flash_attention(_hm(q), _hm(k), _hm(v), causal=True,
+                         block_q=BQ, block_k=BK, layout="bhld")
+    assert o2.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(_hm(o2), np.float32),
+                                  np.asarray(o1, np.float32))
+
+
+def test_bad_layout_raises():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="layout"):
+        flash_attention(q, k, v, layout="bdlh")
+
+
+def test_model_bhld_trains():
+    """TransformerLM(qkv_layout='bhld') learns; its attention params are
+    the head-major einsum kernels."""
+    from chainermn_tpu.models.transformer import (TransformerLM,
+                                                  lm_loss_with_aux)
+
+    V, Dm, Ll = 128, 32, 64
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randint(0, V, (2, Ll)), jnp.int32)
+    y = jnp.asarray(rs.randint(0, V, (2, Ll)), jnp.int32)
+    m = TransformerLM(vocab=V, d_model=Dm, n_heads=2, n_layers=2,
+                      d_ff=64, max_len=Ll, pos_emb="rope",
+                      attention="flash", qkv_layout="bhld")
+    p = m.init(jax.random.PRNGKey(0), x)["params"]
+    assert "qkv_bhld" in p["block_0"]
+    step = jax.jit(jax.value_and_grad(
+        lambda p: lm_loss_with_aux(m, p, x, y)[0]))
+    losses = []
+    for _ in range(10):
+        l, g = step(p)
+        losses.append(float(l))
+        p = jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g)
+    assert all(np.isfinite(v) for v in losses)
+    assert losses[-1] < 0.9 * losses[0], losses
+
+
+def test_model_bhld_gqa_trains():
+    from chainermn_tpu.models.transformer import (TransformerLM,
+                                                  lm_loss_with_aux)
+
+    V, Dm, Ll = 64, 32, 32
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randint(0, V, (2, Ll)), jnp.int32)
+    y = jnp.asarray(rs.randint(0, V, (2, Ll)), jnp.int32)
+    m = TransformerLM(vocab=V, d_model=Dm, n_heads=4, n_kv_heads=2,
+                      n_layers=1, d_ff=64, max_len=Ll, pos_emb="rope",
+                      attention="flash", qkv_layout="bhld")
+    p = m.init(jax.random.PRNGKey(0), x)["params"]
+    assert "q_bhld" in p["block_0"] and "kv_bhld" in p["block_0"]
+    l, g = jax.value_and_grad(
+        lambda p: lm_loss_with_aux(m, p, x, y)[0])(p)
+    assert np.isfinite(float(l))
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_model_bhld_rejects_decode():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    m = TransformerLM(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                      d_ff=64, max_len=32, decode=True,
+                      qkv_layout="bhld")
+    with pytest.raises(ValueError, match="bhld"):
+        m.init(jax.random.PRNGKey(0),
+               jnp.zeros((1, 8), jnp.int32))

@@ -25,7 +25,8 @@ import numpy as np
 
 
 def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
-            loss_kind="unfused", d_head=64, scan_k=4, n_iters=6):
+            loss_kind="unfused", d_head=64, scan_k=4, n_iters=6,
+            qkv_layout="blhd"):
     """Measure LM training throughput; returns (tokens_per_sec_per_chip,
     config dict). Importable — bench.py reuses this as its LM gate."""
     import jax
@@ -47,7 +48,8 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
     model = TransformerLM(
         vocab=32768, d_model=d_model, n_heads=d_model // d_head,
         n_layers=n_layers, d_ff=4 * d_model, max_len=seq_len,
-        pos_emb="rope", attention="flash", dtype=jnp.bfloat16)
+        pos_emb="rope", attention="flash", dtype=jnp.bfloat16,
+        qkv_layout=qkv_layout)
 
     toks = np.random.RandomState(0).randint(
         0, 32768, size=(batch * comm.size, seq_len + 1)).astype(np.int32)
@@ -95,7 +97,7 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
               "seq_len": seq_len, "batch_per_chip": batch,
               "d_head": d_head,
               "params_m": round(n_params / 1e6, 1),
-              "loss": loss_kind}
+              "loss": loss_kind, "qkv_layout": qkv_layout}
     return tokens_per_sec / comm.size, config
 
 
@@ -106,9 +108,11 @@ def main():
     batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
     loss_kind = sys.argv[5] if len(sys.argv) > 5 else "unfused"
     d_head = int(sys.argv[6]) if len(sys.argv) > 6 else 64
+    qkv_layout = sys.argv[7] if len(sys.argv) > 7 else "blhd"
     try:
         per_chip, config = measure(d_model, n_layers, seq_len, batch,
-                                   loss_kind, d_head)
+                                   loss_kind, d_head,
+                                   qkv_layout=qkv_layout)
     except ValueError as e:
         raise SystemExit(str(e))
     print(json.dumps({

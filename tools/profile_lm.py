@@ -30,6 +30,7 @@ import numpy as np
 
 D_MODEL, N_LAYERS, SEQ_LEN, BATCH = 768, 12, 2048, 8
 SCAN_K = 4
+QKV_LAYOUT = os.environ.get("PROFILE_QKV_LAYOUT", "blhd")
 
 
 def build_step():
@@ -46,7 +47,8 @@ def build_step():
     model = TransformerLM(
         vocab=32768, d_model=D_MODEL, n_heads=D_MODEL // 64,
         n_layers=N_LAYERS, d_ff=4 * D_MODEL, max_len=SEQ_LEN,
-        pos_emb="rope", attention="flash", dtype=jnp.bfloat16)
+        pos_emb="rope", attention="flash", dtype=jnp.bfloat16,
+        qkv_layout=QKV_LAYOUT)
     toks = np.random.RandomState(0).randint(
         0, 32768, size=(BATCH * comm.size, SEQ_LEN + 1)).astype(np.int32)
     params = comm.bcast_data(
