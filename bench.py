@@ -219,17 +219,20 @@ def main():
 
     # LM regression gate, folded into the SAME json line (extra keys are
     # harmless to any parser of the headline metric): the flash-attention
-    # + fused-CE LM path at its measured optimum (b=4, BASELINE.md) must
-    # stay above the 100k tok/s/chip floor — a kernel regression can no
-    # longer land with all driver-visible artifacts green. TPU-only: the
-    # Pallas kernels don't run on the CPU mesh.
+    # + fused-CE LM path at its measured optimum (b=4, head-major bhld
+    # layout — BASELINE.md r4) must stay above the 100k tok/s/chip floor
+    # — a kernel regression can no longer land with all driver-visible
+    # artifacts green. TPU-only: the Pallas kernels don't run on the CPU
+    # mesh.
     if "--no-lm" not in sys.argv and jax.default_backend() != "cpu":
         lm_floor = 100_000.0
         try:
             from tools.bench_lm import measure
 
-            lm_per_chip, _ = measure(batch=4, loss_kind="fused")
+            lm_per_chip, lm_cfg = measure(batch=4, loss_kind="fused",
+                                          qkv_layout="bhld")
             record["lm_tokens_per_sec_per_chip"] = round(lm_per_chip, 1)
+            record["lm_config"] = lm_cfg
             record["lm_floor_tokens_per_sec"] = lm_floor
             record["lm_gate_ok"] = bool(lm_per_chip >= lm_floor)
         except Exception as e:  # never sink the headline metric
