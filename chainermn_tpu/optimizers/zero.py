@@ -341,6 +341,7 @@ def make_zero2_train_step(
     n_microbatches: int,
     loss_fn: Optional[Callable] = None,
     donate: bool = True,
+    bucket_bytes: Optional[int] = None,
 ) -> Tuple[Callable, Tuple]:
     """ZeRO-2: ZeRO-1 plus a SHARDED gradient accumulator.
 
@@ -351,12 +352,24 @@ def make_zero2_train_step(
     memory is ``full/N`` instead of ZeRO-1's full-size gradient, which is
     the ZeRO-2 claim; optimizer state is sharded exactly as in ZeRO-1.
 
+    ``bucket_bytes`` additionally kills the per-microbatch TRANSIENT:
+    each bucket scatters into its own 1/N accumulator as the microbatch
+    backward produces it (same :class:`_BucketLayout` and
+    tuple-of-buckets state as the bucketed ZeRO-1 — pass the same value
+    to :func:`zero1_params`), so peak live gradient inside one scan
+    iteration ≈ one bucket.
+
     Same restrictions as :func:`make_zero1_train_step` (single-axis comm,
     element-wise optimizer, uniform param dtype, no mutable collections);
     the local batch must divide ``n_microbatches``. Returns
-    ``(step, state)`` with the same state layout as ZeRO-1, so
-    :func:`zero1_params` re-assembles parameters for either.
+    ``(step, state)`` with the same state layout as ZeRO-1 (at equal
+    ``bucket_bytes``), so :func:`zero1_params` re-assembles parameters
+    for either.
     """
+    if bucket_bytes is not None:
+        return _make_zero2_bucketed(model, optimizer, comm, params,
+                                    n_microbatches, loss_fn, donate,
+                                    bucket_bytes)
     from chainermn_tpu.training.step import classifier_loss
 
     lf = loss_fn or classifier_loss
@@ -440,6 +453,99 @@ def make_zero2_train_step(
             local_step, mesh=mesh,
             in_specs=((P(ax), opt_specs), dspec, dspec),
             out_specs=((P(ax), opt_specs), P()),
+        ),
+        donate_argnums=(0,) if donate else (),
+    )
+    return step, state
+
+
+def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
+                         loss_fn, donate, bucket_bytes):
+    """Bucketed ZeRO-2 (see ``make_zero2_train_step(bucket_bytes=...)``)."""
+    from chainermn_tpu.training.step import classifier_loss
+    from chainermn_tpu.utils import match_vma as _mv
+
+    lf = loss_fn or classifier_loss
+    mesh = comm.mesh
+    ax = comm.axis_name
+    n = comm.size
+    axes = comm.axis_names
+    dspec = P(ax)
+    m = n_microbatches
+
+    layout = _BucketLayout(params, n, bucket_bytes)
+    shard_shapes = {(ln,) for ln in layout.shard_lens}
+
+    def init_fn(params):
+        i = lax.axis_index(ax)
+        shards = tuple(
+            lax.dynamic_slice_in_dim(v, i * ln, ln)
+            for v, ln in zip(layout.pack_buckets(params),
+                             layout.shard_lens)
+        )
+        return shards, optimizer.init(shards)
+
+    abs_shards = tuple(
+        jax.ShapeDtypeStruct((ln,), layout.dtype)
+        for ln in layout.shard_lens)
+    abs_opt = jax.eval_shape(optimizer.init, abs_shards)
+    opt_specs = jax.tree_util.tree_map(
+        lambda l: P(ax) if l.shape in shard_shapes else P(), abs_opt)
+    shard_specs = tuple(P(ax) for _ in layout.buckets)
+
+    state = jax.jit(shard_map(
+        init_fn, mesh=mesh, in_specs=(P(),),
+        out_specs=(shard_specs, opt_specs), check_vma=False,
+    ))(params)
+
+    def local_step(state, x, y):
+        p_shards, opt_state = state
+        fulls = [lax.all_gather(s, ax, tiled=True) for s in p_shards]
+        p = layout.unpack_full(fulls)
+
+        bl = x.shape[0]
+        assert bl % m == 0, (
+            f"local batch {bl} not divisible by {m} microbatches")
+        xm = x.reshape((m, bl // m) + x.shape[1:])
+        ym = y.reshape((m, bl // m) + y.shape[1:])
+
+        def micro(carry, xy):
+            accs, loss_a, acc_a = carry
+            xi, yi = xy
+
+            def f(p):
+                loss, (a, _) = lf(model, p, xi, yi, train=True)
+                return loss, a
+
+            (loss, a), grads = jax.value_and_grad(f, has_aux=True)(p)
+            # each full-size BUCKET dies right here; only 1/N shards
+            # persist across the accumulation window
+            accs = tuple(
+                acc + lax.psum_scatter(g, ax, tiled=True) / n
+                for acc, g in zip(accs, layout.pack_buckets(grads)))
+            return (accs, loss_a + loss, acc_a + a), None
+
+        accs0 = tuple(
+            _mv(jnp.zeros((ln,), layout.dtype), s)
+            for ln, s in zip(layout.shard_lens, p_shards))
+        z = _mv(jnp.zeros(()), fulls[0])
+        (g_shards, loss_sum, acc_sum), _ = lax.scan(
+            micro, (accs0, z, z), (xm, ym))
+        g_shards = tuple(g / m for g in g_shards)
+        updates, opt_state = optimizer.update(g_shards, opt_state,
+                                              p_shards)
+        p_shards = optax.apply_updates(p_shards, updates)
+        metrics = {
+            "main/loss": lax.pmean(loss_sum / m, axes),
+            "main/accuracy": lax.pmean(acc_sum / m, axes),
+        }
+        return (p_shards, opt_state), metrics
+
+    step = jax.jit(
+        shard_map(
+            local_step, mesh=mesh,
+            in_specs=((shard_specs, opt_specs), dspec, dspec),
+            out_specs=((shard_specs, opt_specs), P()),
         ),
         donate_argnums=(0,) if donate else (),
     )
