@@ -76,6 +76,11 @@ def main():
     p.add_argument("--seq-impl", choices=["ring", "ring_flash",
                                           "ulysses"], default="ring",
                    help="sequence-parallel attention used by --ring")
+    p.add_argument("--qkv-layout", choices=["blhd", "bhld"],
+                   default="blhd",
+                   help="bhld: head-major pivot-free attention tensors "
+                        "(+3%% measured on the flash path — BASELINE.md; "
+                        "decode/generation needs blhd)")
     p.add_argument("--n-kv-heads", type=int, default=0, metavar="K",
                    help="KV heads < query heads = GQA/MQA (0 = all)")
     p.add_argument("--window", type=int, default=0, metavar="W",
@@ -125,12 +130,15 @@ def main():
 
     attention = ("flash" if jax.default_backend() == "tpu"
                  else "reference")
-    if args.window or (args.n_kv_heads and attention == "reference"):
+    if (args.window or args.qkv_layout == "bhld"
+            or (args.n_kv_heads and attention == "reference")):
         attention = "flash"  # interpreted off-TPU; required for window
+        #                      and for the head-major bhld layout
     lm_kw = dict(
         n_kv_heads=args.n_kv_heads or None,
         attention_window=args.window or None,
         pos_emb="rope" if args.rope else "learned",
+        qkv_layout=args.qkv_layout,
     )
     sample = np.zeros((1, args.seq_len), np.int32)
     if args.moe > 0:
