@@ -202,6 +202,62 @@ def test_validation_errors():
         BranchingPipeline(bad, sd, axis_name="stage")
 
 
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_fuzz_random_dags(seed):
+    """Property: random DAGs (random stage count, random 1- or 2-input
+    stages over random earlier producers, random widths, a sum-join head
+    over every dangling sink) match the sequential oracle — loss AND
+    every stage's grads. Mirrors the hetero-chain fuzz of
+    test_hetero_pipeline.py for the branching executor."""
+    rs = np.random.RandomState(seed)
+    S = int(rs.choice([4, 5, 6]))   # devices 4..6 of the 8-dev mesh
+    widths = {}
+
+    def mk_stage(idx, preds):
+        douts = int(rs.choice([4, 8, 12]))
+        widths[idx] = douts
+        dins = ([DIN] if not preds
+                else [widths[p] for p in preds])
+        p = {f"w{i}": jnp.asarray(
+                rs.randn(din, douts) * 0.4, jnp.float32)
+             for i, din in enumerate(dins)}
+        p["b"] = jnp.asarray(rs.randn(douts) * 0.1, jnp.float32)
+
+        def fn(p, *xs):
+            acc = p["b"]
+            for i, x in enumerate(xs):
+                acc = acc + x @ p[f"w{i}"]
+            return jnp.tanh(acc)
+
+        return (fn, p, tuple(preds))
+
+    defs = [mk_stage(0, ())]
+    for sidx in range(1, S - 1):
+        k = int(rs.choice([1, 1, 2]))  # mostly linear, some joins
+        preds = tuple(sorted(set(
+            int(rs.randint(0, sidx)) for _ in range(k))))
+        defs.append(mk_stage(sidx, preds))
+    consumed = {p for _, _, pr in defs for p in pr}
+    sinks = [i for i in range(S - 1) if i not in consumed]
+    defs.append(mk_stage(S - 1, tuple(sinks)))
+
+    pipe = BranchingPipeline(
+        defs, jax.ShapeDtypeStruct((MB, DIN), jnp.float32),
+        axis_name="stage")
+    m = int(rs.choice([3, 5]))
+    xs = jnp.asarray(rs.randn(m, MB, DIN) * 0.5, jnp.float32)
+    ys = jnp.asarray(rs.randn(m, MB, widths[S - 1]) * 0.5, jnp.float32)
+
+    loss, grads = _run_pipeline(pipe, defs, xs, ys, S)
+    ref_loss, ref_grads = _sequential_value_and_grad(defs, xs, ys)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for g, rg in zip(grads, ref_grads):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=2e-6),
+            g, rg)
+
+
 def test_chain_list_budget_refusal_then_branching_lowering():
     """THE VERDICT r4 #3 criterion: a branching MultiNodeChainList whose
     params exceed the replicated budget refuses apply() with guidance,
