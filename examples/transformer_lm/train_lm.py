@@ -76,6 +76,14 @@ def main():
     p.add_argument("--seq-impl", choices=["ring", "ring_flash",
                                           "ulysses"], default="ring",
                    help="sequence-parallel attention used by --ring")
+    p.add_argument("--zero", type=int, default=0, choices=[0, 1, 2, 3],
+                   help="ZeRO stage: 1 = sharded optimizer state, 2 = +"
+                        "sharded grad accumulator (2 microbatches), "
+                        "3 = FSDP per-leaf param sharding")
+    p.add_argument("--zero-bucket-kib", type=int, default=0,
+                   help="with --zero 1/2: reduce-scatter per KiB-sized "
+                        "gradient bucket (kills the transient full "
+                        "gradient)")
     p.add_argument("--qkv-layout", choices=["blhd", "bhld"],
                    default="blhd",
                    help="bhld: head-major pivot-free attention tensors "
@@ -166,11 +174,36 @@ def main():
             max_len=args.seq_len, attention=attention, **lm_kw)
         params = model.init(jax.random.PRNGKey(0), sample)["params"]
         params = comm.bcast_data(params)
-        optimizer = chainermn_tpu.create_multi_node_optimizer(
-            optax.adam(args.lr), comm)
-        state = (params, optimizer.init(params))
-        step = make_data_parallel_train_step(
-            model, optimizer, comm, loss_fn=lm_loss_with_aux)
+        if args.zero:
+            # sharded training (beyond reference, optimizers/zero.py):
+            # adam m/v live 1/N per device; --zero-bucket-kib additionally
+            # reduce-scatters each gradient bucket as backward produces
+            # it, so the full-model gradient never exists as one buffer
+            from chainermn_tpu.optimizers import (make_fsdp_train_step,
+                                                  make_zero1_train_step,
+                                                  make_zero2_train_step)
+
+            bb = (args.zero_bucket_kib * 1024
+                  if args.zero_bucket_kib else None)
+            if args.zero == 1:
+                step, state = make_zero1_train_step(
+                    model, optax.adam(args.lr), comm, params,
+                    loss_fn=lm_loss_with_aux, bucket_bytes=bb)
+            elif args.zero == 2:
+                step, state = make_zero2_train_step(
+                    model, optax.adam(args.lr), comm, params,
+                    n_microbatches=2, loss_fn=lm_loss_with_aux,
+                    bucket_bytes=bb)
+            else:
+                step, state = make_fsdp_train_step(
+                    model, optax.adam(args.lr), comm, params,
+                    loss_fn=lm_loss_with_aux)
+        else:
+            optimizer = chainermn_tpu.create_multi_node_optimizer(
+                optax.adam(args.lr), comm)
+            state = (params, optimizer.init(params))
+            step = make_data_parallel_train_step(
+                model, optimizer, comm, loss_fn=lm_loss_with_aux)
 
     train_it = SerialIterator(train, args.batchsize, shuffle=True, seed=0)
     updater = StandardUpdater(train_it, step, state, comm)
@@ -190,11 +223,13 @@ def main():
         print(f"final: loss={final.get('main/loss'):.4f} "
               f"acc={final.get('main/accuracy'):.4f}")
 
-    if args.ring and (args.moe > 0 or args.n_kv_heads):
+    if args.ring and (args.moe > 0 or args.n_kv_heads or args.zero
+                      or args.qkv_layout != "blhd"):
         if comm.is_master:
             print("--ring demo skipped: it reuses the trained params, and "
-                  "a MoE/GQA run produces a different param structure than "
-                  "the sequence-parallel model expects")
+                  "a MoE/GQA/ZeRO/bhld run produces a different param "
+                  "structure/layout than the sequence-parallel model "
+                  "expects")
     elif args.ring and args.seq_impl == "ulysses" and (
             args.n_heads % comm.size):
         if comm.is_master:
