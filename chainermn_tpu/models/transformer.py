@@ -46,7 +46,7 @@ from chainermn_tpu.parallel.ulysses import ulysses_attention
 from chainermn_tpu.ops.rotary import apply_rope, apply_rope_bhld
 
 __all__ = ["TransformerLM", "TransformerBlock", "generate",
-           "lm_loss_with_aux", "tp_lm_loss"]
+           "lm_loss_with_aux", "tp_lm_loss", "bhld_to_blhd_params"]
 
 
 class TransformerBlock(nn.Module):
@@ -388,6 +388,46 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def bhld_to_blhd_params(model, params):
+    """Convert a bhld-trained parameter tree to the blhd layout.
+
+    The head-major einsum kernels are reshapes/concats of the Dense
+    kernels the blhd path declares (same math, different factorization):
+    ``qkv_bhld [d,3,h,e]`` → ``qkv/kernel [d,3·d_model]`` (q/k/v blocks
+    concatenated the way ``jnp.split`` undoes), ``q_bhld``/``kv_bhld``
+    likewise for GQA, ``attn_out_bhld [h,e,d]`` → ``attn_out/kernel
+    [h·e,d]``. Everything else passes through. Use before
+    :func:`generate` (the KV-cache decode path is blhd-only) or to hand
+    a bhld-trained model to blhd-layout tooling.
+    """
+    d = model.d_model
+    h = model.n_heads
+    hkv = model.n_kv_heads or h
+    e = d // h
+
+    def convert_block(bp):
+        out = {k: v for k, v in bp.items() if not k.endswith("_bhld")}
+        if "qkv_bhld" in bp:
+            w = jnp.asarray(bp["qkv_bhld"])          # [d, 3, h, e]
+            out["qkv"] = {"kernel": jnp.concatenate(
+                [w[:, t].reshape(d, h * e) for t in range(3)], axis=1)}
+        if "q_bhld" in bp:
+            out["q_proj"] = {"kernel":
+                             jnp.asarray(bp["q_bhld"]).reshape(d, h * e)}
+        if "kv_bhld" in bp:
+            w = jnp.asarray(bp["kv_bhld"])           # [d, 2, hkv, e]
+            out["kv_proj"] = {"kernel": jnp.concatenate(
+                [w[:, t].reshape(d, hkv * e) for t in range(2)], axis=1)}
+        if "attn_out_bhld" in bp:
+            out["attn_out"] = {"kernel":
+                               jnp.asarray(bp["attn_out_bhld"])
+                               .reshape(h * e, d)}
+        return out
+
+    return {k: (convert_block(v) if k.startswith("block_") else v)
+            for k, v in params.items()}
+
+
 def generate(model, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 1.0, top_k: Optional[int] = None,
              eos_id: Optional[int] = None, pad_id: int = 0):
@@ -421,6 +461,11 @@ def generate(model, params, prompt, max_new_tokens: int,
                          "tp_axis/lm_head_tp models decode without TP "
                          "(clone with tp_axis=None, lm_head_tp=False and "
                          "gather the sharded weights)")
+    if model.qkv_layout == "bhld":
+        # the KV-cache decode path is blhd-only; fold the head-major
+        # kernels back into Dense form (exact, see bhld_to_blhd_params)
+        params = bhld_to_blhd_params(model, params)
+        model = model.clone(qkv_layout="blhd")
     dm = model.clone(decode=True)
     b, lp = prompt.shape
     total = lp + max_new_tokens

@@ -159,6 +159,37 @@ def test_model_bhld_gqa_trains():
         assert np.all(np.isfinite(np.asarray(leaf)))
 
 
+def test_bhld_to_blhd_conversion_exact():
+    """The converted param tree reproduces the bhld model's logits
+    through the blhd path exactly (the kernels are reshapes of each
+    other), for both fused-QKV and GQA param structures — and
+    generate() therefore works on bhld-trained models."""
+    from chainermn_tpu.models.transformer import (TransformerLM,
+                                                  bhld_to_blhd_params,
+                                                  generate)
+
+    V, Dm, Ll = 96, 32, 32
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randint(0, V, (2, Ll)), jnp.int32)
+    for kv in (None, 2):
+        mb = TransformerLM(vocab=V, d_model=Dm, n_heads=4,
+                           n_kv_heads=kv, n_layers=2, d_ff=64,
+                           max_len=Ll, pos_emb="rope",
+                           attention="flash", qkv_layout="bhld")
+        pb = mb.init(jax.random.PRNGKey(3), x)["params"]
+        ml = mb.clone(qkv_layout="blhd")
+        pl = bhld_to_blhd_params(mb, pb)
+        lo_b = mb.apply({"params": pb}, x)
+        lo_l = ml.apply({"params": pl}, x)
+        np.testing.assert_allclose(np.asarray(lo_l), np.asarray(lo_b),
+                                   rtol=1e-5, atol=1e-5)
+
+    out = generate(mb, pb, x[:, :4], max_new_tokens=3)
+    assert out.shape == (2, 7)
+    np.testing.assert_array_equal(np.asarray(out[:, :4]),
+                                  np.asarray(x[:, :4]))
+
+
 def test_model_bhld_rejects_decode():
     from chainermn_tpu.models.transformer import TransformerLM
 
