@@ -56,3 +56,8 @@ def test_schedule_interleaves_allreduce_with_backward():
     # the strong form: real backward work is scheduled after the first
     # gradient collective is issued
     assert out["backward_ops_after_first_allreduce"] >= 2, out
+    # the EXPLICITLY bucketed allreduce_grad path (hierarchical/DCN
+    # plan_buckets psums) must interleave too
+    b = out["bucketed_allreduce_grad"]
+    assert b["ok"], f"bucketed allreduce_grad serialized: {b}"
+    assert b["backward_ops_after_first_allreduce"] >= 2, b

@@ -107,30 +107,63 @@ def main():
         lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=rep),
         state)
 
-    compiled = jax.jit(lambda s, x, y: step(s, x, y)).lower(
-        state, x, y).compile({
-            "xla_tpu_enable_latency_hiding_scheduler": "true",
-            "xla_enable_async_all_reduce": "true",
-        })
-    txt = compiled.as_text()
-    ops = scheduled_entry_ops(txt)
+    def analyze(compiled):
+        txt = compiled.as_text()
+        ops = scheduled_entry_ops(txt)
+        ar = [i for i, (k, _) in enumerate(ops)
+              if k in ("all-reduce", "all-reduce-start")]
+        bwd = [i for i, (_, s) in enumerate(ops) if "transpose(jvp" in s]
+        out = {
+            "is_scheduled": "is_scheduled=true" in txt,
+            "n_sched_ops": len(ops),
+            "n_allreduce": len(ar),
+            "first_allreduce": min(ar) if ar else None,
+            "last_backward": max(bwd) if bwd else None,
+            "backward_ops_after_first_allreduce": (
+                sum(1 for i in bwd if i > min(ar)) if ar else 0),
+            "async_pairs": bool(re.search(r"all-reduce-start", txt)),
+        }
+        out["ok"] = bool(
+            out["is_scheduled"] and ar and bwd and min(ar) < max(bwd))
+        return out
 
-    ar = [i for i, (k, _) in enumerate(ops)
-          if k in ("all-reduce", "all-reduce-start")]
-    bwd = [i for i, (_, s) in enumerate(ops) if "transpose(jvp" in s]
-    out = {
-        "is_scheduled": "is_scheduled=true" in txt,
-        "n_sched_ops": len(ops),
-        "n_allreduce": len(ar),
-        "first_allreduce": min(ar) if ar else None,
-        "last_backward": max(bwd) if bwd else None,
-        "backward_ops_after_first_allreduce": (
-            sum(1 for i in bwd if i > min(ar)) if ar else 0),
-        "async_pairs": bool(re.search(r"all-reduce-start", txt)),
+    opts = {
+        "xla_tpu_enable_latency_hiding_scheduler": "true",
+        "xla_enable_async_all_reduce": "true",
     }
-    out["ok"] = bool(
-        out["is_scheduled"] and ar and bwd
-        and min(ar) < max(bwd))
+    out = analyze(jax.jit(lambda s, x, y: step(s, x, y)).lower(
+        state, x, y).compile(opts))
+
+    # second configuration: the EXPLICITLY bucketed allreduce_grad (the
+    # hierarchical communicator's DCN path — one psum per plan_buckets
+    # bucket in the jaxpr), asserting the compiler schedules those
+    # bucket collectives into the backward window too
+    from jax import shard_map
+
+    bcomm = XlaCommunicator(mesh=mesh, dcn_bucket_bytes=16 * 2 ** 20)
+
+    def local_step(p, xb, yb):
+        def loss(p):
+            logits = model.apply({"params": p}, xb)
+            one = jax.nn.one_hot(yb, 10)
+            return jnp.mean((logits - one) ** 2)
+
+        l, g = jax.value_and_grad(loss)(p)
+        g = bcomm.allreduce_grad(g, "mean")
+        newp = jax.tree_util.tree_map(
+            lambda a, b: a - 0.1 * b, p, g)
+        return jax.lax.pmean(l, ("dcn", "ici")), newp
+
+    sm = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(("dcn", "ici")), P(("dcn", "ici"))),
+        out_specs=(P(), P()))
+    pab = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=rep),
+        params)
+    out2 = analyze(jax.jit(sm).lower(pab, x, y).compile(opts))
+    out["bucketed_allreduce_grad"] = out2
+    out["ok"] = bool(out["ok"] and out2["ok"])
     print(json.dumps(out))
 
 
