@@ -6,11 +6,14 @@ which also folds this number into its JSON line as the LM regression
 gate): a GPT-small-ish causal LM on the flash-attention path, bf16
 compute, data-parallel step factory. Prints one JSON line per config.
 
-Usage: python tools/bench_lm.py [d_model n_layers seq_len batch [loss [d_head]]]
+Usage: python tools/bench_lm.py [d_model n_layers seq_len batch
+                                 [loss [d_head [qkv_layout]]]]
   loss: 'unfused' (default) or 'fused' — the fused head+CE Pallas kernel
   (ops/fused_ce.py; measured throughput-neutral, −2 GB logits memory)
   d_head: head dim (default 64; 128 halves the QK^T MXU inefficiency the
-  roofline attributes to d=64 — docs/lm_roofline.md)
+  roofline attributes to d=64 — docs/lm_roofline.md: +26% measured)
+  qkv_layout: 'blhd' (default) or 'bhld' — head-major pivot-free
+  attention tensors (+3% measured; BASELINE.md r4)
 """
 
 import json
