@@ -188,16 +188,23 @@ def load_cifar(data_dir: str, n_classes: int = 100, train: bool = True,
     if n_classes == 100:
         files = ["train.bin"] if train else ["test.bin"]
         label_bytes = 2
+        optional = set()
     elif n_classes == 10:
         files = ([f"data_batch_{i}.bin" for i in range(1, 6)]
                  if train else ["test_batch.bin"])
         label_bytes = 1
+        # the real distribution always has all five train batches; a
+        # small locally-generated set may hold fewer (save_cifar skips
+        # empty parts), so only batch 1 is mandatory
+        optional = set(files[1:]) if train else set()
     else:
         raise ValueError(f"n_classes must be 10 or 100, got {n_classes}")
     imgs, labels = [], []
     for name in files:
         path = os.path.join(data_dir, name)
         if not os.path.exists(path):
+            if name in optional:
+                continue
             raise FileNotFoundError(
                 f"{path}: missing CIFAR-{n_classes} binary batch "
                 "(generate locally with examples/cifar/"
@@ -234,7 +241,12 @@ def save_cifar(data_dir: str, xs: np.ndarray, ys: np.ndarray,
     elif n_classes == 10:
         recs = np.concatenate([ys[:, None], pix], axis=1)
         if train:
-            parts = np.array_split(recs, 5)
+            if len(recs) == 0:
+                raise ValueError("cannot save an empty CIFAR-10 set")
+            # skip empty parts for tiny locally-generated sets (a 0-byte
+            # batch file would fail the loader's record-size check);
+            # load_cifar treats batches 2..5 as optional accordingly
+            parts = [p for p in np.array_split(recs, 5) if len(p)]
             files = {f"data_batch_{i + 1}.bin": p
                      for i, p in enumerate(parts)}
         else:

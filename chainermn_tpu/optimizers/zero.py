@@ -255,24 +255,14 @@ def make_zero1_train_step(
     return step, state
 
 
-def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
-                         bucket_bytes):
-    """Bucketed ZeRO-1 (see ``make_zero1_train_step(bucket_bytes=...)``).
-
-    Per step, per bucket: ``psum_scatter`` the bucket's padded gradient
-    (mean) → concatenate the per-bucket shards into the flat aligned
-    shard vector → one element-wise ``optimizer.update``. The per-bucket
-    ``all_gather`` on the forward side re-assembles parameters with the
-    same layout. XLA's liveness analysis frees each full-size bucket
-    gradient at its scatter, and its latency-hiding scheduler can start
-    late-layer buckets' collectives while early layers are still in
-    backward (tests/comm_tests/test_overlap_schedule.py asserts the
-    schedule interleaving for the DP path)."""
+def _bucketed_init(optimizer, comm, params, bucket_bytes):
+    """Shared bucketed-state construction for ZeRO-1 and ZeRO-2: the
+    layout, per-bucket P(ax) specs, opt-state specs, and the initial
+    (tuple-of-shards, opt_state) — one definition so the two steps can
+    never diverge on state layout."""
     mesh = comm.mesh
     ax = comm.axis_name
     n = comm.size
-    axes = comm.axis_names
-    dspec = P(ax)
 
     layout = _BucketLayout(params, n, bucket_bytes)
     shard_shapes = {(ln,) for ln in layout.shard_lens}
@@ -298,6 +288,30 @@ def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
         init_fn, mesh=mesh, in_specs=(P(),),
         out_specs=(shard_specs, opt_specs), check_vma=False,
     ))(params)
+    return layout, shard_specs, opt_specs, state
+
+
+def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
+                         bucket_bytes):
+    """Bucketed ZeRO-1 (see ``make_zero1_train_step(bucket_bytes=...)``).
+
+    Per step, per bucket: ``psum_scatter`` the bucket's padded gradient
+    (mean) → concatenate the per-bucket shards into the flat aligned
+    shard vector → one element-wise ``optimizer.update``. The per-bucket
+    ``all_gather`` on the forward side re-assembles parameters with the
+    same layout. XLA's liveness analysis frees each full-size bucket
+    gradient at its scatter, and its latency-hiding scheduler can start
+    late-layer buckets' collectives while early layers are still in
+    backward (tests/comm_tests/test_overlap_schedule.py asserts the
+    schedule interleaving for the DP path)."""
+    mesh = comm.mesh
+    ax = comm.axis_name
+    n = comm.size
+    axes = comm.axis_names
+    dspec = P(ax)
+
+    layout, shard_specs, opt_specs, state = _bucketed_init(
+        optimizer, comm, params, bucket_bytes)
 
     def local_step(state, x, y):
         p_shards, opt_state = state
@@ -473,30 +487,8 @@ def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
     dspec = P(ax)
     m = n_microbatches
 
-    layout = _BucketLayout(params, n, bucket_bytes)
-    shard_shapes = {(ln,) for ln in layout.shard_lens}
-
-    def init_fn(params):
-        i = lax.axis_index(ax)
-        shards = tuple(
-            lax.dynamic_slice_in_dim(v, i * ln, ln)
-            for v, ln in zip(layout.pack_buckets(params),
-                             layout.shard_lens)
-        )
-        return shards, optimizer.init(shards)
-
-    abs_shards = tuple(
-        jax.ShapeDtypeStruct((ln,), layout.dtype)
-        for ln in layout.shard_lens)
-    abs_opt = jax.eval_shape(optimizer.init, abs_shards)
-    opt_specs = jax.tree_util.tree_map(
-        lambda l: P(ax) if l.shape in shard_shapes else P(), abs_opt)
-    shard_specs = tuple(P(ax) for _ in layout.buckets)
-
-    state = jax.jit(shard_map(
-        init_fn, mesh=mesh, in_specs=(P(),),
-        out_specs=(shard_specs, opt_specs), check_vma=False,
-    ))(params)
+    layout, shard_specs, opt_specs, state = _bucketed_init(
+        optimizer, comm, params, bucket_bytes)
 
     def local_step(state, x, y):
         p_shards, opt_state = state
@@ -559,6 +551,12 @@ def zero1_params(state, like_params, bucket_bytes=None):
     is shard-major (:class:`_BucketLayout`) and silently permutes if
     read with the wrong plan."""
     if bucket_bytes is None:
+        if isinstance(state[0], (tuple, list)):
+            raise ValueError(
+                "this state holds a TUPLE of bucket shards — it was "
+                "built with bucket_bytes; pass the same bucket_bytes to "
+                "zero1_params (stacking the buckets would interleave "
+                "their padding and silently corrupt every later leaf)")
         flat, unravel = ravel_pytree(like_params)
         full = jnp.asarray(state[0]).reshape(-1)[: flat.size]
         return unravel(full)

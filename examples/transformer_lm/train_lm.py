@@ -185,6 +185,11 @@ def main():
 
             bb = (args.zero_bucket_kib * 1024
                   if args.zero_bucket_kib else None)
+            if args.zero == 3 and bb:
+                raise SystemExit(
+                    "--zero-bucket-kib applies to --zero 1/2 only: FSDP "
+                    "gradient liveness follows XLA's per-leaf schedule, "
+                    "not the bucket plan")
             if args.zero == 1:
                 step, state = make_zero1_train_step(
                     model, optax.adam(args.lr), comm, params,

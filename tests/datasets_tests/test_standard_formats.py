@@ -183,6 +183,23 @@ def test_cifar100_roundtrip(tmp_path):
         assert y == int(ys[i])
 
 
+def test_cifar10_tiny_set_roundtrip(tmp_path):
+    """Fewer records than batches: empty parts are skipped at save and
+    batches 2..5 are optional at load (the real distribution always has
+    all five; only locally-generated tiny sets hit this)."""
+    rs = np.random.RandomState(9)
+    xs = rs.randint(0, 256, size=(3, 32, 32, 3)).astype(np.uint8)
+    ys = np.asarray([0, 1, 2], np.uint8)
+    save_cifar(str(tmp_path), xs, ys, n_classes=10, train=True)
+    assert not os.path.exists(tmp_path / "data_batch_4.bin")
+    ds = load_cifar(str(tmp_path), n_classes=10, normalize=False)
+    assert len(ds) == 3
+    assert sorted(int(ds[i][1]) for i in range(3)) == [0, 1, 2]
+    with pytest.raises(ValueError, match="empty"):
+        save_cifar(str(tmp_path), xs[:0], ys[:0], n_classes=10,
+                   train=True)
+
+
 def test_cifar10_roundtrip_five_batches(tmp_path):
     rs = np.random.RandomState(3)
     xs = rs.randint(0, 256, size=(10, 32, 32, 3)).astype(np.uint8)

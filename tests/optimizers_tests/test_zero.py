@@ -214,6 +214,24 @@ def test_zero1_bucketed_matches_unbucketed(comm, bucket_kib):
         p0, p1)
 
 
+def test_zero1_params_layout_mismatch_raises(comm):
+    """Reading a bucketed state without bucket_bytes (or vice versa)
+    must raise, never silently permute (interleaved padding would
+    corrupt every leaf after bucket 0)."""
+    model = MLP(n_units=32, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    bb = 64 * 1024
+    _, stb = make_zero1_train_step(model, optax.sgd(0.1), comm, params,
+                                   donate=False, bucket_bytes=bb)
+    with pytest.raises(ValueError, match="bucket"):
+        zero1_params(stb, params)
+    _, st = make_zero1_train_step(model, optax.sgd(0.1), comm, params,
+                                  donate=False)
+    with pytest.raises(ValueError, match="WITHOUT bucket_bytes"):
+        zero1_params(st, params, bucket_bytes=bb)
+
+
 def test_zero1_bucketed_kills_full_gradient_transient(comm):
     """THE ZeRO-1 memory claim, from the compiler's own buffer
     assignment: the bucketed step's temp allocation is smaller than the
