@@ -4,9 +4,13 @@
 Measures data-parallel training throughput (images/sec) of the current
 flagship model on the available devices. The north-star metric
 (BASELINE.md) is ImageNet ResNet-50 images/sec/chip with ≥90% scaling
-v5e-8 → v5e-256; on a single chip this reports absolute images/sec/chip,
-with ``vs_baseline`` = 1.0 until a reference figure exists to normalize
-against (BASELINE.json's ``published`` field is empty).
+v5e-8 → v5e-256; on a single chip this reports absolute images/sec/chip.
+``vs_baseline`` is the ratio against the first recorded round's own
+measurement (BENCH_r01.json: 2506.43 im/s/chip — BASELINE.json's
+``published`` field is empty, so our r1 number IS the recorded baseline);
+ResNet-50 here is HBM-roofline-bound at 97.8% of spec bandwidth
+(docs/resnet50_roofline.md), so ~1.00 is the expected steady state and a
+drop below ~0.97 means a real regression, not noise.
 
 Modes:
   default       pre-staged device tensors (pure device throughput; the
@@ -46,6 +50,12 @@ import chainermn_tpu
 SCAN_K = 8  # optimizer steps compiled per dispatch (both modes MUST share
 #             one step program — the default-vs-realistic comparison is
 #             meaningless otherwise)
+
+# the recorded baseline vs_baseline normalizes against: round-1's measured
+# ResNet-50 number (BENCH_r01.json). No published reference figure exists
+# (BASELINE.json .published == {}), so the first recorded measurement of
+# this same benchmark is the denominator.
+RECORDED_BASELINE_IMG_PER_SEC = 2506.43
 
 
 def _init_state_and_step(comm, model, image, mutable):
@@ -210,34 +220,49 @@ def main():
                            mutable)
     per_chip = images_per_sec / n_dev
     suffix = "_realistic" if realistic else ""
+    # the recorded baseline is the default-mode ResNet-50 number; other
+    # modes/models have no recorded denominator and report 1.0
+    vs = (per_chip / RECORDED_BASELINE_IMG_PER_SEC
+          if name == "resnet50" and not realistic else 1.0)
     record = {
         "metric": f"{name}_train_images_per_sec_per_chip{suffix}",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(vs, 4),
     }
 
-    # LM regression gate, folded into the SAME json line (extra keys are
-    # harmless to any parser of the headline metric): the flash-attention
-    # + fused-CE LM path at its measured optimum (b=4, head-major bhld
-    # layout — BASELINE.md r4) must stay above the 100k tok/s/chip floor
-    # — a kernel regression can no longer land with all driver-visible
-    # artifacts green. TPU-only: the Pallas kernels don't run on the CPU
-    # mesh.
+    # LM regression gates, folded into the SAME json line (extra keys are
+    # harmless to any parser of the headline metric). TWO gated configs,
+    # each floored ~3% under its r4 measurement so a 5% kernel regression
+    # in either fails the gate (VERDICT r4 asked for exactly this — the
+    # old 100k floor left a 9% window under the measured 110.2k):
+    #   contract  — b=4, d_head=64, bhld, fused CE (110.2k measured)
+    #   frontier  — same but d_head=128, the config BASELINE.md recommends
+    #               to model authors (135.2k measured)
+    # TPU-only: the Pallas kernels don't run on the CPU mesh.
     if "--no-lm" not in sys.argv and jax.default_backend() != "cpu":
-        lm_floor = 100_000.0
-        try:
-            from tools.bench_lm import measure
+        gates = [
+            ("lm", dict(batch=4, loss_kind="fused", qkv_layout="bhld"),
+             107_000.0),
+            ("lm_frontier",
+             dict(batch=4, loss_kind="fused", qkv_layout="bhld",
+                  d_head=128),
+             130_000.0),
+        ]
+        ok = True
+        for prefix, kw, floor in gates:
+            try:
+                from tools.bench_lm import measure
 
-            lm_per_chip, lm_cfg = measure(batch=4, loss_kind="fused",
-                                          qkv_layout="bhld")
-            record["lm_tokens_per_sec_per_chip"] = round(lm_per_chip, 1)
-            record["lm_config"] = lm_cfg
-            record["lm_floor_tokens_per_sec"] = lm_floor
-            record["lm_gate_ok"] = bool(lm_per_chip >= lm_floor)
-        except Exception as e:  # never sink the headline metric
-            record["lm_gate_ok"] = False
-            record["lm_error"] = f"{type(e).__name__}: {e}"[:300]
+                per, cfg = measure(**kw)
+                record[f"{prefix}_tokens_per_sec_per_chip"] = round(per, 1)
+                record[f"{prefix}_config"] = cfg
+                record[f"{prefix}_floor_tokens_per_sec"] = floor
+                ok = ok and per >= floor
+            except Exception as e:  # never sink the headline metric
+                ok = False
+                record[f"{prefix}_error"] = f"{type(e).__name__}: {e}"[:300]
+        record["lm_gate_ok"] = bool(ok)
     print(json.dumps(record))
 
 

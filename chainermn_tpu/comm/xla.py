@@ -164,6 +164,25 @@ class XlaCommunicator(CommunicatorBase):
 
     @property
     def intra_rank(self) -> int:
+        """Always 0 — DOCUMENTED DEVIATION under the process=node mapping.
+
+        Reference contract (communicator_base.py ``intra_rank``): this
+        rank's position within its node, produced by MPI's hostname
+        split and used to pick the node-local CUDA device. This
+        framework's process model (MIGRATION.md §Process model) maps one
+        JAX PROCESS to the reference's "node": a process owns all its
+        local devices (``intra_size`` of them), so as the node's only
+        member its within-node rank is identically 0 — consistent with
+        ``rank`` being the process's FIRST addressable device and with
+        ``inter_rank``/``inter_size`` being the process index/count
+        (checkpoint shard naming, ``scatter_dataset``, and rank-0
+        election all build on that). Device selection, the reference's
+        only use of ``intra_rank``, is ``jax.local_devices()`` here.
+        Tested: tests/comm_tests/test_communicator.py (single-process)
+        and test_multiprocess_collectives.py (two processes, one host —
+        still 0 on both, because a process IS a node, hosts don't enter
+        the mapping).
+        """
         return 0
 
     @property
@@ -693,11 +712,40 @@ class XlaCommunicator(CommunicatorBase):
         every rank's initial parameters identical. Single-controller JAX has
         one source of truth already, so this lowers to replication placement
         (plus a host-plane broadcast when processes may disagree).
+
+        ``root`` is a rank in this communicator's rank space; multi-process
+        it selects the SOURCE process — the one owning the mesh position
+        ``root`` — whose values every other process receives (the reference
+        broadcasts from an arbitrary root the same way). Single-process the
+        one process is every rank, so any root is trivially honored. On a
+        communicator spanning a SUBSET of the mesh axes, a rank names a
+        device GROUP (one member per complementary mesh coordinate) that
+        can straddle processes, so multi-process only ``root=0`` (whose
+        group contains the mesh origin) is accepted — split a full-mesh
+        communicator for arbitrary roots.
         """
+        flat = self._mesh.devices.reshape(-1)
+        spans_all = self._size == flat.size
+        # rank-space superset: comm ranks for a full-mesh communicator,
+        # global flat indices (the `rank` property's convention) otherwise
+        if not 0 <= root < flat.size:
+            raise ValueError(
+                f"bcast_data root {root} out of range for a "
+                f"size-{self.size} communicator on a {flat.size}-device "
+                f"mesh")
         if self.inter_size > 1:
             from jax.experimental import multihost_utils
 
-            params = multihost_utils.broadcast_one_to_all(params)
+            if not spans_all and root != 0:
+                raise ValueError(
+                    f"bcast_data(root={root}) on a communicator spanning "
+                    f"axes {self._axes} of mesh {self._mesh.axis_names}: a "
+                    "sub-axis rank is a device group that may straddle "
+                    "processes, so a non-zero root has no single source "
+                    "process; use root=0 or a full-mesh communicator")
+            root_proc = int(flat[root].process_index)
+            params = multihost_utils.broadcast_one_to_all(
+                params, is_source=jax.process_index() == root_proc)
         repl = NamedSharding(self._mesh, P())
         return jax.tree_util.tree_map(
             lambda l: jax.device_put(jnp.asarray(l), repl), params

@@ -26,7 +26,9 @@ import optax
 from chainermn_tpu.comm.base import CommunicatorBase
 from chainermn_tpu.optimizers.zero import (  # noqa: F401
     fsdp_gather_params,
+    fsdp_scan_apply,
     fsdp_shardings,
+    fsdp_stack_shardings,
     make_fsdp_train_step,
     make_zero1_train_step,
     make_zero2_train_step,

@@ -266,6 +266,33 @@ def test_bcast_data_replicates(any_comm):
     np.testing.assert_allclose(np.asarray(leaf), params["dense1"]["w"])
 
 
+def test_bcast_data_root_validated(any_comm):
+    """Single-controller, every valid root is trivially honored (one
+    source of truth); an out-of-range root must raise, not silently
+    broadcast from rank 0 (r4 VERDICT parity nit)."""
+    comm = any_comm
+    params = _toy_params()
+    out = comm.bcast_data(params, root=comm.size - 1)
+    np.testing.assert_allclose(np.asarray(out["dense1"]["w"]),
+                               params["dense1"]["w"])
+    with pytest.raises(ValueError, match="root"):
+        comm.bcast_data(params, root=comm.size)
+    with pytest.raises(ValueError, match="root"):
+        comm.bcast_data(params, root=-1)
+
+
+def test_intra_rank_process_is_node(any_comm):
+    """The documented process=node mapping (MIGRATION.md): a process is
+    its node's only member, so intra_rank is identically 0 and
+    intra_size is the process's device count; the two-process case
+    (still 0 on both) is exercised by test_multiprocess_collectives."""
+    comm = any_comm
+    assert comm.intra_rank == 0
+    assert comm.intra_size == jax.local_device_count()
+    assert comm.intra_rank < comm.intra_size
+    assert comm.inter_rank == 0 and comm.inter_size == 1
+
+
 def test_allreduce_grad_in_graph(any_comm):
     comm = any_comm
     n = comm.size

