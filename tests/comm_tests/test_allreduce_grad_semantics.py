@@ -49,6 +49,7 @@ def _train(comm, check_vma, lr=0.2, steps=150, data=None):
         x, y = data
     for _ in range(steps):
         params = step(params, x, y)
+        jax.block_until_ready(params)  # per-iter sync (conftest 1-core rule)
     return np.asarray(params["w"])
 
 

@@ -124,6 +124,7 @@ xg = jax.make_array_from_process_local_data(dsh, x[proc_id*32:(proc_id+1)*32])
 yg = jax.make_array_from_process_local_data(dsh, y[proc_id*32:(proc_id+1)*32])
 for _ in range(150):
     params = step(params, xg, yg)
+    jax.block_until_ready(params)  # per-iter sync (conftest 1-core rule)
 w = np.asarray(jax.device_get(
     jax.tree_util.tree_map(lambda l: l, params)["w"]))
 np.testing.assert_allclose(w, [3.0, 1.0], atol=5e-2)

@@ -61,3 +61,14 @@ def test_schedule_interleaves_allreduce_with_backward():
     b = out["bucketed_allreduce_grad"]
     assert b["ok"], f"bucketed allreduce_grad serialized: {b}"
     assert b["backward_ops_after_first_allreduce"] >= 2, b
+    # the 1F1B PIPELINE tick: wire ppermutes must lower to async
+    # collective-permute-start/done pairs with real stage compute
+    # scheduled between them — the per-tick wire hop hides behind
+    # compute (docs/scaling_model.md §6) instead of serializing
+    p = out["pipeline_1f1b"]
+    assert p["ok"], f"1F1B wire hop serialized against tick compute: {p}"
+    assert p["n_permute_pairs"] >= 2, p  # fwd AND bwd rings async
+    # EVERY hop must hide compute inside its own start->done window —
+    # compute between unrelated pairs certifies nothing
+    assert p["min_compute_inside_any_pair"] >= 1, p
+    assert p["sync_permutes"] == 0, p

@@ -10,8 +10,13 @@ collectives, then run everything SPMD under jit/shard_map.
 collective-bearing steps WITHOUT syncing each iteration (pull a scalar,
 e.g. ``float(metrics["main/loss"])``, or ``jax.block_until_ready``) piles
 up async executions until the XLA CPU collective rendezvous aborts the
-process ("Fatal Python error: Aborted", intermittent, load-dependent).
-Every multi-iteration training loop in this suite must sync per step.
+process ("Fatal Python error: Aborted", load-dependent). FIXED r5:
+every multi-iteration step loop in the suite (and in the embedded
+multi-process worker scripts) now syncs per iteration — the r4 full-suite
+abort came from test_multi_node_optimizer.py's 300-step loop, audited
+along with every other loop via an AST scan for step-calling loops with
+no sync marker in the body. New tests MUST keep the rule: sync (scalar
+pull or block_until_ready) inside every step loop.
 """
 
 import os

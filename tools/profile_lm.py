@@ -28,9 +28,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import numpy as np
 
-D_MODEL, N_LAYERS, SEQ_LEN, BATCH = 768, 12, 2048, 8
+D_MODEL, N_LAYERS, SEQ_LEN = 768, 12, 2048
+BATCH = int(os.environ.get("PROFILE_BATCH", "8"))
 SCAN_K = 4
 QKV_LAYOUT = os.environ.get("PROFILE_QKV_LAYOUT", "blhd")
+LOSS = os.environ.get("PROFILE_LOSS", "unfused")  # 'fused' → ops.fused_ce
 
 
 def build_step():
@@ -54,8 +56,14 @@ def build_step():
     params = comm.bcast_data(
         model.init(jax.random.PRNGKey(0), toks[:1, :-1])["params"])
     opt = chainermn_tpu.create_multi_node_optimizer(optax.adamw(3e-4), comm)
+    if LOSS == "fused":
+        from chainermn_tpu.ops import fused_lm_loss
+
+        lf = fused_lm_loss
+    else:
+        lf = lm_loss_with_aux
     step = make_data_parallel_train_step(
-        model, opt, comm, loss_fn=lm_loss_with_aux, scan_steps=SCAN_K)
+        model, opt, comm, loss_fn=lf, scan_steps=SCAN_K)
     state = (params, opt.init(params))
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -99,6 +107,8 @@ def parse_trace(trace_dir):
         # containers, not kernels
         if name.startswith("jit_") or name.isdigit():
             continue
+        if name.startswith("while"):
+            continue  # container: its leaves are counted individually
         dur = e.get("dur", 0) / 1e6  # us → s
         base = name.split(".")[0].split("(")[0]
         # strip trailing instance numbers: fusion.123 → fusion
@@ -132,11 +142,16 @@ def main():
         ca = {"error": repr(e)}
 
     # ---- timed steady state ------------------------------------------
+    # bench_lm methodology: sync ONCE at the end — dispatches queue
+    # asynchronously so the ~100 ms tunnel round-trip overlaps and the
+    # figure is DEVICE throughput. (A per-iteration sync adds the full
+    # tunnel latency to every dispatch: measured +23 ms/step on the same
+    # program, r5 — that discrepancy was methodology, not the program.)
     n_iters = 6
     t0 = time.perf_counter()
     for _ in range(n_iters):
         state, m = step(state, xs, ys)
-        float(m["main/loss"][-1])
+    float(m["main/loss"][-1])
     dt = time.perf_counter() - t0
     step_s = dt / (n_iters * SCAN_K)
     tok_s = BATCH * SEQ_LEN / step_s
