@@ -388,6 +388,124 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def stack_lm_blocks(params):
+    """TransformerLM params → the scanned-stack layout: the homogeneous
+    ``block_i`` subtrees stacked leaf-wise on a leading layer dim under
+    ``"blocks"``, everything else passed through. This is the parameter
+    layout :func:`make_lm_fsdp_scan_loss` consumes (and
+    ``optimizers.fsdp_scan_apply`` scans over); invert with
+    :func:`unstack_lm_blocks` for checkpoints, ``generate``, or any
+    per-layer tooling."""
+    names = sorted((k for k in params if k.startswith("block_")),
+                   key=lambda k: int(k.split("_")[1]))
+    if not names:
+        raise ValueError("no block_i subtrees found — not TransformerLM "
+                         "params?")
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls), *[params[k] for k in names])
+    return {"blocks": stacked, **rest}
+
+
+def unstack_lm_blocks(packed):
+    """Inverse of :func:`stack_lm_blocks`: ``{"blocks": [L, ...], ...}``
+    → the original ``block_i`` per-layer tree."""
+    blocks = packed["blocks"]
+    n = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    out = {k: v for k, v in packed.items() if k != "blocks"}
+    for i in range(n):
+        out[f"block_{i}"] = jax.tree_util.tree_map(
+            lambda l, i=i: l[i], blocks)
+    return out
+
+
+def make_lm_fsdp_scan_loss(model):
+    """A step-factory ``loss_fn`` running TransformerLM's layer stack
+    through ``optimizers.fsdp_scan_apply`` — the COMPILER-FORCED FSDP
+    memory bound (peak gathered params ≈ one layer, re-gathered in
+    backward) on the flagship model, with the fused head+CE loss
+    (ops/fused_ce.py — the full logits never materialize).
+
+    The forward is rebuilt from the model's OWN flax submodules applied
+    piecewise (``nn.Embed``/``TransformerBlock``/``nn.LayerNorm`` with
+    the extracted param subtrees) — embed/blocks/LN numerics are those
+    of ``model.apply`` exactly, and the head follows ``fused_lm_loss``'s
+    convention (the dot takes ``h.dtype`` inputs with f32 accumulation;
+    for bf16 models that differs from the unfused head's f32-input
+    Dense, exactly as the fused path always has). Asserted against the
+    replicated step by the oracle test
+    (tests/optimizers_tests/test_zero.py). Use with the stacked layout
+    and a mixed sharding tree::
+
+        packed = stack_lm_blocks(params)
+        shardings = dict(fsdp_shardings(packed, comm),
+                         blocks=fsdp_stack_shardings(packed, comm)["blocks"])
+        step, state = make_fsdp_train_step(
+            None, optimizer, comm, packed,
+            loss_fn=make_lm_fsdp_scan_loss(model),
+            param_shardings=shardings)
+
+    Supported envelope: plain data-axis FSDP under jit — no
+    ``tp_axis``/``seq_axis`` (those need shard_map axis context), no
+    MoE (the load-balancing 'losses' collection cannot thread through
+    the scan), no decode. The scan body is always rematerialized (the
+    FSDP memory floor), independent of ``model.remat``.
+    """
+    if getattr(model, "moe_experts_per_device", 0):
+        raise ValueError("MoE models: the load-balancing aux cannot "
+                         "thread through the scan; use the per-layer "
+                         "model with lm_loss_with_aux")
+    if model.tp_axis is not None or model.seq_axis is not None:
+        raise ValueError("tp_axis/seq_axis need shard_map axis context; "
+                         "the FSDP scan step runs under plain jit")
+    if model.decode or model.lm_head_tp:
+        raise ValueError("decode/lm_head_tp unsupported in the FSDP "
+                         "scan loss")
+    from chainermn_tpu.ops.fused_ce import fused_ce_head
+
+    block = TransformerBlock(
+        d_model=model.d_model, n_heads=model.n_heads, d_ff=model.d_ff,
+        n_kv_heads=model.n_kv_heads, dtype=model.dtype,
+        attention=model.attention,
+        attention_window=model.attention_window,
+        attention_blocks=model.attention_blocks,
+        pos_emb=model.pos_emb, rope_theta=model.rope_theta,
+        max_len=model.max_len, qkv_layout=model.qkv_layout)
+    embed = nn.Embed(model.vocab, model.d_model, dtype=model.dtype)
+    ln_f = nn.LayerNorm(dtype=model.dtype)
+
+    def loss_fn(_model, p, x, y, train=True, **kw):
+        from chainermn_tpu.optimizers import fsdp_scan_apply
+
+        h = embed.apply({"params": p["tok_emb"]}, x)
+        if model.pos_emb == "learned":
+            idx = jnp.arange(x.shape[1])
+            h = h + jnp.take(p["pos_emb"], idx, axis=0).astype(
+                model.dtype)[None]
+        h = fsdp_scan_apply(
+            lambda pi, h: block.apply({"params": pi}, h), p["blocks"], h)
+        h = ln_f.apply({"params": p["LayerNorm_0"]}, h)
+        b, l, d = h.shape
+        w = p["lm_head"]["kernel"].astype(h.dtype)
+        # vocab tile: the largest kernel-legal tile dividing the vocab
+        # (the kernel requires vocab % block_v == 0, and its dW pass
+        # needs a dividing sub-tile — a 128-multiple keeps Mosaic's
+        # lane tiling happy)
+        bv = next((t for t in (2048, 1024, 512, 256, 128)
+                   if model.vocab % t == 0), None)
+        if bv is None:
+            raise ValueError(
+                f"vocab {model.vocab} has no 128-multiple tile divisor "
+                "<= 2048; pad the vocabulary to a multiple of 128 for "
+                "the fused-CE head")
+        loss, acc = fused_ce_head(
+            h.reshape(b * l, d), w, jnp.asarray(y, jnp.int32).reshape(-1),
+            block_v=bv)
+        return loss, (acc, {})
+
+    return loss_fn
+
+
 def bhld_to_blhd_params(model, params):
     """Convert a bhld-trained parameter tree to the blhd layout.
 

@@ -163,6 +163,18 @@ def fused_ce_head(h, w, y, block_rows: int = 256, block_v: int = 2048):
     Returns ``(loss, acc)`` — scalars, differentiable w.r.t. h and w
     (y gets no gradient). Rows are padded internally to the block size;
     padded rows are masked out of both loss and accuracy.
+
+    Under shard_map's varying-axis tracking, a REPLICATED head kernel
+    next to batch-varying hidden states would fail the kernel's dot with
+    mixed vma operands; ``_fwd`` pcasts ``w`` to ``h``'s varying axes
+    (inside ``_fwd`` — the custom_vjp PRIMAL body is swapped for
+    ``_fwd_rule`` under differentiation, so a pcast here would never run
+    on a training path; ``_fwd`` is shared by both, and the pcast ``w``
+    rides the residuals into ``_bwd_rule``). The compiled TPU path then
+    runs fine inside shard_map (bench.py's gated LM config is exactly
+    that); the INTERPRET-mode fallback still trips on kernel-internal
+    constants under check_vma — on the CPU mesh, call it outside
+    shard_map or with check_vma=False.
     """
     loss, acc, _ = _fwd(h, w, y, block_rows, block_v)
     return loss, acc
@@ -196,7 +208,10 @@ def _run_fwd(h, w, y, block_rows, block_v, interpret):
 
 
 def _fwd(h, w, y, block_rows, block_v):
+    from chainermn_tpu.utils import match_vma
+
     interpret = jax.default_backend() != "tpu"
+    w = match_vma(w, h)  # shard_map vma alignment (see fused_ce_head)
     n0, d = h.shape
     v = w.shape[1]
     if v % block_v:
@@ -256,8 +271,14 @@ def _bwd_rule(block_rows, block_v, res, g):
 
     # the dW pass holds a [D, VT] f32 scratch PLUS the [D, VT] weight
     # tile and [R, VT] recompute intermediates — at D=768/VT=2048 that
-    # exceeds scoped VMEM in-program; halve its vocab tile independently
+    # exceeds scoped VMEM in-program; halve its vocab tile independently.
+    # The halved tile must still DIVIDE the vocab (a remainder would
+    # leave the tail dW columns unwritten — silent gradient corruption);
+    # when it doesn't, fall back to block_v itself, which _fwd already
+    # validated — correct at a higher VMEM cost
     bv_dw = min(block_v, 1024)
+    if v % bv_dw:
+        bv_dw = block_v
     nv_dw = v // bv_dw
     dw = pl.pallas_call(
         functools.partial(_dw_kernel, vt=bv_dw, nr=nr),
