@@ -342,6 +342,25 @@ class TransformerLM(nn.Module):
     #                                    head+CE loss applies lm_head
     #                                    itself — ops/fused_ce.py)
 
+    def block_config(self) -> dict:
+        """The per-layer TransformerBlock constructor kwargs — ONE source
+        of truth shared by ``__call__`` and
+        :func:`make_lm_fsdp_scan_loss` (a field added here reaches both;
+        hand-copied kwargs in two sites silently diverged otherwise)."""
+        return dict(
+            d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
+            n_kv_heads=self.n_kv_heads, dtype=self.dtype,
+            attention=self.attention,
+            attention_window=self.attention_window,
+            attention_blocks=self.attention_blocks,
+            pos_emb=self.pos_emb, rope_theta=self.rope_theta,
+            seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+            moe_experts_per_device=self.moe_experts_per_device,
+            expert_axis=self.expert_axis,
+            capacity_factor=self.capacity_factor,
+            moe_top_k=self.moe_top_k, decode=self.decode,
+            max_len=self.max_len, qkv_layout=self.qkv_layout)
+
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
         b, l = tokens.shape
@@ -358,21 +377,8 @@ class TransformerLM(nn.Module):
         block_cls = (nn.remat(TransformerBlock)
                      if self.remat and not self.decode else TransformerBlock)
         for i in range(self.n_layers):
-            x = block_cls(
-                d_model=self.d_model, n_heads=self.n_heads, d_ff=self.d_ff,
-                n_kv_heads=self.n_kv_heads,
-                dtype=self.dtype, attention=self.attention,
-                attention_window=self.attention_window,
-                attention_blocks=self.attention_blocks,
-                pos_emb=self.pos_emb, rope_theta=self.rope_theta,
-                seq_axis=self.seq_axis, tp_axis=self.tp_axis,
-                moe_experts_per_device=self.moe_experts_per_device,
-                expert_axis=self.expert_axis,
-                capacity_factor=self.capacity_factor,
-                moe_top_k=self.moe_top_k,
-                decode=self.decode, max_len=self.max_len,
-                qkv_layout=self.qkv_layout,
-                name=f"block_{i}")(x, pos_offset=pos_offset)
+            x = block_cls(**self.block_config(),
+                          name=f"block_{i}")(x, pos_offset=pos_offset)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         if self.return_hidden:
             return x
@@ -463,14 +469,7 @@ def make_lm_fsdp_scan_loss(model):
                          "scan loss")
     from chainermn_tpu.ops.fused_ce import fused_ce_head
 
-    block = TransformerBlock(
-        d_model=model.d_model, n_heads=model.n_heads, d_ff=model.d_ff,
-        n_kv_heads=model.n_kv_heads, dtype=model.dtype,
-        attention=model.attention,
-        attention_window=model.attention_window,
-        attention_blocks=model.attention_blocks,
-        pos_emb=model.pos_emb, rope_theta=model.rope_theta,
-        max_len=model.max_len, qkv_layout=model.qkv_layout)
+    block = TransformerBlock(**model.block_config())
     embed = nn.Embed(model.vocab, model.d_model, dtype=model.dtype)
     ln_f = nn.LayerNorm(dtype=model.dtype)
 

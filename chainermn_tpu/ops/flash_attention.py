@@ -473,6 +473,59 @@ _FUSED_BWD_SCRATCH_BYTES = 2 * 2 ** 20
 _FUSED_BWD_MAX_LK = 2048
 
 
+def _flash_bwd_slabbed(q, k, v, do, lse, dr, *, causal, scale, block_q,
+                       block_k, interpret, hq, hkv, segs, slab):
+    """Long-Lk FUSED backward: KV sliced into slabs that fit the fused
+    kernel's whole-Lk VMEM scratch (r5). Per slab, causal structure is
+    block-wise — q rows before the slab contribute nothing, the diagonal
+    region runs with in-slab causal masking, rows after see the whole
+    slab unmasked — the ring executor's visiting-block trichotomy
+    (parallel/ring_attention.py `_ring_blocks`) applied serially on one
+    chip. Every (q, kv) tile pair still pays the fused kernel's 5 dots
+    (the split fallback pays 7), so sequences beyond the in-program
+    envelope keep the fused backward's arithmetic. dq accumulates in
+    f32 across slab contributions; each slab's dk/dv is the f32 sum of
+    its diagonal and suffix calls, concatenated along Lk."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    q_seg = kv_seg = None
+    if segs is not None:
+        q_seg, kv_seg = segs
+    dq32 = jnp.zeros((bh, lq, d), jnp.float32)
+    dks, dvs = [], []
+    for s0 in range(0, lk, slab):
+        s1 = min(s0 + slab, lk)
+        ks, vs = k[:, s0:s1], v[:, s0:s1]
+        kvs = None if kv_seg is None else kv_seg[:, :, s0:s1]
+        if causal:
+            # diagonal region: q rows [s0, s1) (lq == lk asserted at
+            # dispatch), in-slab causal; suffix: q rows [s1, lq) unmasked
+            regions = [(s0, s1, True)]
+            if s1 < lq:
+                regions.append((s1, lq, False))
+        else:
+            regions = [(0, lq, False)]
+        dk_acc = jnp.zeros((bh, s1 - s0, d), jnp.float32)
+        dv_acc = jnp.zeros((bh, s1 - s0, d), jnp.float32)
+        for r0, r1, diag in regions:
+            sub_segs = None
+            if q_seg is not None:
+                sub_segs = (q_seg[:, r0:r1], kvs)
+            dq_p, dk_p, dv_p = _flash_bwd_3d(
+                q[:, r0:r1], ks, vs, do[:, r0:r1],
+                lse[:, r0:r1], dr[:, r0:r1],
+                causal=diag, scale=scale, block_q=block_q,
+                block_k=block_k, interpret=interpret, hq=hq, hkv=hkv,
+                segs=sub_segs)
+            dq32 = dq32.at[:, r0:r1].add(dq_p.astype(jnp.float32))
+            dk_acc = dk_acc + dk_p.astype(jnp.float32)
+            dv_acc = dv_acc + dv_p.astype(jnp.float32)
+        dks.append(dk_acc.astype(k.dtype))
+        dvs.append(dv_acc.astype(v.dtype))
+    return (dq32.astype(q.dtype), jnp.concatenate(dks, axis=1),
+            jnp.concatenate(dvs, axis=1))
+
+
 def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
                   interpret, hq=1, hkv=1, segs=None, window=None):
     """q/do: [B*Hq, Lq, D]; k/v: [B*Hkv, Lk, D]; lse/dr: [B*Hq, Lq] →
@@ -480,6 +533,22 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
     hkv < hq]). ``segs``: (q_seg [B, Lq, 1], kv_seg [B, 1, Lk])."""
     bh, lq, d = q.shape
     lk = k.shape[1]
+    fused_ok = (2 * lk * d * 4 <= _FUSED_BWD_SCRATCH_BYTES
+                and lk <= _FUSED_BWD_MAX_LK)
+    if not fused_ok and window is None and (not causal or lq == lk):
+        # beyond the fused envelope: slab the KV range so each piece
+        # fits it, keeping the 5-dot fused kernel (window masking is
+        # position-relative and would break on slices — it stays on the
+        # split path; causal slabbing needs the self-attention lq == lk
+        # alignment)
+        slab = min(_FUSED_BWD_MAX_LK,
+                   _FUSED_BWD_SCRATCH_BYTES // (8 * d))
+        slab -= slab % 128  # lane-aligned; >= 128 keeps legal tiles
+        if slab >= 128:
+            return _flash_bwd_slabbed(
+                q, k, v, do, lse, dr, causal=causal, scale=scale,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+                hq=hq, hkv=hkv, segs=segs, slab=slab)
     lse = lse.reshape(bh, lq, 1)   # minimal legal TPU block layout
     dr = dr.reshape(bh, lq, 1)
     bq = _fit_block(block_q, lq)
@@ -500,8 +569,7 @@ def _flash_bwd_3d(q, k, v, do, lse, dr, *, causal, scale, block_q, block_k,
         in_specs += list(_seg_specs(hq, bq, bk))
         operands += segs
 
-    if (2 * lk * d * 4 <= _FUSED_BWD_SCRATCH_BYTES
-            and lk <= _FUSED_BWD_MAX_LK):
+    if fused_ok:  # the ONE envelope predicate, computed at dispatch
         dkv_full = pl.BlockSpec((1, lk, d), lambda b, qi, ki: (b, 0, 0),
                                 memory_space=pltpu.VMEM)
         return pl.pallas_call(

@@ -124,3 +124,34 @@ def test_fused_lm_loss_end_to_end():
 
 # full-suite only: the quick battery must stay well under its 120 s
 # budget and these interpret-mode kernel tests cost ~25 s
+
+
+def test_dw_tile_fallback_non_dividing_halved_tile():
+    """Regression (r5 review): with block_v > 1024 and vocab not a
+    multiple of 1024, the dW pass's halved tile would not divide the
+    vocab — the old code left the tail dW columns UNWRITTEN (silently
+    zero gradients for part of the head). The fallback must keep every
+    column correct; compare against the unfused XLA loss's gradients."""
+    import optax
+
+    rng = np.random.RandomState(31)
+    n, d, v = 128, 32, 1536  # v % 1024 != 0, block_v = v > 1024
+    h = jnp.asarray(rng.randn(n, d).astype(np.float32) * 0.3)
+    w = jnp.asarray(rng.randn(d, v).astype(np.float32) * 0.05)
+    y = jnp.asarray(rng.randint(0, v, size=(n,)).astype(np.int32))
+
+    def loss_fused(h, w):
+        return fused_ce_head(h, w, y, 128, v)[0]
+
+    def loss_ref(h, w):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            (h @ w).astype(jnp.float32), y).mean()
+
+    gf = jax.grad(loss_fused, argnums=(0, 1))(h, w)
+    gr = jax.grad(loss_ref, argnums=(0, 1))(h, w)
+    # the tail columns (>= 1024) are exactly where the old bug zeroed dW
+    tail = np.asarray(gf[1][:, 1024:])
+    assert np.abs(tail).max() > 0, "tail dW columns are all zero"
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
