@@ -76,6 +76,12 @@ def main():
     p.add_argument("--seq-impl", choices=["ring", "ring_flash",
                                           "ulysses"], default="ring",
                    help="sequence-parallel attention used by --ring")
+    p.add_argument("--fsdp-scan", action="store_true",
+                   help="FSDP over a SCANNED layer stack: stack_lm_blocks"
+                        " + make_lm_fsdp_scan_loss — the compiler-forced "
+                        "per-layer gather bound (peak gathered params = "
+                        "one layer) with the fused head+CE loss; needs "
+                        "vocab % 128 == 0")
     p.add_argument("--zero", type=int, default=0, choices=[0, 1, 2, 3],
                    help="ZeRO stage: 1 = sharded optimizer state, 2 = +"
                         "sharded grad accumulator (2 microbatches), "
@@ -149,6 +155,13 @@ def main():
         qkv_layout=args.qkv_layout,
     )
     sample = np.zeros((1, args.seq_len), np.int32)
+    if args.fsdp_scan and args.moe > 0:
+        # make_lm_fsdp_scan_loss would refuse MoE anyway, but the MoE
+        # branch below is taken first — fail HERE instead of silently
+        # dropping the flag
+        raise SystemExit("--fsdp-scan does not compose with --moe (the "
+                         "load-balancing aux cannot thread through the "
+                         "scan)")
     if args.moe > 0:
         from chainermn_tpu.training.step import (
             init_expert_parallel_state,
@@ -174,7 +187,30 @@ def main():
             max_len=args.seq_len, attention=attention, **lm_kw)
         params = model.init(jax.random.PRNGKey(0), sample)["params"]
         params = comm.bcast_data(params)
-        if args.zero:
+        if args.fsdp_scan:
+            # the r5 flagship FSDP form (models/transformer.py
+            # make_lm_fsdp_scan_loss): layer stack scanned, one layer
+            # gathered at a time, re-gathered in backward
+            if args.zero:
+                raise SystemExit("--fsdp-scan and --zero are exclusive")
+            if args.vocab % 128:
+                raise SystemExit("--fsdp-scan needs vocab % 128 == 0 "
+                                 "(fused head+CE vocab tile)")
+            from chainermn_tpu.models.transformer import (
+                make_lm_fsdp_scan_loss, stack_lm_blocks)
+            from chainermn_tpu.optimizers import (fsdp_shardings,
+                                                  fsdp_stack_shardings,
+                                                  make_fsdp_train_step)
+
+            packed = stack_lm_blocks(params)
+            shardings = dict(
+                fsdp_shardings(packed, comm),
+                blocks=fsdp_stack_shardings(packed, comm)["blocks"])
+            step, state = make_fsdp_train_step(
+                None, optax.adam(args.lr), comm, packed,
+                loss_fn=make_lm_fsdp_scan_loss(model),
+                param_shardings=shardings)
+        elif args.zero:
             # sharded training (beyond reference, optimizers/zero.py):
             # adam m/v live 1/N per device; --zero-bucket-kib additionally
             # reduce-scatters each gradient bucket as backward produces
@@ -229,12 +265,12 @@ def main():
               f"acc={final.get('main/accuracy'):.4f}")
 
     if args.ring and (args.moe > 0 or args.n_kv_heads or args.zero
-                      or args.qkv_layout != "blhd"):
+                      or args.fsdp_scan or args.qkv_layout != "blhd"):
         if comm.is_master:
             print("--ring demo skipped: it reuses the trained params, and "
-                  "a MoE/GQA/ZeRO/bhld run produces a different param "
-                  "structure/layout than the sequence-parallel model "
-                  "expects")
+                  "a MoE/GQA/ZeRO/fsdp-scan/bhld run produces a different "
+                  "param structure/layout than the sequence-parallel "
+                  "model expects")
     elif args.ring and args.seq_impl == "ulysses" and (
             args.n_heads % comm.size):
         if comm.is_master:
