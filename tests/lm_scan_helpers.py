@@ -1,0 +1,34 @@
+"""Shared helpers for the flagship scan-FSDP tests.
+
+One definition of the canonical tiny TransformerLM and the
+stack-and-shard recipe, used by tests/optimizers_tests/test_zero.py and
+tests/extensions_tests/test_sharded_checkpoint.py — the setup API has
+exactly one place to change."""
+
+
+def tiny_lm():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    # vocab 2048 = one fused-CE kernel tile (the kernel needs
+    # vocab % block_v == 0)
+    return TransformerLM(vocab=2048, d_model=32, n_heads=4, n_layers=4,
+                         d_ff=64, max_len=16, pos_emb="rope",
+                         attention="reference")
+
+
+def lm_scan_setup(comm, model, params, opt):
+    """(step, state) for the scanned-stack FSDP form of ``model``: the
+    documented stack_lm_blocks + mixed-shardings + make_lm_fsdp_scan_loss
+    recipe."""
+    from chainermn_tpu.models.transformer import (make_lm_fsdp_scan_loss,
+                                                  stack_lm_blocks)
+    from chainermn_tpu.optimizers import (fsdp_shardings,
+                                          fsdp_stack_shardings,
+                                          make_fsdp_train_step)
+
+    packed = stack_lm_blocks(params)
+    shardings = dict(fsdp_shardings(packed, comm),
+                     blocks=fsdp_stack_shardings(packed, comm)["blocks"])
+    return make_fsdp_train_step(None, opt, comm, packed,
+                                loss_fn=make_lm_fsdp_scan_loss(model),
+                                param_shardings=shardings, donate=False)

@@ -485,28 +485,13 @@ def test_fsdp_stack_shardings_never_shard_stack_dim(comm):
     assert np.isfinite(float(m["main/loss"]))
 
 
-def _tiny_lm():
-    from chainermn_tpu.models.transformer import TransformerLM
+import os as _os
+import sys as _sys
 
-    # vocab 2048 = one fused-CE kernel tile (the kernel needs
-    # vocab % block_v == 0)
-    return TransformerLM(vocab=2048, d_model=32, n_heads=4, n_layers=4,
-                         d_ff=64, max_len=16, pos_emb="rope",
-                         attention="reference")
-
-
-def _lm_scan_setup(comm, model, params, opt):
-    from chainermn_tpu.models.transformer import (
-        make_lm_fsdp_scan_loss, stack_lm_blocks)
-    from chainermn_tpu.optimizers import (fsdp_shardings,
-                                          fsdp_stack_shardings)
-
-    packed = stack_lm_blocks(params)
-    shardings = dict(fsdp_shardings(packed, comm),
-                     blocks=fsdp_stack_shardings(packed, comm)["blocks"])
-    return make_fsdp_train_step(None, opt, comm, packed,
-                                loss_fn=make_lm_fsdp_scan_loss(model),
-                                param_shardings=shardings, donate=False)
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))
+from lm_scan_helpers import lm_scan_setup as _lm_scan_setup  # noqa: E402
+from lm_scan_helpers import tiny_lm as _tiny_lm  # noqa: E402
 
 
 def test_lm_fsdp_scan_matches_replicated(comm):
