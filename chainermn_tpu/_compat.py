@@ -1,0 +1,87 @@
+"""Version shims for the supported jax range.
+
+The codebase targets the modern top-level ``jax.shard_map`` spelling;
+older jaxlibs (< 0.5) only ship it as
+``jax.experimental.shard_map.shard_map``. Publishing the attribute on
+the ``jax`` module keeps every ``from jax import shard_map`` site —
+package, examples, tools, and embedded multi-process worker scripts —
+working on both sides of the move with a single shim, imported first
+thing by :mod:`chainermn_tpu`.
+"""
+
+import jax
+from jax import lax
+
+if not hasattr(jax, "shard_map"):
+    import functools
+    import inspect
+
+    from jax.experimental.shard_map import shard_map as _experimental_sm
+
+    if "check_vma" in inspect.signature(_experimental_sm).parameters:
+        shard_map = _experimental_sm
+    else:
+        # the replication-check kwarg was renamed check_rep -> check_vma
+        # along with the move to the top level
+        @functools.wraps(_experimental_sm)
+        def shard_map(*args, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            # the old static replication checker predates the vma system
+            # this codebase is written against: it has no pallas_call
+            # rule and refuses out_specs whose replication it cannot
+            # infer, both of which the vma checker handles. Default it
+            # off; callers that ask for checking still get it.
+            kwargs.setdefault("check_rep", False)
+            return _experimental_sm(*args, **kwargs)
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax, "typeof"):
+    from jax._src import core as _src_core
+
+    class _AvalView:
+        """Aval plus an (empty) ``vma`` set.
+
+        Old jax has no varying-manual-axes tracking; every caller in this
+        codebase probes ``typeof(x).vma`` and falls back to its
+        tracking-off path when the set is empty, so an empty frozenset is
+        the correct answer everywhere.
+        """
+
+        vma = frozenset()
+
+        def __init__(self, aval):
+            self._aval = aval
+
+        def __getattr__(self, name):
+            return getattr(self._aval, name)
+
+        def __repr__(self):
+            return repr(self._aval)
+
+    def _typeof(x):
+        return _AvalView(_src_core.get_aval(x))
+
+    jax.typeof = _typeof
+
+if not hasattr(lax, "pcast"):
+    # pcast only adjusts vma metadata; with tracking off it is identity
+    def _pcast(x, axis_name, *, to=None):
+        return x
+
+    lax.pcast = _pcast
+
+if not hasattr(lax, "axis_size"):
+    from jax._src import core as _src_core
+
+    def _axis_size(axis_name):
+        # pre-0.5 jax: core.axis_frame(name) IS the static size
+        if isinstance(axis_name, (tuple, list)):
+            size = 1
+            for a in axis_name:
+                size *= _src_core.axis_frame(a)
+            return size
+        return _src_core.axis_frame(axis_name)
+
+    lax.axis_size = _axis_size

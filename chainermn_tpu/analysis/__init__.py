@@ -1,0 +1,56 @@
+"""dlint — distributed-correctness static analysis for the whole stack.
+
+Distributed training fails in ways single-process code never does:
+mismatched collectives deadlock, rank-dependent control flow diverges,
+channel tags collide, and overlap regressions silently serialize comms.
+Compiler-level collective tooling (GC3, arxiv 2201.11840; TACCL, arxiv
+2111.04867) shows collective programs are tractable objects for static
+checking; this package brings that discipline in-repo as a permanent
+analysis subsystem instead of per-round manual audits (the round-5
+unsynced-step-loop flake was caught only by a manual AST pass — that
+audit is now rule DL104).
+
+Two pass families, one CLI (``tools/dlint.py``):
+
+* **AST passes** (:mod:`.ast_passes`) run over Python sources —
+  ``chainermn_tpu/``, ``examples/``, ``tests/``, ``tools/``:
+
+  - ``DL101`` divergent collective under rank-dependent control flow
+  - ``DL102`` eager-P2P channel-tag collision / reserved-namespace use
+  - ``DL103`` root argument from the wrong rank space
+  - ``DL104`` step-dispatch loop without a per-iteration sync
+
+* **Compiled-HLO passes** (:mod:`.hlo_passes`) run over scheduled HLO
+  text (``compiled.as_text()``) — the generalized form of
+  ``tools/check_overlap_schedule.py``, which is now a thin wrapper:
+
+  - ``DL201`` gradient all-reduce must overlap backward compute
+  - ``DL202`` per-step collective-count budget
+  - ``DL203`` 1F1B wire permutes must be async with compute inside
+  - ``DL204`` degenerate FSDP all-gather prefetch (gathered layers co-live)
+
+Every rule has a stable ID, a fix-it message citing the docs
+(docs/static_analysis.md catalogues each with a minimal failing
+example), and positive/negative fixture tests under
+``tests/analysis_tests/``. Findings are suppressed in source with a
+``# dlint: disable=RULE`` comment on the flagged line (or the line
+directly above it) — suppressions should carry a rationale.
+"""
+
+from chainermn_tpu.analysis import ast_passes  # noqa: F401  (registers DL1xx)
+from chainermn_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RULES,
+    iter_python_files,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from chainermn_tpu.analysis.hlo_passes import (  # noqa: F401
+    check_collective_budget,
+    check_dp_overlap,
+    check_fsdp_gather_liveness,
+    check_pipeline_permute_overlap,
+    parse_computations,
+    scheduled_entry_ops,
+)

@@ -1,0 +1,155 @@
+"""dlint core: findings, the rule registry, suppressions, and the driver.
+
+The shape every pass shares: a pass is a function
+``(tree, src, path) -> list[Finding]`` registered under a stable rule ID.
+The driver parses each file once, collects ``# dlint: disable=RULE``
+comments from the token stream (so string literals containing the marker
+cannot suppress anything), runs every requested pass, and drops findings
+whose line — or the line directly above, for multi-line calls and
+statement-level suppressions — carries a matching disable comment.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+# ``# dlint: disable=DL101`` or ``# dlint: disable=DL101,DL104`` or
+# ``# dlint: disable=all``
+_DISABLE_RE = re.compile(r"#\s*dlint:\s*disable=([\w,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str          # stable ID, e.g. "DL101"
+    path: str          # file the finding is in
+    line: int          # 1-indexed line of the offending node
+    message: str       # what is wrong + the fix-it, citing docs
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class Rule:
+    """Registry entry: a pass plus its catalogue metadata."""
+
+    rule_id: str
+    name: str
+    doc: str           # docs/static_analysis.md anchor for the fix-it
+    check: Callable    # (tree, src, path) -> List[Finding]
+    kind: str = "ast"  # "ast" | "hlo" (hlo rules are not file passes)
+
+
+#: rule_id -> Rule. AST passes register themselves on import
+#: (see :mod:`.ast_passes`); HLO rules register metadata only — they run
+#: on compiled HLO text via :mod:`.hlo_passes`, not on source files.
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.rule_id in RULES:
+        raise ValueError(f"duplicate dlint rule id {rule.rule_id}")
+    RULES[rule.rule_id] = rule
+    return rule
+
+
+def suppressed_lines(src: str) -> Dict[int, set]:
+    """line -> set of rule IDs disabled there (``{"all"}`` disables all).
+
+    Read from the TOKEN stream, not a regex over raw lines: a string
+    literal that happens to contain the marker (e.g. this module's own
+    docstrings, or a test fixture embedded as a string) must not
+    suppress anything.
+    """
+    out: Dict[int, set] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE_RE.search(tok.string)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                out.setdefault(tok.start[0], set()).update(rules)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return out
+
+
+def _is_suppressed(f: Finding, disables: Dict[int, set]) -> bool:
+    for line in (f.line, f.line - 1):
+        rules = disables.get(line)
+        if rules and (f.rule in rules or "all" in rules):
+            return True
+    return False
+
+
+def lint_source(src: str, path: str = "<string>",
+                rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the AST passes over one source string. ``rules`` restricts to
+    the given IDs (default: every registered AST rule)."""
+    # passes register on import; import here so `import analysis.core`
+    # alone never yields an empty registry
+    from chainermn_tpu.analysis import ast_passes  # noqa: F401
+
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("DL000", path, e.lineno or 1,
+                        f"syntax error blocks analysis: {e.msg}")]
+    disables = suppressed_lines(src)
+    findings: List[Finding] = []
+    for rule in RULES.values():
+        if rule.kind != "ast":
+            continue
+        if rules is not None and rule.rule_id not in rules:
+            continue
+        findings.extend(rule.check(tree, src, path))
+    findings = [f for f in findings if not _is_suppressed(f, disables)]
+    # a call nested under two rank-dependent Ifs can be reported by both
+    # evaluations; one report per (rule, line) is enough
+    findings = sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_file(path: str,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    return lint_source(src, path, rules=rules)
+
+
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist",
+              ".eggs", "node_modules"}
+
+
+def iter_python_files(roots: Iterable[str]) -> List[str]:
+    """Every .py under the given files/directories, sorted, deduped."""
+    out = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the AST passes over every .py file under ``paths``."""
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path, rules=rules))
+    return findings

@@ -1,0 +1,328 @@
+"""dlint HLO passes: schedule-level distributed-correctness checks.
+
+These run on *compiled* HLO text (``compiled.as_text()`` of a lowered
+computation, or a saved dump) — the generalized form of
+``tools/check_overlap_schedule.py``, which is now a thin wrapper over
+this module. Source-level rules (DL1xx, :mod:`.ast_passes`) can only
+prove a program *says* the right thing; these prove the compiler
+*scheduled* the right thing:
+
+* ``DL201`` :func:`check_dp_overlap` — in a latency-hiding-scheduled
+  module, the FIRST gradient all-reduce must be placed before the LAST
+  backward op (ops carrying ``transpose(jvp`` metadata), i.e. gradient
+  collectives issue while backward compute remains rather than
+  serializing after it (docs/scaling_model.md §2).
+* ``DL202`` :func:`check_collective_budget` — the scheduled entry (or a
+  named computation) must not exceed a per-step collective-op budget;
+  a bucketing/combining regression shows up as a collective-count jump
+  long before it shows up in step time.
+* ``DL203`` :func:`check_pipeline_permute_overlap` — 1F1B wire
+  ppermutes must lower to async collective-permute-start/done pairs
+  with ≥1 real compute op inside EVERY pair's own window and no
+  synchronous collective-permute fallback (docs/scaling_model.md §6).
+* ``DL204`` :func:`check_fsdp_gather_liveness` — FSDP parameter
+  all-gathers must not all be live at once: if the peak number of
+  concurrently-live gathered buffers is ~every layer, sharding only
+  saved optimizer memory and the prefetch is degenerate (the
+  ``make_fsdp_train_step`` 0.93×-full-params peak of VERDICT weak #2;
+  the scan path pins the bound instead).
+
+Every checker returns a dict with at least ``{"ok": bool, ...}``
+evidence fields; ``ok=None`` with a ``skip`` key means the input had
+nothing to check (e.g. an unscheduled module).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from chainermn_tpu.analysis.core import Rule, register
+
+_DOC = "docs/static_analysis.md"
+
+for _rid, _name in (("DL201", "dp-allreduce-overlap"),
+                    ("DL202", "collective-budget"),
+                    ("DL203", "pipeline-permute-overlap"),
+                    ("DL204", "fsdp-gather-liveness")):
+    register(Rule(_rid, _name, f"{_DOC}#{_rid.lower()}",
+                  check=None, kind="hlo"))
+
+
+#: collective op kinds counted by the budget pass (start/done async
+#: halves count once, via the -start form; the bare form is the sync op)
+COLLECTIVE_OPS = (
+    "all-reduce", "all-reduce-start", "all-gather", "all-gather-start",
+    "reduce-scatter", "all-to-all", "collective-permute",
+    "collective-permute-start", "collective-broadcast",
+)
+
+
+def scheduled_entry_ops(hlo_text: str) -> List[Tuple[str, str]]:
+    """(op_kind, full_line) per instruction of the ENTRY computation, in
+    schedule order (meaningful when the module is ``is_scheduled=true``)."""
+    ops = []
+    in_entry = False
+    for ln in hlo_text.splitlines():
+        if ln.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if in_entry:
+            if ln.startswith("}"):
+                break
+            s = ln.strip()
+            if s.startswith("ROOT "):
+                s = s[len("ROOT "):]
+            if not re.match(r"%?[\w.-]+ = ", s):
+                continue
+            # the opcode is the token right before the operand list:
+            # the leftmost space-preceded lowercase token directly
+            # followed by "(". Result types can't shadow it — tuple
+            # types open with "= (", and the tile/memory annotations
+            # inside them ("T(8,128)", "S(1)") are uppercase. Operands
+            # may carry full types ("all-reduce(f32[...] %x, ...)"),
+            # so nothing stricter than the bare paren can be anchored.
+            m = re.search(r" ([a-z][\w-]*)\(", s)
+            if m:
+                ops.append((m.group(1), s))
+    return ops
+
+
+def parse_computations(
+        hlo_text: str) -> Dict[str, List[Tuple[str, str, List[str]]]]:
+    """name -> [(op_kind, result_name, [operand_names])] per HLO
+    computation, in textual (= schedule, when scheduled) order."""
+    comps: Dict[str, List[Tuple[str, str, List[str]]]] = {}
+    cur: Optional[str] = None
+    for ln in hlo_text.splitlines():
+        m = re.match(r"^(?:ENTRY )?%?([\w.-]+) \(.*\{\s*$", ln)
+        if m:
+            cur = m.group(1)
+            comps[cur] = []
+            continue
+        if cur is not None:
+            if ln.startswith("}"):
+                cur = None
+                continue
+            s = ln.strip()
+            if s.startswith("ROOT "):
+                s = s[len("ROOT "):]
+            mm = re.match(r"%?([\w.-]+) = .*? ([a-z][\w-]*)\((.*)", s)
+            if mm:
+                operands = re.findall(r"%([\w.-]+)", mm.group(3))
+                comps[cur].append((mm.group(2), mm.group(1), operands))
+    return comps
+
+
+# ---------------------------------------------------------------------------
+# DL201 — gradient all-reduce must overlap backward compute
+# ---------------------------------------------------------------------------
+
+
+def check_dp_overlap(hlo_text: str) -> dict:
+    """Does the scheduled entry start gradient all-reduces before
+    backward compute ends?"""
+    ops = scheduled_entry_ops(hlo_text)
+    ar = [i for i, (k, _) in enumerate(ops)
+          if k in ("all-reduce", "all-reduce-start")]
+    bwd = [i for i, (_, s) in enumerate(ops) if "transpose(jvp" in s]
+    out = {
+        "rule": "DL201",
+        "is_scheduled": "is_scheduled=true" in hlo_text,
+        "n_sched_ops": len(ops),
+        "n_allreduce": len(ar),
+        "first_allreduce": min(ar) if ar else None,
+        "last_backward": max(bwd) if bwd else None,
+        "backward_ops_after_first_allreduce": (
+            sum(1 for i in bwd if i > min(ar)) if ar else 0),
+        "async_pairs": bool(re.search(r"all-reduce-start", hlo_text)),
+    }
+    out["ok"] = bool(
+        out["is_scheduled"] and ar and bwd and min(ar) < max(bwd))
+    if not out["ok"]:
+        out["fix"] = (
+            "compile with xla_tpu_enable_latency_hiding_scheduler=true "
+            "and xla_enable_async_all_reduce=true so gradient "
+            f"all-reduces hide in the backward window ({_DOC}#dl201)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DL202 — per-step collective-count budget
+# ---------------------------------------------------------------------------
+
+
+def check_collective_budget(hlo_text: str, budget: int,
+                            computation: Optional[str] = None) -> dict:
+    """At most ``budget`` collective ops per step.
+
+    Counts :data:`COLLECTIVE_OPS` in the scheduled entry (or in a named
+    computation, e.g. a pipeline while-body). A combiner/bucketing
+    regression multiplies the per-step collective count — catch it at
+    compile time, not in the profile.
+    """
+    if computation is None:
+        kinds = [k for k, _ in scheduled_entry_ops(hlo_text)]
+    else:
+        comps = parse_computations(hlo_text)
+        if computation not in comps:
+            return {"rule": "DL202", "ok": None,
+                    "skip": f"no computation named {computation!r}"}
+        kinds = [k for k, _, _ in comps[computation]]
+    counts: Dict[str, int] = {}
+    for k in kinds:
+        if k in COLLECTIVE_OPS:
+            counts[k] = counts.get(k, 0) + 1
+    total = sum(counts.values())
+    out = {"rule": "DL202", "n_collectives": total, "budget": budget,
+           "by_kind": counts, "ok": total <= budget}
+    if not out["ok"]:
+        out["fix"] = (
+            f"{total} collectives exceed the per-step budget of {budget}; "
+            "check dcn_bucket_bytes / the XLA all-reduce combiner "
+            f"threshold before profiling ({_DOC}#dl202)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DL203 — 1F1B wire permutes must be async with compute inside
+# ---------------------------------------------------------------------------
+
+
+def check_pipeline_permute_overlap(hlo_text: str) -> dict:
+    """Every collective-permute must be an async start/done pair with
+    ≥1 real compute op (fusion/dot/custom-call) scheduled inside ITS OWN
+    window, and no op may fall back to a synchronous collective-permute.
+
+    Scans every computation and reports the one with the most permute
+    pairs (the pipeline while-body); compute counted inside an unrelated
+    pair's gap must not certify an individually-serialized hop, so each
+    start is matched to the done consuming its result.
+    """
+    best = None
+    for name, ops in parse_computations(hlo_text).items():
+        starts = [(i, res) for i, (k, res, _) in enumerate(ops)
+                  if k == "collective-permute-start"]
+        if not starts:
+            continue
+        fusions = [i for i, (k, _, _) in enumerate(ops)
+                   if k in ("fusion", "dot", "custom-call")]
+        pairs = []
+        for si, res in starts:
+            done = next((i for i, (k, _, opr) in enumerate(ops)
+                         if i > si and k == "collective-permute-done"
+                         and res in opr), None)
+            if done is not None:
+                pairs.append(
+                    (si, done, sum(1 for f in fusions if si < f < done)))
+        if not pairs:
+            continue
+        cand = {
+            "body": name,
+            "n_body_ops": len(ops),
+            "n_permute_pairs": len(pairs),
+            "pairs": [{"start": s, "done": d, "compute_inside": c}
+                      for s, d, c in pairs],
+            "min_compute_inside_any_pair": min(c for _, _, c in pairs),
+            "n_compute": len(fusions),
+        }
+        if best is None or cand["n_permute_pairs"] > best["n_permute_pairs"]:
+            best = cand
+
+    out = best or {"n_permute_pairs": 0}
+    out["rule"] = "DL203"
+    out["sync_permutes"] = len(
+        re.findall(r"= *\S* *collective-permute\(", hlo_text))
+    # ok = both rings async, EVERY hop hides >=1 real compute op inside
+    # its own start->done window, and nothing fell back to a synchronous
+    # collective-permute
+    out["ok"] = bool(best and best["n_permute_pairs"] >= 2
+                     and best["min_compute_inside_any_pair"] >= 1
+                     and out["sync_permutes"] == 0)
+    if not out["ok"]:
+        out["fix"] = (
+            "the wire hop is serialized with tick compute; enable the "
+            "latency-hiding scheduler and keep per-tick compute large "
+            f"enough to hide the permute ({_DOC}#dl203)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DL204 — degenerate FSDP all-gather prefetch
+# ---------------------------------------------------------------------------
+
+
+def check_fsdp_gather_liveness(hlo_text: str,
+                               max_live: int = 2,
+                               computation: Optional[str] = None) -> dict:
+    """Peak number of concurrently-live all-gathered buffers.
+
+    For each all-gather (sync, or async via its -start/-done pair) in
+    the computation, the gathered value is live from its definition to
+    its last textual use. If nearly all of them overlap — peak live ≈
+    total gathers — XLA prefetched EVERY layer's parameters up front:
+    peak memory is back to the unsharded model and FSDP only sharded
+    optimizer state (the degenerate ``make_fsdp_train_step`` shape;
+    ``fsdp_scan_apply`` pins the bound to one layer instead).
+
+    ``max_live`` is the allowed peak (2 admits the standard
+    prefetch-one-layer-ahead pipeline).
+    """
+    comps = parse_computations(hlo_text)
+    if computation is not None:
+        if computation not in comps:
+            return {"rule": "DL204", "ok": None,
+                    "skip": f"no computation named {computation!r}"}
+        selected = {computation: comps[computation]}
+    else:
+        selected = comps
+
+    # pick the computation with the most all-gathers (entry for the
+    # degenerate case, the scan/while body for the pinned case)
+    best_name, best_ops, best_n = None, None, 0
+    for name, ops in selected.items():
+        n = sum(1 for k, _, _ in ops
+                if k in ("all-gather", "all-gather-start"))
+        if n > best_n:
+            best_name, best_ops, best_n = name, ops, n
+    if best_ops is None:
+        return {"rule": "DL204", "ok": None, "skip": "no all-gathers"}
+
+    last_use = {}
+    for i, (_, _, operands) in enumerate(best_ops):
+        for o in operands:
+            last_use[o] = i
+    intervals = []
+    for i, (k, res, operands) in enumerate(best_ops):
+        if k == "all-gather":
+            intervals.append((i, last_use.get(res, i)))
+        elif k == "all-gather-start":
+            # live from the start; the value consumers use is the done's
+            # result — extend to ITS last use
+            done = next(
+                ((j, r) for j, (kk, r, opr) in enumerate(best_ops)
+                 if j > i and kk == "all-gather-done" and res in opr),
+                None)
+            end = last_use.get(done[1], done[0]) if done else \
+                last_use.get(res, i)
+            intervals.append((i, end))
+    peak = 0
+    for i in range(len(best_ops)):
+        live = sum(1 for s, e in intervals if s <= i <= e)
+        peak = max(peak, live)
+    out = {
+        "rule": "DL204",
+        "computation": best_name,
+        "n_gathers": len(intervals),
+        "peak_live_gathers": peak,
+        "max_live": max_live,
+        "ok": peak <= max_live,
+    }
+    if not out["ok"]:
+        out["fix"] = (
+            f"{peak} of {len(intervals)} gathered parameter buffers are "
+            "live at once — the prefetch is degenerate and peak memory "
+            "is back at the unsharded model. Stack the layers and use "
+            "fsdp_scan_apply + fsdp_stack_shardings to pin the bound "
+            f"({_DOC}#dl204)")
+    return out

@@ -54,7 +54,19 @@ class CommunicatorBase(abc.ABC):
     @property
     @abc.abstractmethod
     def rank(self) -> int:
-        """Global rank of this process's first local device."""
+        """Rank of this process's first local device in THIS
+        communicator — dense in ``[0, size)`` (the reference invariant),
+        so it is always a valid root/peer for this communicator's
+        collectives. See :attr:`global_index` for the mesh-global
+        position."""
+
+    @property
+    def global_index(self) -> int:
+        """Mesh-global flat index of this process's first device. Equal
+        to :attr:`rank` on a full-mesh communicator; on sub-axis
+        communicators it can exceed ``size`` — a coordinate for
+        bookkeeping, never a root (dlint DL103)."""
+        return self.rank
 
     @property
     @abc.abstractmethod

@@ -147,15 +147,37 @@ class XlaCommunicator(CommunicatorBase):
 
     @property
     def rank(self) -> int:
-        # Global index of this process's first addressable device within the
-        # communicator's rank space. Single-controller: 0, and the driver
-        # stands in for every rank.
+        """Rank of this process's first device IN THIS COMMUNICATOR —
+        dense in ``[0, size)``, so it is always a valid root/peer for
+        this communicator's collectives (the reference invariant
+        ``0 <= rank < size``). On a sub-axis communicator a rank names a
+        device group; this is the first group containing one of this
+        process's devices. Single-controller: 0, and the driver stands
+        in for every rank. The mesh-global flat position (the old
+        convention, which could exceed ``size`` on sub-axis
+        communicators) lives at :attr:`global_index`."""
+        if jax.process_count() == 1:
+            return 0
+        pid = jax.process_index()
+        groups = self._comm_device_groups()
+        for i in range(groups.shape[0]):
+            if any(int(d.process_index) == pid for d in groups[i]):
+                return i
+        return 0
+
+    @property
+    def global_index(self) -> int:
+        """Flat-mesh index of this process's first addressable device —
+        a MESH coordinate, not a rank: it can reach ``mesh.devices.size``
+        on sub-axis communicators, so never pass it as a root (dlint
+        DL103). Use it for mesh-global bookkeeping (labels, logs);
+        use :attr:`rank` to address this communicator's collectives."""
         if jax.process_count() == 1:
             return 0
         flat = self._mesh.devices.reshape(-1)
         for i, d in enumerate(flat):
             if d.process_index == jax.process_index():
-                return i
+                return int(i)
         return 0
 
     @property
@@ -346,14 +368,21 @@ class XlaCommunicator(CommunicatorBase):
                 "processes); use the compiled in-graph collectives on the "
                 "sub-mesh instead")
 
-    def _comm_devices(self) -> np.ndarray:
-        """Devices of this communicator's axes, flattened in rank order."""
+    def _comm_device_groups(self) -> np.ndarray:
+        """(size, k) device array: row r is rank r's device group — one
+        member per complementary mesh coordinate (k == 1 when the
+        communicator spans the whole mesh)."""
         names = self._mesh.axis_names
         perm = [names.index(a) for a in self._axes] + [
             i for i, a in enumerate(names) if a not in self._axes
         ]
         d = np.transpose(self._mesh.devices, perm)
-        return d.reshape(self._size, -1)[:, 0]
+        return d.reshape(self._size, -1)
+
+    def _comm_devices(self) -> np.ndarray:
+        """Rank-representative devices (each rank group's first member),
+        flattened in rank order."""
+        return self._comm_device_groups()[:, 0]
 
     # -- array collectives ----------------------------------------------
 
@@ -713,26 +742,25 @@ class XlaCommunicator(CommunicatorBase):
         one source of truth already, so this lowers to replication placement
         (plus a host-plane broadcast when processes may disagree).
 
-        ``root`` is a rank in this communicator's rank space; multi-process
-        it selects the SOURCE process — the one owning the mesh position
-        ``root`` — whose values every other process receives (the reference
-        broadcasts from an arbitrary root the same way). Single-process the
-        one process is every rank, so any root is trivially honored. On a
+        ``root`` is a rank in this communicator's rank space — dense in
+        ``[0, size)``, same as :attr:`rank`; multi-process it selects the
+        SOURCE process — the owner of rank ``root``'s device — whose
+        values every other process receives (the reference broadcasts
+        from an arbitrary root the same way). Single-process the one
+        process is every rank, so any root is trivially honored. On a
         communicator spanning a SUBSET of the mesh axes, a rank names a
         device GROUP (one member per complementary mesh coordinate) that
         can straddle processes, so multi-process only ``root=0`` (whose
         group contains the mesh origin) is accepted — split a full-mesh
         communicator for arbitrary roots.
         """
-        flat = self._mesh.devices.reshape(-1)
-        spans_all = self._size == flat.size
-        # rank-space superset: comm ranks for a full-mesh communicator,
-        # global flat indices (the `rank` property's convention) otherwise
-        if not 0 <= root < flat.size:
+        spans_all = self._size == self._mesh.devices.size
+        if not 0 <= root < self._size:
             raise ValueError(
                 f"bcast_data root {root} out of range for a "
-                f"size-{self.size} communicator on a {flat.size}-device "
-                f"mesh")
+                f"size-{self.size} communicator (roots are communicator "
+                "ranks, dense in [0, size) — comm.rank space, not "
+                "comm.global_index)")
         if self.inter_size > 1:
             from jax.experimental import multihost_utils
 
@@ -743,7 +771,7 @@ class XlaCommunicator(CommunicatorBase):
                     "sub-axis rank is a device group that may straddle "
                     "processes, so a non-zero root has no single source "
                     "process; use root=0 or a full-mesh communicator")
-            root_proc = int(flat[root].process_index)
+            root_proc = int(self._comm_devices()[root].process_index)
             params = multihost_utils.broadcast_one_to_all(
                 params, is_source=jax.process_index() == root_proc)
         repl = NamedSharding(self._mesh, P())

@@ -86,10 +86,18 @@ def create_multi_node_iterator(actual_iterator, communicator: CommunicatorBase,
 
 
 class _MultiNodeIterator:
+    """Every process's view of the master's iterator: ``epoch``,
+    ``is_new_epoch`` and ``epoch_detail`` ride the broadcast payload, so
+    trigger logic (LogReport intervals, epoch-end hooks) agrees across
+    processes by construction."""
+
     def __init__(self, iterator, comm, rank_master):
         self._it = iterator
         self._comm = comm
         self._master = rank_master
+        self.epoch = getattr(iterator, "epoch", 0)
+        self.is_new_epoch = getattr(iterator, "is_new_epoch", False)
+        self.epoch_detail = getattr(iterator, "epoch_detail", 0.0)
 
     def __iter__(self):
         return self
@@ -98,17 +106,19 @@ class _MultiNodeIterator:
         if self._comm.inter_rank == self._master:
             try:
                 batch = self._it.next()
-                payload = (batch, self._it.epoch, self._it.is_new_epoch, False)
+                payload = (batch, self._it.epoch, self._it.is_new_epoch,
+                           getattr(self._it, "epoch_detail", None), False)
             except StopIteration:
-                payload = (None, None, None, True)
+                payload = (None, None, None, None, True)
             payload = self._comm.bcast_obj(payload, root=self._master)
         else:
             payload = self._comm.bcast_obj(None, root=self._master)
-        batch, epoch, is_new_epoch, stop = payload
+        batch, epoch, is_new_epoch, epoch_detail, stop = payload
         if stop:
             # keep the last valid epoch counters; callers may read them
             raise StopIteration
         self.epoch, self.is_new_epoch = epoch, is_new_epoch
+        self.epoch_detail = epoch_detail
         return batch
 
     next = __next__

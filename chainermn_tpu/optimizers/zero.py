@@ -24,6 +24,7 @@ ResNet-50 drop from 2x model size per chip to 2x/N.
 
 from __future__ import annotations
 
+import warnings
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -782,6 +783,38 @@ def fsdp_scan_apply(block_fn, stacked, h, *, remat: bool = True):
     return h
 
 
+def _find_stacked_subtree(params, n):
+    """Heuristic detector for scanned layer-stack pytrees
+    (:func:`fsdp_scan_apply` input): an internal node with >= 2 array
+    leaves that all share the same leading dim ``L >= 2`` (every leaf
+    ndim >= 2, at least one ndim >= 3) with ``L % n == 0`` — exactly the
+    shape class where :func:`fsdp_shardings`'s first-divisible-dim rule
+    would shard the LAYER dim. Returns the subtree's key path as a
+    string, or ``None``."""
+    from jax.tree_util import tree_flatten_with_path
+
+    groups = {}
+    for kp, leaf in tree_flatten_with_path(params)[0]:
+        shp = tuple(getattr(leaf, "shape", ()))
+        groups.setdefault(tuple(kp[:-1]), []).append(shp)
+    for parent, shapes in groups.items():
+        if len(shapes) < 2:
+            continue
+        if not all(len(s) >= 2 for s in shapes):
+            continue
+        if not any(len(s) >= 3 for s in shapes):
+            continue
+        heads = {s[0] for s in shapes}
+        if len(heads) != 1:
+            continue
+        L = heads.pop()
+        if L >= 2 and L % n == 0:
+            return "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k)))
+                for k in parent) or "<root>"
+    return None
+
+
 def make_fsdp_train_step(
     model,
     optimizer: optax.GradientTransformation,
@@ -821,7 +854,12 @@ def make_fsdp_train_step(
     ``param_shardings``: optional per-leaf ``NamedSharding`` pytree
     overriding :func:`fsdp_shardings` (e.g. a mixed tree where the
     scanned stack uses :func:`fsdp_stack_shardings`). Optimizer-state
-    leaves follow the matching param leaf's sharding by shape.
+    leaves follow the matching param leaf's sharding by shape. Without
+    it, a params tree that LOOKS like a scanned layer stack (>= 2
+    sibling leaves sharing a leading dim divisible by ``comm.size``)
+    raises a ``UserWarning``: the default rule would shard the layer
+    dim, which silently defeats :func:`fsdp_scan_apply`'s per-layer
+    liveness bound.
 
     Returns ``(step, state)`` with ``state = (params, opt_state)`` sharded;
     use :func:`fsdp_gather_params` to re-assemble for export. Models with
@@ -836,6 +874,20 @@ def make_fsdp_train_step(
     mesh = comm.mesh
     ax = comm.axis_name
 
+    if param_shardings is None:
+        stacked_at = _find_stacked_subtree(params, comm.size)
+        if stacked_at is not None:
+            warnings.warn(
+                f"make_fsdp_train_step: params[{stacked_at}] looks like a "
+                "scanned layer stack (>= 2 leaves sharing a leading dim "
+                f"divisible by comm.size={comm.size}); the default "
+                "fsdp_shardings rule will shard the LAYER dim, turning "
+                "each scan iteration's layer slice into a cross-device "
+                "gather of the slicing and defeating fsdp_scan_apply's "
+                "per-layer liveness bound. Pass "
+                "param_shardings=fsdp_stack_shardings(params, comm) (or a "
+                "mixed tree) to shard within layers instead.",
+                UserWarning, stacklevel=2)
     pshard = (param_shardings if param_shardings is not None
               else fsdp_shardings(params, comm))
     params = jax.device_put(params, pshard)

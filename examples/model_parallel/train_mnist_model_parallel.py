@@ -19,10 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import chainermn_tpu  # installs the jax.shard_map shim (_compat)
+
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
-
-import chainermn_tpu
 from chainermn_tpu.utils import ensure_platform
 
 ensure_platform()

@@ -102,6 +102,12 @@ def main():
     p.add_argument("--rope", action="store_true",
                    help="rotary position embeddings instead of a "
                         "learned table")
+    p.add_argument("--autotune-blocks", action="store_true",
+                   help="time the flash-attention (block_q, block_k) "
+                        "candidates for this exact shape "
+                        "(ops/autotune.py) and build the model with the "
+                        "winner; off-TPU the tuner returns the defaults "
+                        "untimed, so the flag is a no-op there")
     p.add_argument("--text-file", default=None,
                    help="train from a REAL text file: byte-BPE tokenize "
                         "(vocab from --bpe-vocab, cached next to the "
@@ -154,6 +160,19 @@ def main():
         pos_emb="rope" if args.rope else "learned",
         qkv_layout=args.qkv_layout,
     )
+    if args.autotune_blocks:
+        import jax.numpy as jnp
+
+        from chainermn_tpu.ops.autotune import tune_flash_blocks
+
+        bq, bk = tune_flash_blocks(
+            max(1, args.batchsize // comm.size), args.seq_len,
+            args.n_heads, args.d_model // args.n_heads,
+            kv_heads=args.n_kv_heads or None, dtype=jnp.float32,
+            window=args.window or None)
+        lm_kw["attention_blocks"] = (bq, bk)
+        if comm.is_master:
+            print(f"autotuned flash blocks: block_q={bq} block_k={bk}")
     sample = np.zeros((1, args.seq_len), np.int32)
     if args.fsdp_scan and args.moe > 0:
         # make_lm_fsdp_scan_loss would refuse MoE anyway, but the MoE

@@ -1,0 +1,402 @@
+"""dlint AST-rule fixtures: each rule trips on a seeded violation at the
+right file/line and stays quiet on a clean twin.
+
+These are pure-AST tests (no jax import, no devices) so they run in the
+tier-1 flow at zero cost; tests/analysis_tests/test_repo_clean.py keeps
+the repo itself lint-clean.
+"""
+
+import textwrap
+
+import pytest
+
+from chainermn_tpu.analysis import RULES, lint_source
+
+
+def _lint(src, rules=None):
+    return lint_source(textwrap.dedent(src), "fixture.py", rules=rules)
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def test_registry_has_every_documented_rule():
+    assert {"DL101", "DL102", "DL103", "DL104",
+            "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
+    for rule in RULES.values():
+        assert rule.doc.startswith("docs/static_analysis.md#")
+        assert rule.kind in ("ast", "hlo")
+
+
+# ---------------------------------------------------------------------------
+# DL101 — divergent collective
+# ---------------------------------------------------------------------------
+
+
+def test_dl101_flags_collective_under_rank_branch():
+    src = """\
+    def run(comm, x):
+        if comm.rank == 0:
+            x = comm.allreduce_grad(x, "mean")
+        return x
+    """
+    fs = _only(_lint(src), "DL101")
+    assert len(fs) == 1
+    assert fs[0].path == "fixture.py"
+    assert fs[0].line == 3
+    assert "allreduce_grad" in fs[0].message
+    assert "docs/static_analysis.md#dl101" in fs[0].message
+
+
+def test_dl101_clean_when_both_branches_call_it():
+    src = """\
+    def run(comm, x):
+        if comm.rank == 0:
+            out = comm.bcast_obj(x, root=0)
+        else:
+            out = comm.bcast_obj(None, root=0)
+        return out
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+def test_dl101_clean_when_hoisted_out_of_branch():
+    src = """\
+    def run(comm, x):
+        if comm.rank == 0:
+            print("master")
+        return comm.allreduce_grad(x, "mean")
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+def test_dl101_flags_psum_under_process_index_call():
+    src = """\
+    import jax
+    from jax import lax
+
+    def f(x):
+        if jax.process_index() == 0:
+            x = lax.psum(x, "i")
+        return x
+    """
+    fs = _only(_lint(src), "DL101")
+    assert [f.line for f in fs] == [6]
+
+
+def test_dl101_taint_through_local_assignment():
+    src = """\
+    def f(comm, x):
+        me = comm.rank
+        am_root = me == 0
+        if am_root:
+            comm.barrier()
+        return x
+    """
+    fs = _only(_lint(src), "DL101")
+    assert [f.line for f in fs] == [5]
+
+
+def test_dl101_p2p_matched_across_branches_is_clean():
+    src = """\
+    def f(comm, x):
+        if comm.rank == 0:
+            comm.send(x, dest=1, tag=7)
+        else:
+            x = comm.recv(src=0, tag=7)
+        return x
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+def test_dl101_p2p_with_silent_sibling_is_flagged():
+    src = """\
+    def f(comm, x):
+        if comm.rank == 0:
+            comm.send(x, dest=1, tag=7)
+        else:
+            x = x + 1
+        return x
+    """
+    fs = _only(_lint(src), "DL101")
+    assert [f.line for f in fs] == [3]
+    assert "send" in fs[0].message
+
+
+def test_dl101_terminating_guard_fallthrough_is_implicit_else():
+    # the scatter_dataset shape: root streams and RETURNS; the
+    # fallthrough (only reached by non-roots) receives — matched P2P
+    src = """\
+    def f(comm, x):
+        if comm.inter_rank == 0:
+            comm.send_obj(x, dest=1, tag=9)
+            return x
+        return comm.recv_obj(src=0, tag=9)
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+def test_dl101_non_rank_branch_is_clean():
+    # sizes are equal on every rank — branching on them cannot diverge
+    src = """\
+    def f(comm, x):
+        if comm.inter_size > 1:
+            x = comm.allreduce_grad(x, "sum")
+        return x
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+def test_dl101_suppression_comment():
+    src = """\
+    def f(comm, x):
+        if comm.rank == 0:
+            # this fixture documents an intentional divergence
+            comm.barrier()  # dlint: disable=DL101
+        return x
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+# ---------------------------------------------------------------------------
+# DL102 — channel-tag collision
+# ---------------------------------------------------------------------------
+
+
+def test_dl102_flags_same_channel_from_two_scopes():
+    src = """\
+    def iterator_traffic(comm, batch):
+        comm.send_obj(batch, dest=1, tag=3)
+
+    def user_traffic(comm, msg):
+        comm.send_obj(msg, dest=1, tag=3)
+    """
+    fs = _only(_lint(src), "DL102")
+    assert len(fs) == 1
+    assert fs[0].line == 5
+    assert "tag=3" in fs[0].message
+
+
+def test_dl102_clean_with_distinct_tags():
+    src = """\
+    def iterator_traffic(comm, batch):
+        comm.send_obj(batch, dest=1, tag=3)
+
+    def user_traffic(comm, msg):
+        comm.send_obj(msg, dest=1, tag=4)
+    """
+    assert _only(_lint(src), "DL102") == []
+
+
+def test_dl102_sequential_sends_in_one_scope_are_clean():
+    # one ordered channel, consumed in order — the scatter_dataset shape
+    src = """\
+    def stream(comm, parts):
+        for p in parts:
+            comm.send_obj(p, dest=1, tag=5)
+        comm.send_obj(None, dest=1, tag=5)
+    """
+    assert _only(_lint(src), "DL102") == []
+
+
+def test_dl102_reserved_eagergrad_namespace():
+    src = """\
+    def f(comm, x):
+        comm.send(x, dest=1, tag="eagergrad.7")
+    """
+    fs = _only(_lint(src), "DL102")
+    assert [f.line for f in fs] == [2]
+    assert "eagergrad" in fs[0].message
+
+
+def test_dl102_raw_send_colliding_with_eager_autograd_channel():
+    src = """\
+    from chainermn_tpu.functions import eager_send
+
+    def autograd_path(comm, x):
+        return eager_send(x, comm, 1, tag=11)
+
+    def raw_path(comm, x):
+        comm.send(x, dest=1, tag=11)
+    """
+    fs = _only(_lint(src), "DL102")
+    assert len(fs) == 1
+    assert fs[0].line == 7
+    assert "autograd" in fs[0].message
+
+
+def test_dl102_socket_recv_is_not_a_channel():
+    src = """\
+    def pump(sock):
+        data = sock.recv(4096)
+        gen = make_gen()
+        gen.send(None)
+        return data
+    """
+    assert _only(_lint(src), "DL102") == []
+
+
+# ---------------------------------------------------------------------------
+# DL103 — root rank-space
+# ---------------------------------------------------------------------------
+
+
+def test_dl103_flags_global_index_as_array_root():
+    src = """\
+    def f(comm, x):
+        return comm.bcast_data(x, root=comm.global_index)
+    """
+    fs = _only(_lint(src), "DL103")
+    assert [f.line for f in fs] == [2]
+    assert "global_index" in fs[0].message
+
+
+def test_dl103_flags_process_index_as_array_root():
+    src = """\
+    import jax
+
+    def f(comm, x):
+        return comm.gather(x, root=jax.process_index())
+    """
+    fs = _only(_lint(src), "DL103")
+    assert [f.line for f in fs] == [4]
+
+
+def test_dl103_flags_device_rank_as_object_root():
+    src = """\
+    def f(comm, obj):
+        return comm.bcast_obj(obj, root=comm.rank)
+    """
+    fs = _only(_lint(src), "DL103")
+    assert [f.line for f in fs] == [2]
+    assert "process-index" in fs[0].message
+
+
+def test_dl103_flags_negative_literal_root():
+    src = """\
+    def f(comm, x):
+        return comm.gather(x, root=-1)
+    """
+    fs = _only(_lint(src), "DL103")
+    assert [f.line for f in fs] == [2]
+
+
+def test_dl103_clean_roots():
+    src = """\
+    def f(comm, x, obj):
+        a = comm.bcast_data(x, root=0)
+        b = comm.gather(x, root=comm.size - 1)
+        c = comm.bcast_obj(obj, root=comm.inter_rank)
+        d = comm.scatter_obj(None, root=0)
+        return a, b, c, d
+    """
+    assert _only(_lint(src), "DL103") == []
+
+
+# ---------------------------------------------------------------------------
+# DL104 — unsynced step loop
+# ---------------------------------------------------------------------------
+
+
+def test_dl104_flags_unsynced_step_loop():
+    src = """\
+    def train(step, state, x, y):
+        for _ in range(100):
+            state, metrics = step(state, x, y)
+        return state
+    """
+    fs = _only(_lint(src), "DL104")
+    assert [f.line for f in fs] == [3]
+    assert "sync" in fs[0].message
+
+
+def test_dl104_clean_with_scalar_pull():
+    src = """\
+    def train(step, state, x, y):
+        for _ in range(100):
+            state, metrics = step(state, x, y)
+            loss = float(metrics["main/loss"])
+        return state
+    """
+    assert _only(_lint(src), "DL104") == []
+
+
+def test_dl104_clean_with_block_until_ready():
+    src = """\
+    import jax
+
+    def train(train_step, state, x, y):
+        while keep_going():
+            state, _ = train_step(state, x, y)
+            jax.block_until_ready(state)
+        return state
+    """
+    assert _only(_lint(src), "DL104") == []
+
+
+def test_dl104_step_factory_call_is_not_a_dispatch():
+    src = """\
+    def sweep(model, opt, comm, params):
+        out = {}
+        for bb in (None, 1024):
+            s, st = make_zero1_train_step(model, opt, comm, params,
+                                          bucket_bytes=bb)
+            out[bb] = s
+        return out
+    """
+    assert _only(_lint(src), "DL104") == []
+
+
+def test_dl104_suppression_with_rationale():
+    src = """\
+    def bench(step, state, x, y, n):
+        for _ in range(n):
+            # timed region: sync once at the end (device throughput)
+            state, m = step(state, x, y)  # dlint: disable=DL104
+        return float(m["loss"])
+    """
+    assert _only(_lint(src), "DL104") == []
+
+
+# ---------------------------------------------------------------------------
+# driver behavior
+# ---------------------------------------------------------------------------
+
+
+def test_syntax_error_becomes_dl000():
+    fs = _lint("def broken(:\n    pass\n")
+    assert [f.rule for f in fs] == ["DL000"]
+
+
+def test_rules_filter_restricts_passes():
+    src = """\
+    def f(comm, step, state, x):
+        if comm.rank == 0:
+            comm.barrier()
+        for _ in range(10):
+            state, _ = step(state, x, x)
+        return state
+    """
+    assert {f.rule for f in _lint(src)} == {"DL101", "DL104"}
+    assert {f.rule for f in _lint(src, rules=["DL104"])} == {"DL104"}
+
+
+def test_disable_all_suppresses_everything():
+    src = """\
+    def f(comm):
+        if comm.rank == 0:
+            comm.barrier()  # dlint: disable=all
+    """
+    assert _lint(src) == []
+
+
+def test_string_literal_cannot_suppress():
+    src = '''\
+    def f(comm):
+        doc = "# dlint: disable=DL101"
+        if comm.rank == 0:
+            comm.barrier()
+        return doc
+    '''
+    assert [f.rule for f in _lint(src)] == ["DL101"]

@@ -1,0 +1,290 @@
+"""dlint HLO-rule fixtures: canned scheduled-HLO text (the shapes XLA
+actually emits, reduced to the ops the passes read) so the rules are
+exercised deterministically on any machine — no TPU compiler plugin
+needed. tools/check_overlap_schedule.py runs the same passes on REAL
+compiled HLO where the plugin exists, and
+tests/comm_tests/test_overlap_schedule.py asserts those verdicts.
+"""
+
+import textwrap
+
+from chainermn_tpu.analysis import (
+    check_collective_budget,
+    check_dp_overlap,
+    check_fsdp_gather_liveness,
+    check_pipeline_permute_overlap,
+    parse_computations,
+    scheduled_entry_ops,
+)
+
+
+def _hlo(s):
+    return textwrap.dedent(s)
+
+
+# ---------------------------------------------------------------------------
+# DL201 — DP all-reduce/backward overlap
+# ---------------------------------------------------------------------------
+
+_DP_OVERLAPPED = _hlo("""\
+    HloModule train_step, is_scheduled=true
+
+    ENTRY %main.42 (p0: f32[128]) -> (f32[128]) {
+      %p0 = f32[128]{0} parameter(0)
+      %bwd1 = f32[128]{0} fusion(%p0), kind=kLoop, metadata={op_name="jit(step)/transpose(jvp(loss))/mul"}
+      %ar = f32[128]{0} all-reduce-start(%bwd1), replica_groups={{0,1}}, to_apply=%add
+      %bwd2 = f32[128]{0} fusion(%bwd1), kind=kLoop, metadata={op_name="jit(step)/transpose(jvp(loss))/dot"}
+      %ard = f32[128]{0} all-reduce-done(%ar)
+      ROOT %out = (f32[128]{0}) tuple(%ard)
+    }
+    """)
+
+_DP_SERIALIZED = _hlo("""\
+    HloModule train_step, is_scheduled=true
+
+    ENTRY %main.42 (p0: f32[128]) -> (f32[128]) {
+      %p0 = f32[128]{0} parameter(0)
+      %bwd1 = f32[128]{0} fusion(%p0), kind=kLoop, metadata={op_name="jit(step)/transpose(jvp(loss))/mul"}
+      %bwd2 = f32[128]{0} fusion(%bwd1), kind=kLoop, metadata={op_name="jit(step)/transpose(jvp(loss))/dot"}
+      %ar = f32[128]{0} all-reduce(%bwd2), replica_groups={{0,1}}, to_apply=%add
+      ROOT %out = (f32[128]{0}) tuple(%ar)
+    }
+    """)
+
+
+def test_scheduled_entry_ops_reads_schedule_order():
+    kinds = [k for k, _ in scheduled_entry_ops(_DP_OVERLAPPED)]
+    assert kinds == ["parameter", "fusion", "all-reduce-start", "fusion",
+                     "all-reduce-done", "tuple"]
+
+
+def test_scheduled_entry_ops_parses_typed_operand_lists():
+    # real compiled dumps print the FULL type of every operand
+    # ("all-reduce(f32[...]{...} %x, ...)"), with tile/memory
+    # annotations ("T(8,128)", "S(1)") inside result types — the opcode
+    # anchor must survive both (the first real-dump run found 0 ops)
+    text = _hlo("""\
+        HloModule m, is_scheduled=true
+
+        ENTRY %main.333_spmd (param: f32[1024]) -> f32[1024] {
+          %param = f32[1024]{0:T(1024)} parameter(0)
+          %all-reduce.24 = (f32[1024]{0:T(1024)S(1)}, f32[]{:T(128)}) all-reduce(f32[1024]{0:T(1024)S(1)} %param, f32[]{:T(128)S(6)} %param), channel_id=1, replica_groups={{0,1}}, to_apply=%region_10.110
+          ROOT %gte = f32[1024]{0:T(1024)} get-tuple-element((f32[1024]{0:T(1024)S(1)}, f32[]{:T(128)}) %all-reduce.24), index=0
+        }
+        """)
+    kinds = [k for k, _ in scheduled_entry_ops(text)]
+    assert kinds == ["parameter", "all-reduce", "get-tuple-element"]
+
+
+def test_dl201_ok_when_allreduce_issues_inside_backward_window():
+    out = check_dp_overlap(_DP_OVERLAPPED)
+    assert out["ok"] is True
+    assert out["is_scheduled"] is True
+    assert out["n_allreduce"] == 1
+    assert out["first_allreduce"] < out["last_backward"]
+    assert out["async_pairs"] is True
+
+
+def test_dl201_fails_when_collectives_serialize_after_backward():
+    out = check_dp_overlap(_DP_SERIALIZED)
+    assert out["ok"] is False
+    assert "fix" in out
+
+
+def test_dl201_unscheduled_module_is_not_ok():
+    out = check_dp_overlap(_DP_OVERLAPPED.replace(
+        ", is_scheduled=true", ""))
+    assert out["ok"] is False
+
+
+# ---------------------------------------------------------------------------
+# DL202 — collective budget
+# ---------------------------------------------------------------------------
+
+
+def test_dl202_within_budget():
+    out = check_collective_budget(_DP_OVERLAPPED, budget=1)
+    assert out["ok"] is True
+    assert out["n_collectives"] == 1
+    assert out["by_kind"] == {"all-reduce-start": 1}
+
+
+def test_dl202_over_budget():
+    out = check_collective_budget(_DP_SERIALIZED, budget=0)
+    assert out["ok"] is False
+    assert "fix" in out
+
+
+def test_dl202_named_computation_and_missing_computation():
+    body = _hlo("""\
+        HloModule m, is_scheduled=true
+
+        %wide.body (arg: f32[8]) -> f32[8] {
+          %arg = f32[8]{0} parameter(0)
+          %ar1 = f32[8]{0} all-reduce(%arg), to_apply=%add
+          %ag1 = f32[32]{0} all-gather(%ar1), dimensions={0}
+          ROOT %r = f32[8]{0} reduce-scatter(%ag1), dimensions={0}
+        }
+        """)
+    out = check_collective_budget(body, budget=2, computation="wide.body")
+    assert out["ok"] is False
+    assert out["n_collectives"] == 3
+    missing = check_collective_budget(body, budget=2, computation="nope")
+    assert missing["ok"] is None and "skip" in missing
+
+
+# ---------------------------------------------------------------------------
+# DL203 — 1F1B permute overlap
+# ---------------------------------------------------------------------------
+
+_PIPE_OVERLAPPED = _hlo("""\
+    HloModule pipe, is_scheduled=true
+
+    %while_body.7 (arg: f32[8]) -> f32[8] {
+      %arg = f32[8]{0} parameter(0)
+      %fwd_start = (f32[8]{0}, f32[8]{0}) collective-permute-start(%arg), source_target_pairs={{0,1},{1,2}}
+      %stage1 = f32[8]{0} fusion(%arg), kind=kOutput
+      %fwd_done = f32[8]{0} collective-permute-done(%fwd_start)
+      %bwd_start = (f32[8]{0}, f32[8]{0}) collective-permute-start(%stage1), source_target_pairs={{1,0},{2,1}}
+      %stage2 = f32[8]{0} dot(%stage1, %stage1)
+      %bwd_done = f32[8]{0} collective-permute-done(%bwd_start)
+      ROOT %out = f32[8]{0} add(%fwd_done, %bwd_done)
+    }
+    """)
+
+_PIPE_SERIALIZED = _hlo("""\
+    HloModule pipe, is_scheduled=true
+
+    %while_body.7 (arg: f32[8]) -> f32[8] {
+      %arg = f32[8]{0} parameter(0)
+      %fwd_start = (f32[8]{0}, f32[8]{0}) collective-permute-start(%arg), source_target_pairs={{0,1}}
+      %fwd_done = f32[8]{0} collective-permute-done(%fwd_start)
+      %stage1 = f32[8]{0} fusion(%fwd_done), kind=kOutput
+      %bwd_start = (f32[8]{0}, f32[8]{0}) collective-permute-start(%stage1), source_target_pairs={{1,0}}
+      %bwd_done = f32[8]{0} collective-permute-done(%bwd_start)
+      ROOT %out = f32[8]{0} add(%stage1, %bwd_done)
+    }
+    """)
+
+_PIPE_SYNC_FALLBACK = _hlo("""\
+    HloModule pipe, is_scheduled=true
+
+    %while_body.7 (arg: f32[8]) -> f32[8] {
+      %arg = f32[8]{0} parameter(0)
+      %hop = f32[8]{0} collective-permute(%arg), source_target_pairs={{0,1}}
+      %stage1 = f32[8]{0} fusion(%hop), kind=kOutput
+      ROOT %out = f32[8]{0} add(%stage1, %hop)
+    }
+    """)
+
+
+def test_parse_computations_sees_entry_and_bodies():
+    comps = parse_computations(_DP_OVERLAPPED)
+    assert "main.42" in comps
+    comps = parse_computations(_PIPE_OVERLAPPED)
+    ops = comps["while_body.7"]
+    assert [k for k, _, _ in ops][:3] == [
+        "parameter", "collective-permute-start", "fusion"]
+    # operand wiring: the done consumes its start's result
+    kinds = {res: (k, opr) for k, res, opr in ops}
+    assert "fwd_start" in kinds["fwd_done"][1]
+
+
+def test_dl203_ok_when_every_hop_hides_compute():
+    out = check_pipeline_permute_overlap(_PIPE_OVERLAPPED)
+    assert out["ok"] is True
+    assert out["n_permute_pairs"] == 2
+    assert out["min_compute_inside_any_pair"] >= 1
+    assert out["sync_permutes"] == 0
+    assert out["body"] == "while_body.7"
+
+
+def test_dl203_fails_on_individually_serialized_hop():
+    # async pairs exist, but no compute inside either window
+    out = check_pipeline_permute_overlap(_PIPE_SERIALIZED)
+    assert out["ok"] is False
+    assert out["min_compute_inside_any_pair"] == 0
+
+
+def test_dl203_fails_on_sync_permute_fallback():
+    out = check_pipeline_permute_overlap(_PIPE_SYNC_FALLBACK)
+    assert out["ok"] is False
+    assert out["sync_permutes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# DL204 — FSDP all-gather liveness
+# ---------------------------------------------------------------------------
+
+_FSDP_DEGENERATE = _hlo("""\
+    HloModule fsdp, is_scheduled=true
+
+    ENTRY %main.9 (p: f32[4]) -> f32[16] {
+      %p = f32[4]{0} parameter(0)
+      %ag1 = f32[16]{0} all-gather(%p), dimensions={0}
+      %ag2 = f32[16]{0} all-gather(%p), dimensions={0}
+      %ag3 = f32[16]{0} all-gather(%p), dimensions={0}
+      %ag4 = f32[16]{0} all-gather(%p), dimensions={0}
+      %l1 = f32[16]{0} fusion(%ag1), kind=kLoop
+      %l2 = f32[16]{0} fusion(%l1, %ag2), kind=kLoop
+      %l3 = f32[16]{0} fusion(%l2, %ag3), kind=kLoop
+      ROOT %l4 = f32[16]{0} fusion(%l3, %ag4), kind=kLoop
+    }
+    """)
+
+_FSDP_PINNED = _hlo("""\
+    HloModule fsdp, is_scheduled=true
+
+    ENTRY %main.9 (p: f32[4]) -> f32[16] {
+      %p = f32[4]{0} parameter(0)
+      %ag1 = f32[16]{0} all-gather(%p), dimensions={0}
+      %l1 = f32[16]{0} fusion(%ag1), kind=kLoop
+      %ag2 = f32[16]{0} all-gather(%p), dimensions={0}
+      %l2 = f32[16]{0} fusion(%l1, %ag2), kind=kLoop
+      %ag3 = f32[16]{0} all-gather(%p), dimensions={0}
+      %l3 = f32[16]{0} fusion(%l2, %ag3), kind=kLoop
+      %ag4 = f32[16]{0} all-gather(%p), dimensions={0}
+      ROOT %l4 = f32[16]{0} fusion(%l3, %ag4), kind=kLoop
+    }
+    """)
+
+
+def test_dl204_flags_degenerate_prefetch():
+    out = check_fsdp_gather_liveness(_FSDP_DEGENERATE, max_live=2)
+    assert out["ok"] is False
+    assert out["n_gathers"] == 4
+    assert out["peak_live_gathers"] == 4
+    assert "fsdp_scan_apply" in out["fix"]
+
+
+def test_dl204_pinned_prefetch_is_ok():
+    out = check_fsdp_gather_liveness(_FSDP_PINNED, max_live=2)
+    assert out["ok"] is True
+    assert out["peak_live_gathers"] <= 2
+
+
+def test_dl204_async_gather_interval_extends_to_done_use():
+    hlo = _hlo("""\
+        HloModule fsdp, is_scheduled=true
+
+        ENTRY %main.9 (p: f32[4]) -> f32[16] {
+          %p = f32[4]{0} parameter(0)
+          %ags1 = (f32[4]{0}, f32[16]{0}) all-gather-start(%p), dimensions={0}
+          %ags2 = (f32[4]{0}, f32[16]{0}) all-gather-start(%p), dimensions={0}
+          %agd1 = f32[16]{0} all-gather-done(%ags1)
+          %l1 = f32[16]{0} fusion(%agd1), kind=kLoop
+          %agd2 = f32[16]{0} all-gather-done(%ags2)
+          ROOT %l2 = f32[16]{0} fusion(%l1, %agd2), kind=kLoop
+        }
+        """)
+    out = check_fsdp_gather_liveness(hlo, max_live=1)
+    # both gathers in flight from op 1: peak 2 exceeds max_live=1
+    assert out["n_gathers"] == 2
+    assert out["peak_live_gathers"] == 2
+    assert out["ok"] is False
+    assert check_fsdp_gather_liveness(hlo, max_live=2)["ok"] is True
+
+
+def test_dl204_no_gathers_skips():
+    out = check_fsdp_gather_liveness(_DP_OVERLAPPED)
+    assert out["ok"] is None and "skip" in out

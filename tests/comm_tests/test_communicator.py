@@ -278,7 +278,8 @@ def test_bcast_data_root_validated(any_comm):
     with pytest.raises(ValueError, match="root"):
         comm.bcast_data(params, root=comm.size)
     with pytest.raises(ValueError, match="root"):
-        comm.bcast_data(params, root=-1)
+        # deliberate invalid root: the test asserts the raise
+        comm.bcast_data(params, root=-1)  # dlint: disable=DL103
 
 
 def test_intra_rank_process_is_node(any_comm):
@@ -317,6 +318,68 @@ def test_allreduce_grad_comm_dtype():
     # result keeps fp32 but went through bf16 comm; loose tolerance
     assert out.dtype == np.float32
     np.testing.assert_allclose(out[0], np.full((4,), g[:, 0].mean()), rtol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# sub-axis rank space (dense ranks vs. mesh-flat global_index)
+# ---------------------------------------------------------------------------
+
+
+def _two_axis_mesh(n_devices):
+    devs = np.asarray(jax.devices()[:n_devices]).reshape(2, n_devices // 2)
+    return devs, jax.sharding.Mesh(devs, ("a", "b"))
+
+
+def test_sub_axis_rank_dense_and_global_index(n_devices):
+    """comm.rank is dense in [0, size) on EVERY communicator — including
+    sub-axis ones, where the old mesh-flat convention could exceed size.
+    The mesh-flat position survives as comm.global_index."""
+    from chainermn_tpu.comm.xla import XlaCommunicator
+    full = chainermn_tpu.create_communicator("xla")
+    assert full.rank == full.global_index == 0
+    _, mesh = _two_axis_mesh(n_devices)
+    for ax, size in (("a", 2), ("b", n_devices // 2)):
+        sub = XlaCommunicator(mesh=mesh, axes=(ax,))
+        assert sub.size == size
+        assert sub.rank == 0 and 0 <= sub.rank < sub.size
+        assert sub.global_index == 0
+
+
+def test_sub_axis_device_groups(n_devices):
+    """Rank r of a sub-axis communicator names a device GROUP — one
+    member per complementary mesh coordinate — and _comm_devices() is
+    each group's representative, in dense rank order."""
+    from chainermn_tpu.comm.xla import XlaCommunicator
+    devs, mesh = _two_axis_mesh(n_devices)
+    sub_a = XlaCommunicator(mesh=mesh, axes=("a",))
+    groups = sub_a._comm_device_groups()
+    assert groups.shape == (2, n_devices // 2)
+    for r in range(2):
+        assert list(groups[r]) == list(devs[r])
+    assert list(sub_a._comm_devices()) == [devs[0][0], devs[1][0]]
+    sub_b = XlaCommunicator(mesh=mesh, axes=("b",))
+    gb = sub_b._comm_device_groups()
+    assert gb.shape == (n_devices // 2, 2)
+    for r in range(n_devices // 2):
+        assert list(gb[r]) == [devs[0][r], devs[1][r]]
+
+
+def test_sub_axis_bcast_data_root_matrix(n_devices):
+    """Every dense root in [0, size) is honored on a sub-axis
+    communicator (single-controller: one source of truth); the size
+    boundary is rejected in the DENSE space, so a mesh-flat
+    global_index-style root cannot slip through."""
+    from chainermn_tpu.comm.xla import XlaCommunicator
+    _, mesh = _two_axis_mesh(n_devices)
+    params = _toy_params()
+    for ax in ("a", "b"):
+        sub = XlaCommunicator(mesh=mesh, axes=(ax,))
+        for root in range(sub.size):
+            out = sub.bcast_data(params, root=root)
+            np.testing.assert_allclose(np.asarray(out["dense1"]["w"]),
+                                       params["dense1"]["w"])
+        with pytest.raises(ValueError, match="root"):
+            sub.bcast_data(params, root=sub.size)
 
 
 # ---------------------------------------------------------------------------

@@ -33,10 +33,11 @@ assert jax.process_count() == 2 and len(jax.devices()) == 8
 sys.path.insert(0, os.environ["REPO_ROOT"])
 import numpy as np
 import jax.numpy as jnp
+
+import chainermn_tpu  # installs the jax.shard_map shim (_compat)
+
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
-
-import chainermn_tpu
 
 comm = chainermn_tpu.create_communicator("xla")
 assert comm.size == 8, comm.size
@@ -62,6 +63,30 @@ assert float(p2["w"][0]) == 11.0, float(p2["w"][0])
 # and rank-0 election all assume that) and with intra_rank < intra_size
 assert comm.intra_rank == 0, comm.intra_rank
 assert comm.inter_rank == proc_id and comm.inter_size == 2
+
+# ---- sub-axis ranks are DENSE in [0, size); global_index keeps the old
+# mesh-flat convention (bookkeeping only — never a root) ------------------
+from chainermn_tpu.comm.xla import XlaCommunicator
+# full mesh: the two spaces coincide (4 = first device of process 1)
+assert comm.rank == 4 * proc_id == comm.global_index, (
+    comm.rank, comm.global_index)
+sub_ici = XlaCommunicator(mesh=comm.mesh, axes=(axes[-1],))
+assert sub_ici.size == 4, sub_ici.size
+# each ici-rank names a device GROUP with one member from EACH process,
+# so both processes live in group 0: rank 0 on both, strictly < size
+# (the old convention returned 4 on process 1 — out of range as a root)
+assert sub_ici.rank == 0, sub_ici.rank
+assert sub_ici.global_index == 4 * proc_id, sub_ici.global_index
+sub_dcn = XlaCommunicator(mesh=comm.mesh, axes=(axes[0],))
+assert sub_dcn.size == 2, sub_dcn.size
+assert sub_dcn.rank == proc_id, sub_dcn.rank
+assert sub_dcn.global_index == 4 * proc_id, sub_dcn.global_index
+# roots are validated in the DENSE space, at the size boundary
+try:
+    sub_dcn.bcast_data({"w": jnp.ones(1)}, root=2)
+    raise AssertionError("root=2 must be rejected on a size-2 communicator")
+except ValueError:
+    pass
 
 # ---- full DP training run: grads allreduced ACROSS PROCESSES ------------
 rng = np.random.RandomState(0)   # same on both procs: global dataset

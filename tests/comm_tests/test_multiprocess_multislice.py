@@ -33,9 +33,10 @@ sys.path.insert(0, os.environ["REPO_ROOT"])
 
 import numpy as np
 import jax.numpy as jnp
+import chainermn_tpu  # installs the jax.shard_map shim (_compat)
+
 from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
-import chainermn_tpu
 
 comm = chainermn_tpu.create_communicator(
     "hierarchical", allreduce_grad_dtype=jnp.bfloat16,

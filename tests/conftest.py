@@ -36,6 +36,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# jax version shims (top-level shard_map on older jaxlibs) — test modules
+# import `from jax import shard_map` before importing chainermn_tpu, so
+# apply the shim here, before collection.
+from chainermn_tpu import _compat  # noqa: E402,F401
+
 import pytest  # noqa: E402
 
 

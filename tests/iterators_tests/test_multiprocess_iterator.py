@@ -1,0 +1,154 @@
+"""Multi-process multi-node iterator: the master's stream IS the global
+stream.
+
+Two real ``jax.distributed`` processes: every process must see
+byte-identical batches and agreeing ``epoch`` / ``epoch_detail`` /
+``is_new_epoch`` counters for >= 2 epochs (trigger logic — LogReport
+intervals, epoch-end hooks — keys off these on every process). A second
+worker demonstrates the eager-P2P channel-tag collision hazard that
+dlint DL102 exists to catch, and pins the static rule to it.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mp_harness import assert_all_ok, run_workers
+
+_ITER_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import hashlib
+import numpy as np
+
+import chainermn_tpu
+from chainermn_tpu.iterators import SerialIterator, create_multi_node_iterator
+
+comm = chainermn_tpu.create_communicator("xla")
+assert comm.inter_size == 2
+
+data = [np.arange(4, dtype=np.float32) + i for i in range(10)]
+# the non-master gets a DECOY dataset and seed: if any batch content or
+# counter leaked from the local iterator instead of the master's
+# broadcast, the digests below would disagree
+local = data if proc_id == 0 else [x * -1.0 for x in data]
+base = SerialIterator(local, batch_size=4, shuffle=True,
+                      seed=7 if proc_id == 0 else 1234)
+it = create_multi_node_iterator(base, comm)
+assert it is not base
+
+records = []
+for _ in range(8):  # batch 4 over 10 items -> 8 batches spans 3+ epochs
+    batch = it.next()
+    digest = hashlib.sha256(np.asarray(batch).tobytes()).hexdigest()
+    records.append((digest, it.epoch, it.is_new_epoch, it.epoch_detail))
+
+from chainermn_tpu.comm.object_plane import ObjectPlane
+got = ObjectPlane().allgather_obj(records)
+assert got[0] == got[1], (got[0], got[1])
+assert records[-1][1] >= 2, records          # covered >= 2 full epochs
+assert any(r[2] for r in records), records   # epoch boundaries observed
+assert all(r[3] is not None for r in records)
+
+# finite stream: the master's StopIteration reaches EVERY process at the
+# same step (the stop sentinel rides the same broadcast)
+fin = create_multi_node_iterator(
+    SerialIterator(list(range(6)), 4, shuffle=False, repeat=False), comm)
+count = 0
+try:
+    while True:
+        fin.next()
+        count += 1
+except StopIteration:
+    pass
+assert count == 2, count
+
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+# Two helper functions register the SAME (tag, src, dest) eager-P2P
+# channel — exactly what dlint DL102 flags. At runtime the two sends ride
+# ONE ordered channel, so the receiver's recv call order — not the
+# sender's intent — decides which payload lands where: silent
+# cross-delivery, no error.
+_COLLISION_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np
+import chainermn_tpu
+
+comm = chainermn_tpu.create_communicator("xla")
+assert comm.size == 2
+
+
+def send_checkpoint(comm):
+    comm.send(np.float32(111.0), dest=1, tag=9)
+
+
+def send_metrics(comm):
+    comm.send(np.float32(222.0), dest=1, tag=9)
+
+
+if proc_id == 0:
+    send_checkpoint(comm)
+    send_metrics(comm)
+else:
+    # the metrics consumer runs first, but tag 9 is one ordered channel:
+    # it receives the CHECKPOINT payload — the deliberate collision
+    metrics = comm.recv(src=0, tag=9)
+    ckpt = comm.recv(src=0, tag=9)
+    assert float(metrics) == 111.0, float(metrics)  # wrong payload, no error
+    assert float(ckpt) == 222.0, float(ckpt)
+
+print(f"WORKER{proc_id} OK", flush=True)
+"""
+
+
+@pytest.mark.timeout(120)
+def test_two_process_multi_node_iterator(tmp_path):
+    procs, outs = run_workers(_ITER_WORKER, tmp_path, timeout=110)
+    assert_all_ok(procs, outs)
+
+
+@pytest.mark.timeout(120)
+def test_two_process_eager_p2p_tag_collision_cross_delivers(tmp_path):
+    procs, outs = run_workers(_COLLISION_WORKER, tmp_path, timeout=110)
+    assert_all_ok(procs, outs)
+
+
+def test_dlint_flags_the_collision_worker_statically():
+    """The runtime hazard above is exactly DL102's target: linting the
+    collision worker's source must report the two same-tag send sites."""
+    from chainermn_tpu.analysis import lint_source
+
+    findings = lint_source(_COLLISION_WORKER, "collision_worker.py")
+    dl102 = [f for f in findings if f.rule == "DL102"]
+    # the first registration is the channel's owner; every LATER scope
+    # re-registering it is flagged — here the send_metrics site
+    send_lines = [i + 1 for i, ln in
+                  enumerate(_COLLISION_WORKER.splitlines())
+                  if "tag=9" in ln and "comm.send" in ln]
+    assert len(send_lines) == 2
+    assert [f.line for f in dl102] == send_lines[1:], findings
+    assert f"line {send_lines[0]}" in dl102[0].message

@@ -52,8 +52,20 @@ def run_workers(worker_src: str, tmp_path, n: int = 2, timeout: int = 110,
     return procs, outs
 
 
+_NO_MP_CPU = "Multiprocess computations aren't implemented on the CPU backend"
+
+
 def assert_all_ok(procs, outs):
-    """Every worker exited 0 and printed its WORKER<i> OK marker."""
+    """Every worker exited 0 and printed its WORKER<i> OK marker.
+
+    Skips (rather than fails) when the installed jaxlib's CPU backend
+    cannot run cross-process computations at all — the collective paths
+    these tests exercise don't exist in that environment."""
+    if any(p.returncode != 0 for p in procs) and any(
+            _NO_MP_CPU in out for out in outs):
+        import pytest
+
+        pytest.skip("jaxlib CPU backend lacks cross-process computations")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
         assert f"WORKER{i} OK" in out

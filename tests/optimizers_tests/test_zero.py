@@ -485,6 +485,58 @@ def test_fsdp_stack_shardings_never_shard_stack_dim(comm):
     assert np.isfinite(float(m["main/loss"]))
 
 
+def test_fsdp_warns_on_stacked_tree_without_override(comm):
+    """A params tree that looks like a scanned layer stack (sibling
+    leaves sharing a leading dim divisible by comm.size) must raise a
+    UserWarning when no param_shardings override is given — the default
+    first-divisible-dim rule shards the LAYER dim, silently defeating
+    fsdp_scan_apply's per-layer liveness bound — and must stay silent
+    once the stack shardings are passed."""
+    import warnings
+
+    from chainermn_tpu.optimizers import fsdp_shardings, fsdp_stack_shardings
+
+    n = comm.size
+    L, width = 2 * n, 32
+    rs = np.random.RandomState(0)
+
+    def w(*shape):
+        return jnp.asarray((rs.standard_normal(shape) * 0.05)
+                           .astype(np.float32))
+
+    params = {"inp": w(784, width),
+              "blocks": {"w": w(L, width, width),
+                         "b": jnp.zeros((L, width), jnp.float32)},
+              "out": w(width, 10)}
+
+    def loss(model, p, x, y, train=True, **kw):
+        from chainermn_tpu.optimizers import fsdp_scan_apply
+
+        h = x.reshape((x.shape[0], -1)) @ p["inp"]
+        h = fsdp_scan_apply(
+            lambda pi, h: jax.nn.relu(h @ pi["w"] + pi["b"]), p["blocks"], h)
+        logits = h @ p["out"]
+        l = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return l, ((logits.argmax(-1) == y).mean(), None)
+
+    with pytest.warns(UserWarning, match="scanned layer stack"):
+        make_fsdp_train_step(None, optax.adam(1e-3), comm, params,
+                             loss_fn=loss, donate=False)
+
+    shardings = dict(fsdp_shardings(params, comm),
+                     blocks=fsdp_stack_shardings(params, comm)["blocks"])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        step, state = make_fsdp_train_step(None, optax.adam(1e-3), comm,
+                                           params, loss_fn=loss,
+                                           donate=False,
+                                           param_shardings=shardings)
+    assert not [c for c in caught if "layer stack" in str(c.message)], caught
+    x, y = _data(comm, batch_per=1)
+    state, m = step(state, x, y)
+    assert np.isfinite(float(m["main/loss"]))
+
+
 import os as _os
 import sys as _sys
 

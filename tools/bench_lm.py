@@ -8,6 +8,11 @@ compute, data-parallel step factory. Prints one JSON line per config.
 
 Usage: python tools/bench_lm.py [d_model n_layers seq_len batch
                                  [loss [d_head [qkv_layout]]]]
+                                [--autotune-blocks]
+  --autotune-blocks: time the flash-attention (block_q, block_k)
+  candidates for this shape (ops/autotune.py) and build the model with
+  the winner; off-TPU the tuner returns the defaults untimed (recorded
+  as an honest null in BASELINE.md)
   loss: 'unfused' (default) or 'fused' — the fused head+CE Pallas kernel
   (ops/fused_ce.py; measured throughput-neutral, −2 GB logits memory)
   d_head: head dim (default 64; 128 halves the QK^T MXU inefficiency the
@@ -29,7 +34,7 @@ import numpy as np
 
 def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
             loss_kind="unfused", d_head=64, scan_k=4, n_iters=6,
-            qkv_layout="blhd"):
+            qkv_layout="blhd", autotune_blocks=False):
     """Measure LM training throughput; returns (tokens_per_sec_per_chip,
     config dict). Importable — bench.py reuses this as its LM gate."""
     import jax
@@ -48,11 +53,17 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
         raise ValueError(f"d_head {d_head} must divide d_model {d_model}")
 
     comm = chainermn_tpu.create_communicator("xla")
+    blocks = None
+    if autotune_blocks:
+        from chainermn_tpu.ops.autotune import tune_flash_blocks
+
+        blocks = tune_flash_blocks(batch, seq_len, d_model // d_head,
+                                   d_head, dtype=jnp.bfloat16)
     model = TransformerLM(
         vocab=32768, d_model=d_model, n_heads=d_model // d_head,
         n_layers=n_layers, d_ff=4 * d_model, max_len=seq_len,
         pos_emb="rope", attention="flash", dtype=jnp.bfloat16,
-        qkv_layout=qkv_layout)
+        qkv_layout=qkv_layout, attention_blocks=blocks)
 
     toks = np.random.RandomState(0).randint(
         0, 32768, size=(batch * comm.size, seq_len + 1)).astype(np.int32)
@@ -89,7 +100,10 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
         float(m["main/loss"][-1])
     t0 = time.perf_counter()
     for _ in range(n_iters):
-        state, m = step(state, xs, ys)
+        # timed region syncs ONCE at the end on purpose: the figure is
+        # device throughput, and a per-iteration sync would add the full
+        # tunnel round-trip to every dispatch (see profile_lm.py, r5)
+        state, m = step(state, xs, ys)  # dlint: disable=DL104
     final = float(m["main/loss"][-1])
     dt = time.perf_counter() - t0
     assert final == final, "loss is NaN"
@@ -100,22 +114,28 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
               "seq_len": seq_len, "batch_per_chip": batch,
               "d_head": d_head,
               "params_m": round(n_params / 1e6, 1),
-              "loss": loss_kind, "qkv_layout": qkv_layout}
+              "loss": loss_kind, "qkv_layout": qkv_layout,
+              "attention_blocks": blocks}
     return tokens_per_sec / comm.size, config
 
 
 def main():
-    d_model = int(sys.argv[1]) if len(sys.argv) > 1 else 768
-    n_layers = int(sys.argv[2]) if len(sys.argv) > 2 else 12
-    seq_len = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
-    batch = int(sys.argv[4]) if len(sys.argv) > 4 else 8
-    loss_kind = sys.argv[5] if len(sys.argv) > 5 else "unfused"
-    d_head = int(sys.argv[6]) if len(sys.argv) > 6 else 64
-    qkv_layout = sys.argv[7] if len(sys.argv) > 7 else "blhd"
+    argv = sys.argv[1:]
+    autotune = "--autotune-blocks" in argv
+    if autotune:
+        argv.remove("--autotune-blocks")
+    d_model = int(argv[0]) if len(argv) > 0 else 768
+    n_layers = int(argv[1]) if len(argv) > 1 else 12
+    seq_len = int(argv[2]) if len(argv) > 2 else 2048
+    batch = int(argv[3]) if len(argv) > 3 else 8
+    loss_kind = argv[4] if len(argv) > 4 else "unfused"
+    d_head = int(argv[5]) if len(argv) > 5 else 64
+    qkv_layout = argv[6] if len(argv) > 6 else "blhd"
     try:
         per_chip, config = measure(d_model, n_layers, seq_len, batch,
                                    loss_kind, d_head,
-                                   qkv_layout=qkv_layout)
+                                   qkv_layout=qkv_layout,
+                                   autotune_blocks=autotune)
     except ValueError as e:
         raise SystemExit(str(e))
     print(json.dumps({

@@ -72,7 +72,9 @@ def run_variant(batch, n_scan, s2d, n_iters=10):
             float(m["main/loss"])
         t0 = time.perf_counter()
         for _ in range(n_iters):
-            state, m = step(state, x, y)
+            # timed region: sync once at the end — device-throughput
+            # methodology, same as bench_lm.py
+            state, m = step(state, x, y)  # dlint: disable=DL104
         float(m["main/loss"])
         dt = time.perf_counter() - t0
         total = n_iters * global_batch

@@ -5,11 +5,11 @@ the gradient all-reduces with backward compute.
 
 This turns docs/scaling_model.md §2's central assumption — "the gradient
 all-reduce hides inside the backward window via XLA's latency-hiding
-scheduler" — into compiler-emitted evidence: in the scheduled entry
-computation, the FIRST gradient all-reduce must be placed before the
-LAST backward op (ops carry ``transpose(jvp`` metadata), i.e. XLA issues
-gradient collectives while backward compute remains, rather than
-serializing them after it. Prints one JSON line::
+scheduler" — into compiler-emitted evidence. The analysis itself lives
+in :mod:`chainermn_tpu.analysis.hlo_passes` (rules DL201/DL203 — see
+docs/static_analysis.md); this tool is the thin wrapper that builds the
+representative programs, compiles them against the described topology,
+and runs the passes. Prints one JSON line::
 
     {"ok": true, "first_allreduce": 46, "last_backward": 90,
      "n_sched_ops": 97, "n_allreduce": 2, ...}
@@ -27,39 +27,30 @@ The test suite asserts ok=true via tests/comm_tests/test_overlap_schedule.py.
 
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
+from chainermn_tpu.analysis.hlo_passes import (  # noqa: E402
+    check_dp_overlap,
+    check_pipeline_permute_overlap,
+    scheduled_entry_ops,  # noqa: F401  (re-export: judge scripts import it)
+)
 
-def scheduled_entry_ops(hlo_text):
-    """(op_kind, metadata) per instruction of the ENTRY computation, in
-    schedule order (the module is scheduled: is_scheduled=true)."""
-    ops = []
-    in_entry = False
-    for ln in hlo_text.splitlines():
-        if ln.startswith("ENTRY"):
-            in_entry = True
-            continue
-        if in_entry:
-            if ln.startswith("}"):
-                break
-            s = ln.strip()
-            if not re.match(r"%?[\w.-]+ = ", s):
-                continue
-            # the opcode is the token right before the operand list;
-            # match it AFTER the (possibly tuple, space-containing)
-            # result type by anchoring on "opcode(%" — every entry op
-            # of interest takes at least one %operand
-            m = re.search(r" ([a-z][\w-]*)\(%", s)
-            if m:
-                ops.append((m.group(1), s))
-    return ops
+
+def analyze(compiled):
+    """DL201 on a compiled computation (kept for standalone callers)."""
+    return check_dp_overlap(compiled.as_text())
 
 
 def main():
+    # AOT-only tool: the topology is described, never attached, so the
+    # TPU plugin's GCP-metadata discovery is pure startup cost (~6 min
+    # of retrying a 403ing metadata server off-TPU). Opt out unless the
+    # caller explicitly set the knob.
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
     import numpy as np
 
     import jax
@@ -113,26 +104,6 @@ def main():
         lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=rep),
         state)
 
-    def analyze(compiled):
-        txt = compiled.as_text()
-        ops = scheduled_entry_ops(txt)
-        ar = [i for i, (k, _) in enumerate(ops)
-              if k in ("all-reduce", "all-reduce-start")]
-        bwd = [i for i, (_, s) in enumerate(ops) if "transpose(jvp" in s]
-        out = {
-            "is_scheduled": "is_scheduled=true" in txt,
-            "n_sched_ops": len(ops),
-            "n_allreduce": len(ar),
-            "first_allreduce": min(ar) if ar else None,
-            "last_backward": max(bwd) if bwd else None,
-            "backward_ops_after_first_allreduce": (
-                sum(1 for i in bwd if i > min(ar)) if ar else 0),
-            "async_pairs": bool(re.search(r"all-reduce-start", txt)),
-        }
-        out["ok"] = bool(
-            out["is_scheduled"] and ar and bwd and min(ar) < max(bwd))
-        return out
-
     opts = {
         "xla_tpu_enable_latency_hiding_scheduler": "true",
         "xla_enable_async_all_reduce": "true",
@@ -178,36 +149,15 @@ def main():
     # the ppermutes to async collective-permute-start/done pairs and
     # schedules real fusions between start and done, so the per-tick
     # wire cost (docs/scaling_model.md §6) is hidden behind compute
-    # rather than added to it. Analyze the while-BODY computation (the
-    # entry schedule only shows the while op itself).
-    out["pipeline_1f1b"] = _analyze_pipeline_1f1b(mesh)
+    # rather than added to it. The pass scans every computation and
+    # scores the while-BODY (the entry schedule only shows the while op).
+    out["pipeline_1f1b"] = check_pipeline_permute_overlap(
+        _compile_pipeline_1f1b(mesh).as_text())
     out["ok"] = bool(out["ok"] and out["pipeline_1f1b"]["ok"])
     print(json.dumps(out))
 
 
-def _split_computations(hlo_text):
-    """name -> [(op_kind, result_name, [operand_names])] per HLO
-    computation, in schedule order."""
-    comps, cur = {}, None
-    for ln in hlo_text.splitlines():
-        m = re.match(r"^%?([\w.-]+) \(.*\{\s*$", ln)
-        if m:
-            cur = m.group(1)
-            comps[cur] = []
-            continue
-        if cur is not None:
-            if ln.startswith("}"):
-                cur = None
-                continue
-            s = ln.strip()
-            mm = re.match(r"%?([\w.-]+) = .*? ([a-z][\w-]*)\((.*)", s)
-            if mm:
-                operands = re.findall(r"%([\w.-]+)", mm.group(3))
-                comps[cur].append((mm.group(2), mm.group(1), operands))
-    return comps
-
-
-def _analyze_pipeline_1f1b(mesh):
+def _compile_pipeline_1f1b(mesh):
     import numpy as np
 
     import jax
@@ -246,57 +196,11 @@ def _analyze_pipeline_1f1b(mesh):
             np.shape(l), jnp.asarray(l).dtype,
             sharding=NamedSharding(smesh, spec))
 
-    compiled = jax.jit(sm).lower(
+    return jax.jit(sm).lower(
         jax.tree_util.tree_map(lambda l: absify(l, P("stage")),
                                stack_stage_params(plist)),
         absify(xs, P()), absify(tgt, P())).compile(
             {"xla_tpu_enable_latency_hiding_scheduler": "true"})
-    txt = compiled.as_text()
-
-    best = None
-    for name, ops in _split_computations(txt).items():
-        starts = [(i, res) for i, (k, res, _) in enumerate(ops)
-                  if k == "collective-permute-start"]
-        if not starts:
-            continue
-        fusions = [i for i, (k, _, _) in enumerate(ops)
-                   if k in ("fusion", "dot", "custom-call")]
-        # match each start to ITS done (the done consuming its result):
-        # compute counted inside an unrelated pair's gap must not
-        # certify an individually-serialized hop
-        pairs = []
-        for si, res in starts:
-            done = next((i for i, (k, _, opr) in enumerate(ops)
-                         if i > si and k == "collective-permute-done"
-                         and res in opr), None)
-            if done is not None:
-                pairs.append(
-                    (si, done,
-                     sum(1 for f in fusions if si < f < done)))
-        if not pairs:
-            continue
-        cand = {
-            "body": name,
-            "n_body_ops": len(ops),
-            "n_permute_pairs": len(pairs),
-            "pairs": [{"start": s, "done": d, "compute_inside": c}
-                      for s, d, c in pairs],
-            "min_compute_inside_any_pair": min(c for _, _, c in pairs),
-            "n_compute": len(fusions),
-        }
-        if best is None or cand["n_permute_pairs"] > best["n_permute_pairs"]:
-            best = cand
-
-    out = best or {"n_permute_pairs": 0}
-    out["sync_permutes"] = len(
-        re.findall(r"= *\S* *collective-permute\(", txt))
-    # ok = both rings async, EVERY hop hides >=1 real compute op inside
-    # its own start->done window, and nothing fell back to a synchronous
-    # collective-permute
-    out["ok"] = bool(best and best["n_permute_pairs"] >= 2
-                     and best["min_compute_inside_any_pair"] >= 1
-                     and out["sync_permutes"] == 0)
-    return out
 
 
 if __name__ == "__main__":

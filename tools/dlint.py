@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""dlint — distributed-correctness lint for the whole stack.
+
+Runs the :mod:`chainermn_tpu.analysis` AST passes (DL1xx) over Python
+sources and prints one ``path:line: RULE message`` finding per line.
+Exit status: 0 clean, 1 findings, 2 usage error.
+
+Usage::
+
+    python tools/dlint.py --all                 # lint the whole repo
+    python tools/dlint.py chainermn_tpu/comm    # lint specific paths
+    python tools/dlint.py --rules DL101,DL103 tests/
+    python tools/dlint.py --list-rules          # catalogue + docs anchors
+
+The compiled-HLO passes (DL2xx) take HLO text, not source files — run
+them via :mod:`chainermn_tpu.analysis.hlo_passes` on a compiled
+computation (see ``tools/check_overlap_schedule.py``) or point
+``--hlo FILE`` at a saved ``compiled.as_text()`` dump to run the
+argument-free ones (DL201, DL203).
+
+Suppress an intentional finding with ``# dlint: disable=RULE`` (plus a
+rationale) on the flagged line or the line above. The suite keeps the
+repo clean via tests/analysis_tests/test_repo_clean.py.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+#: what --all means: every Python tree that ships or exercises
+#: distributed behavior
+REPO_ROOTS = ("chainermn_tpu", "examples", "tests", "tools")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="dlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--all", action="store_true",
+                    help="lint the standard repo roots: "
+                         + ", ".join(REPO_ROOTS))
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule IDs to run (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("--hlo", metavar="FILE", default=None,
+                    help="also run argument-free HLO passes on a saved "
+                         "compiled.as_text() dump")
+    args = ap.parse_args(argv)
+
+    from chainermn_tpu.analysis import RULES, lint_paths
+    from chainermn_tpu.analysis import hlo_passes
+
+    if args.list_rules:
+        for rule in sorted(RULES.values(), key=lambda r: r.rule_id):
+            print(f"{rule.rule_id}  [{rule.kind}]  {rule.name}  "
+                  f"({rule.doc})")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"dlint: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.all:
+        paths = [os.path.join(repo, r) for r in REPO_ROOTS
+                 if os.path.isdir(os.path.join(repo, r))]
+    else:
+        paths = args.paths
+    if not paths and not args.hlo:
+        ap.print_usage(sys.stderr)
+        print("dlint: give paths, --all, or --hlo FILE", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths, rules=rules) if paths else []
+    for f in findings:
+        print(f.format())
+
+    hlo_bad = 0
+    if args.hlo:
+        with open(args.hlo, encoding="utf-8") as fh:
+            txt = fh.read()
+        for check in (hlo_passes.check_dp_overlap,
+                      hlo_passes.check_pipeline_permute_overlap):
+            out = check(txt)
+            if rules is not None and out["rule"] not in rules:
+                continue
+            print(json.dumps(out))
+            if out["ok"] is False:
+                hlo_bad += 1
+
+    n = len(findings) + hlo_bad
+    if n:
+        print(f"dlint: {n} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

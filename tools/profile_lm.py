@@ -150,7 +150,7 @@ def main():
     n_iters = 6
     t0 = time.perf_counter()
     for _ in range(n_iters):
-        state, m = step(state, xs, ys)
+        state, m = step(state, xs, ys)  # dlint: disable=DL104 — see above
     float(m["main/loss"][-1])
     dt = time.perf_counter() - t0
     step_s = dt / (n_iters * SCAN_K)
