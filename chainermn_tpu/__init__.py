@@ -35,6 +35,7 @@ from chainermn_tpu.iterators import (
 )
 from chainermn_tpu.links import MultiNodeBatchNormalization, MultiNodeChainList
 from chainermn_tpu.optimizers import create_multi_node_optimizer
+from chainermn_tpu import resilience
 
 __version__ = "0.1.0"
 
@@ -54,5 +55,6 @@ __all__ = [
     "links",
     "MultiNodeBatchNormalization",
     "MultiNodeChainList",
+    "resilience",
     "__version__",
 ]

@@ -18,6 +18,11 @@ shapes this repo has actually hit or audits it has actually run:
 * DL104 — a loop dispatching compiled steps without a per-iteration sync
   piles up async executions until the collective rendezvous aborts
   (tests/conftest.py's 1-core rule; the productized round-5 audit).
+* DL105 — the object plane converts a detected peer death into
+  ``JobAbortedError`` (comm/object_plane.py's poison key + fail-fast
+  probes). A ``try`` that swallows it around ``send_obj``/``recv_obj``/
+  ``bcast_obj`` turns the bounded abort back into the infinite hang the
+  resilience layer exists to prevent (docs/fault_tolerance.md).
 
 Known limits, by design (documented in docs/static_analysis.md): the
 passes are intra-file and intra-function — no cross-module call graph,
@@ -493,6 +498,8 @@ def _is_step_call(call: ast.Call) -> bool:
         return False
     if name.startswith(_FACTORY_PREFIXES):
         return False
+    if name.startswith("on_"):
+        return False  # event hooks (chaos.on_step) dispatch no compute
     return (name in ("step", "step_fn", "train_step")
             or name.endswith("_step"))
 
@@ -531,3 +538,97 @@ def check_unsynced_step_loop(tree, src, path) -> List[Finding]:
 
 register(Rule("DL104", "unsynced-step-loop", f"{_DOC}#dl104",
               check_unsynced_step_loop))
+
+
+# ---------------------------------------------------------------------------
+# DL105 — unguarded object-plane call (handler swallows JobAbortedError)
+# ---------------------------------------------------------------------------
+
+#: object-plane entry points whose guarded waits raise JobAbortedError on
+#: peer death / coordinator loss
+OBJ_PLANE_CALLS = {
+    "send_obj", "recv_obj", "bcast_obj", "gather_obj", "allgather_obj",
+    "allreduce_obj", "scatter_obj",
+}
+
+#: exception names that catch JobAbortedError: itself, or any ancestor on
+#: its MRO (JobAbortedError -> RuntimeError -> Exception -> BaseException)
+_ABORT_CATCHERS = {
+    "JobAbortedError", "RuntimeError", "Exception", "BaseException",
+}
+
+
+def _handler_catches_abort(handler: ast.ExceptHandler) -> bool:
+    """Does this handler's type clause catch JobAbortedError? A bare
+    ``except:`` does; so does any name on its MRO or a tuple containing
+    one."""
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name in _ABORT_CATCHERS:
+            return True
+    return False
+
+
+def _walk_statements(stmts: List[ast.stmt]):
+    """Like :func:`_walk_excluding_defs`, but also skips defs appearing
+    DIRECTLY in ``stmts`` (their bodies run at some other time)."""
+    live = [s for s in stmts
+            if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda))]
+    return _walk_excluding_defs(live)
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """A handler swallows the abort when no path through its body leaves
+    by raising — a ``raise`` anywhere in the body (re-raise or wrap)
+    counts as propagating. Over-approximation: a conditional raise is
+    treated as propagating."""
+    for n in _walk_statements(handler.body):
+        if isinstance(n, ast.Raise):
+            return False
+    return True
+
+
+def check_unguarded_object_plane(tree, src, path) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        swallowing = [
+            h for h in node.handlers
+            if _handler_catches_abort(h) and _handler_swallows(h)
+        ]
+        if not swallowing:
+            continue
+        for n in _walk_statements(node.body):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _callee_name(n)
+            if name not in OBJ_PLANE_CALLS:
+                continue
+            h = swallowing[0]
+            catches = ("bare 'except:'" if h.type is None else
+                       f"'except {ast.unparse(h.type)}' at line "
+                       f"{h.lineno}")
+            findings.append(Finding(
+                "DL105", path, n.lineno,
+                f"object-plane call '{name}' sits in a try whose "
+                f"{catches} swallows JobAbortedError — the abort a "
+                "watchdog or poison key raises when a peer dies. "
+                "Swallowing it turns detected peer death back into a "
+                "silent hang (the surviving ranks keep waiting at the "
+                "next collective). Re-raise JobAbortedError, or narrow "
+                f"the except clause ({_DOC}#dl105)."))
+    return findings
+
+
+register(Rule("DL105", "unguarded-object-plane-call", f"{_DOC}#dl105",
+              check_unguarded_object_plane))

@@ -181,6 +181,16 @@ class CommunicatorBase(abc.ABC):
     def recv_obj(self, src: int, tag: int = 0) -> Any:
         ...
 
+    def host_barrier(self) -> None:
+        """Process-plane barrier over the HOST transport (coordinator KV
+        store where available): bounded waits that the object plane's
+        fail-fast probes and the resilience watchdog can interrupt — a
+        dead peer raises instead of hanging forever. Default falls back
+        to :meth:`barrier` for communicators without a host transport."""
+        barrier = getattr(self, "barrier", None)
+        if callable(barrier):
+            barrier()
+
     # ------------------------------------------------------------------
     # model-level ops (the reference's headline API)
     # ------------------------------------------------------------------

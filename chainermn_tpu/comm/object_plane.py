@@ -20,28 +20,36 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 from typing import Any, List, Optional
 
 import numpy as np
 
 import jax
 
+from chainermn_tpu.resilience import chaos as _chaos
+from chainermn_tpu.resilience.policy import policy as _rpc_policy
+
 # KV-store chunk bound: coordinator values are strings; keep chunks modest.
 _KV_CHUNK = 4 * 1024 * 1024
 
-# Fail-fast granularity: long waits are sliced into probes of this length so
-# a dead coordinator is detected in O(seconds), not after the full budget
-# (the reference gets this from MPI_Abort killing the world; here a crashed
-# coordinator host would otherwise leave peers retrying gRPC for minutes).
-_PROBE_MS = 10_000
+# Every deadline below derives from ONE policy (resilience/policy.py):
+# the total per-operation budget (CHAINERMN_TPU_RPC_TIMEOUT_MS, default
+# 600 s — the historical scattered constant), the fail-fast probe slice
+# (CHAINERMN_TPU_RPC_PROBE_MS, default 10 s) that bounds how long a dead
+# coordinator goes unnoticed, and the jittered-exponential retry ladder.
 
 # seeded by every ObjectPlane at construction; read by the liveness probes
 _ALIVE_KEY = "og/liveness/seed"
 
 # set by post_abort (the global except hook's MPI_Abort analog); checked by
 # every liveness probe so peers of a crashed rank raise within one probe
-# interval instead of waiting out their collective budgets
+# interval instead of waiting out their collective budgets. The flag is a
+# CHILD key under the directory on purpose: key_value_dir_get (present on
+# every jaxlib generation) only lists children, so probes on clients
+# without key_value_try_get can still read it without blocking.
 _ABORT_KEY = "og/abort"
+_ABORT_FLAG = _ABORT_KEY + "/flag"
 
 
 class JobAbortedError(RuntimeError):
@@ -62,9 +70,30 @@ def post_abort(reason: str) -> None:
         return
     try:
         _guard_rpc(lambda: client.key_value_set(
-            _ABORT_KEY, reason[:512]), budget_ms=5_000)
+            _ABORT_FLAG, reason[:512]), budget_ms=5_000)
     except Exception:
         pass
+
+
+def _read_abort(client) -> Optional[str]:
+    """The posted abort reason, or None — without ever blocking.
+
+    Newer clients expose ``key_value_try_get``; older ones only have
+    ``key_value_dir_get``, which returns instantly and lists the abort
+    flag because it is a child of the abort directory. A blocking get is
+    NOT an option here: this runs on every probe slice of every guarded
+    wait, and a missing key would stall it for the full get deadline."""
+    if hasattr(client, "key_value_try_get"):
+        try:
+            return client.key_value_try_get(_ABORT_FLAG)
+        except Exception:  # NotFound: nobody aborted
+            return None
+    try:
+        for _key, reason in client.key_value_dir_get(_ABORT_KEY):
+            return reason
+    except Exception:
+        pass
+    return None
 
 
 def _client():
@@ -152,7 +181,7 @@ class ObjectPlane:
         seq = self._next_seq("allgather")
         key = f"og/ag/{seq}"
         self._kv_put(f"{key}/{self.process_index}", pickle.dumps(obj))
-        self._barrier(f"{key}/barrier", 60_000)
+        self._barrier(f"{key}/barrier", _rpc_policy().barrier_ms())
         return [
             pickle.loads(self._kv_get(f"{key}/{i}"))
             for i in range(self.process_count)
@@ -165,7 +194,7 @@ class ObjectPlane:
         seq = self._next_seq("gather")
         key = f"og/g/{seq}"
         self._kv_put(f"{key}/{self.process_index}", pickle.dumps(obj))
-        self._barrier(f"{key}/barrier", 600_000)
+        self._barrier(f"{key}/barrier", _rpc_policy().timeout_ms)
         if self.process_index != root:
             return None
         return [
@@ -184,7 +213,7 @@ class ObjectPlane:
             for i, o in enumerate(objs):
                 if i != root:
                     self._kv_put(f"{key}/{i}", pickle.dumps(o))
-        self._barrier(f"{key}/barrier", 600_000)
+        self._barrier(f"{key}/barrier", _rpc_policy().timeout_ms)
         if self.process_index == root:
             return objs[self.process_index]
         return pickle.loads(self._kv_get(f"{key}/{self.process_index}"))
@@ -204,9 +233,26 @@ class ObjectPlane:
             raise RuntimeError("recv_obj with a single process has no peer")
         seq = self._next_seq(f"p2p/{src}/{self.process_index}/{tag}")
         data = self._kv_get(
-            f"og/p2p/{src}/{self.process_index}/{tag}/{seq}", timeout_ms=600_000
+            f"og/p2p/{src}/{self.process_index}/{tag}/{seq}"
         )
         return pickle.loads(data)
+
+    # -- host barrier ----------------------------------------------------
+
+    def barrier(self, timeout_ms: Optional[int] = None) -> None:
+        """Coordinator-backed host barrier across processes.
+
+        Unlike a device-collective barrier (``sync_global_devices``) this
+        rides the KV store: it needs no cross-process device computation
+        support and every wait is guarded — a dead peer or coordinator
+        turns into a bounded ``JobAbortedError``/``TimeoutError`` instead
+        of an infinite rendezvous (the watchdog contract)."""
+        if self.process_count == 1:
+            return
+        seq = self._next_seq("host_barrier")
+        self._barrier(f"og/hb_barrier/{seq}",
+                      timeout_ms if timeout_ms is not None
+                      else _rpc_policy().barrier_ms())
 
     # -- kv helpers (chunked; coordinator values are bounded strings) ----
 
@@ -216,22 +262,25 @@ class ObjectPlane:
         return n
 
     def _kv_put(self, key: str, data: bytes) -> None:
+        _chaos.on_rpc("kv_put")
         client = _client()
         nchunks = max(1, (len(data) + _KV_CHUNK - 1) // _KV_CHUNK)
 
         def put_all():
             # ONE guard thread for the whole put (not one per chunk RPC):
             # large scatters would otherwise spawn hundreds of short-lived
-            # threads; the liveness probe still fires every _PROBE_MS
+            # threads; the liveness probe still fires every probe slice
             client.key_value_set(f"{key}/n", str(nchunks))
             for c in range(nchunks):
                 client.key_value_set_bytes(
                     f"{key}/{c}", data[c * _KV_CHUNK:(c + 1) * _KV_CHUNK])
 
         # budget scales with payload so multi-GB scatters aren't cut off
-        _guard_rpc(put_all, budget_ms=600_000 + 10_000 * nchunks)
+        _guard_rpc(put_all, budget_ms=_rpc_policy().put_budget_ms(nchunks))
 
-    def _kv_get(self, key: str, timeout_ms: int = 600_000) -> bytes:
+    def _kv_get(self, key: str, timeout_ms: Optional[int] = None) -> bytes:
+        if timeout_ms is None:
+            timeout_ms = _rpc_policy().timeout_ms
         nchunks = int(_sliced_get(f"{key}/n", timeout_ms))
         parts = []
         for c in range(nchunks):
@@ -239,52 +288,57 @@ class ObjectPlane:
         return b"".join(parts)
 
     def _barrier(self, name: str, timeout_ms: int) -> None:
+        _chaos.on_rpc("barrier")
         client = _client()
         # barriers cannot be sliced (a timed-out barrier id is poisoned for
         # every participant), so guard the single long wait with probes
         _guard_rpc(lambda: client.wait_at_barrier(name, timeout_ms),
-                   budget_ms=timeout_ms + _PROBE_MS)
+                   budget_ms=timeout_ms + _rpc_policy().probe_ms)
 
 
 def _coordinator_alive() -> None:
     """Raise if the job is aborted or the coordinator is unreachable.
 
     Two checks: (1) the poison key posted by a crashing rank's except hook
-    (non-blocking try_get; missing key = healthy); (2) a short get on the
+    or the watchdog (non-blocking read; missing key = healthy); (2) a
+    short get on the
     liveness key every ObjectPlane seeds at construction — it returns
     instantly while the coordinator lives, so ANY error (including a
     client-side deadline against a dead endpoint) means the coordinator is
     gone."""
     client = _client()
-    if hasattr(client, "key_value_try_get"):
-        # guarded: on older jaxlib clients without the method the abort-key
-        # fast path must be *visibly absent* (fall through to check 2), not
-        # a swallowed AttributeError masquerading as "no abort posted"
-        try:
-            reason = client.key_value_try_get(_ABORT_KEY)
-        except Exception:  # NotFound: nobody aborted (or see check 2)
-            pass
-        else:
-            raise JobAbortedError(
-                f"job aborted by a crashed peer: {reason}")
+    reason = _read_abort(client)
+    if reason is not None:
+        raise JobAbortedError(
+            f"job aborted by a crashed peer: {reason}")
     last = None
-    for attempt_ms in (2_000, 5_000):  # one retry: a loaded coordinator
-        #                                may miss a single short deadline
+    pol = _rpc_policy()
+    ladder = pol.liveness_ladder_ms()
+    for attempt, attempt_ms in enumerate(ladder):
+        # retry ladder: a loaded coordinator may miss one short deadline;
+        # back off (jittered) between attempts so N stuck ranks don't
+        # hammer a struggling coordinator in lockstep
         try:
             client.blocking_key_value_get(_ALIVE_KEY, attempt_ms)
             return
         except Exception as e:  # noqa: BLE001
             last = e
+            if attempt + 1 < len(ladder):
+                time.sleep(pol.backoff_ms(attempt) / 1000.0)
     raise RuntimeError(
         f"jax.distributed coordinator unreachable — aborting instead "
         f"of waiting out the full collective timeout: {last}") from last
 
 
-def _guard_rpc(fn, budget_ms: int = 600_000):
+def _guard_rpc(fn, budget_ms: Optional[int] = None):
     """Run a coordinator RPC that has no deadline of its own on a worker
-    thread; while it blocks, probe coordinator liveness every _PROBE_MS and
-    raise promptly if the coordinator is gone (the abandoned daemon thread
-    is moot — the caller is about to tear the process down)."""
+    thread; while it blocks, probe coordinator liveness every policy probe
+    slice and raise promptly if the coordinator is gone (the abandoned
+    daemon thread is moot — the caller is about to tear the process
+    down)."""
+    pol = _rpc_policy()
+    if budget_ms is None:
+        budget_ms = pol.timeout_ms
     result: dict = {}
 
     def run():
@@ -297,7 +351,7 @@ def _guard_rpc(fn, budget_ms: int = 600_000):
     th.start()
     waited = 0
     while True:
-        slice_ms = min(_PROBE_MS, budget_ms - waited)
+        slice_ms = min(pol.probe_ms, budget_ms - waited)
         th.join(max(slice_ms, 1) / 1000)
         waited += slice_ms
         if not th.is_alive():
@@ -343,12 +397,13 @@ def _is_deadline_error(e: Exception) -> bool:
 def _sliced_get(key: str, timeout_ms: int, raw: bool = False):
     """blocking_key_value_get with the budget sliced into short attempts,
     probing coordinator liveness between slices (fail-fast)."""
+    _chaos.on_rpc("kv_get")
     client = _client()
     get = (client.blocking_key_value_get_bytes if raw
            else client.blocking_key_value_get)
     waited = 0
     while True:
-        slice_ms = min(_PROBE_MS, timeout_ms - waited)
+        slice_ms = min(_rpc_policy().probe_ms, timeout_ms - waited)
         if slice_ms <= 0:
             raise TimeoutError(
                 f"key {key!r} not published within {timeout_ms} ms")

@@ -731,6 +731,14 @@ class XlaCommunicator(CommunicatorBase):
     def scatter_obj(self, objs, root: int = 0):
         return self._obj.scatter_obj(objs, root)
 
+    def host_barrier(self) -> None:
+        """Process-plane barrier over the coordinator KV store — every
+        wait is guarded (liveness probes, abort key, watchdog), so a dead
+        peer yields a bounded JobAbortedError, not an infinite device
+        rendezvous. Use for host-side sync points (checkpoint elections);
+        :meth:`barrier` stays the device-collective barrier."""
+        self._obj.barrier()
+
     # -- model-level ops ------------------------------------------------
 
     def bcast_data(self, params, root: int = 0):

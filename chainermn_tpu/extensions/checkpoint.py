@@ -14,12 +14,15 @@ hold") rides the host object plane exactly like the reference's allgather.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import pickle
 import queue
 import re
 import threading
-from typing import Any, List, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -27,6 +30,25 @@ import jax
 import jax.numpy as jnp
 
 from chainermn_tpu.comm.base import CommunicatorBase
+from chainermn_tpu.resilience import chaos as _chaos
+
+
+def _sha256_file(fn: str) -> str:
+    h = hashlib.sha256()
+    with open(fn, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_file(fn: str) -> None:
+    fd = os.open(fn, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # fsync unsupported (some tmpfs) — rename is still atomic
+    finally:
+        os.close(fd)
 
 
 def _leaf_dict(state):
@@ -260,8 +282,17 @@ class MultiNodeCheckpointer:
         # every process writes its own snapshot file and may have its own
         # (non-shared) filesystem — each must create the directory
         os.makedirs(self.path, exist_ok=True)
-        if hasattr(comm, "barrier"):
-            comm.barrier()
+        self._pre_election_barrier()
+
+    def _pre_election_barrier(self):
+        """Host-plane barrier when the communicator offers one (bounded
+        waits, watchdog-abortable, no device collectives needed — a dead
+        peer raises instead of hanging); device barrier as fallback."""
+        hb = getattr(self.comm, "host_barrier", None)
+        if callable(hb):
+            hb()
+        elif hasattr(self.comm, "barrier"):
+            self.comm.barrier()
 
     # -- async writer ---------------------------------------------------
 
@@ -368,8 +399,32 @@ class MultiNodeCheckpointer:
     # -- save -----------------------------------------------------------
 
     def _publish(self, arrays: dict, fn: str):
-        np.savez(fn + ".npz", **arrays)
-        os.replace(fn + ".npz", fn)  # atomic publish
+        """Atomic, verifiable publish: write to a tmp name, fsync, rename
+        into place, then publish a sidecar JSON manifest carrying the
+        file's SHA-256 (itself tmp+fsync+renamed). A crash at any point
+        leaves either the previous snapshot (tmp never renamed) or a
+        data file whose manifest proves it intact — a torn or corrupted
+        file FAILS verification and is excluded from the consensus
+        election instead of poisoning the restore."""
+        tmp = fn + ".npz"
+        np.savez(tmp, **arrays)
+        _fsync_file(tmp)
+        sha = _sha256_file(tmp)
+        size = os.path.getsize(tmp)
+        os.replace(tmp, fn)  # atomic publish
+        manifest = {"format": 1, "sha256": sha, "bytes": size}
+        mtmp = fn + ".json.tmp"
+        with open(mtmp, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh)
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass
+        os.replace(mtmp, fn + ".json")
+        # chaos harness: torn/corrupt-snapshot injection point — damage
+        # happens AFTER a fully valid publish, exactly like a bad disk
+        _chaos.on_checkpoint(fn)
         self._gc()
 
     def _orbax_ck(self):
@@ -379,7 +434,12 @@ class MultiNodeCheckpointer:
             self._orbax = ocp.StandardCheckpointer()
         return self._orbax
 
-    def save(self, state: Any, iteration: int) -> str:
+    def save(self, state: Any, iteration: int,
+             host_state: Any = None) -> str:
+        """Snapshot ``state`` (device pytree) plus optional ``host_state``
+        (a small picklable dict: iterator position, RNG state, epoch
+        counters — see ``StandardUpdater.host_state_dict``) under this
+        rank's file for ``iteration``."""
         self._raise_pending()
         fn = os.path.join(
             self.path, f"snapshot_iter_{iteration}.{self.comm.inter_rank}"
@@ -407,6 +467,13 @@ class MultiNodeCheckpointer:
         # process count changes (scale-up/down resharding) while a crash
         # that lost one rank's file still reads as incomplete
         arrays["__world__"] = np.int64(self.comm.inter_size)
+        if host_state is not None:
+            # host-side state rides the npz as pickled bytes (a uint8
+            # array, so allow_pickle stays False on load) — covered by
+            # the same SHA-256 as the device state
+            arrays["__host_state__"] = np.frombuffer(
+                pickle.dumps(host_state, pickle.HIGHEST_PROTOCOL),
+                np.uint8).copy()
         if self.async_write:
             self._ensure_writer()
             self._queue.put((arrays, fn))
@@ -440,27 +507,138 @@ class MultiNodeCheckpointer:
                     os.remove(fn)
             except OSError:
                 pass
+            try:
+                os.remove(fn + ".json")
+            except OSError:
+                pass
+
+    # -- integrity -------------------------------------------------------
+
+    def _verify_snapshot_file(self, fn: str) -> bool:
+        """Is this snapshot file intact? A sidecar manifest (``fn.json``)
+        carries the published file's SHA-256 and byte size; mismatch —
+        a torn write, truncation, or bit rot — marks the file invalid so
+        the election skips it. Files without a manifest (pre-hardening
+        snapshots, orbax directories) are accepted as-is for
+        compatibility. Results are cached by (mtime, size)."""
+        if os.path.isdir(fn):
+            return True  # orbax: tensorstore does its own checksumming
+        if not os.path.exists(fn):
+            return False
+        mf = fn + ".json"
+        if not os.path.exists(mf):
+            return True
+        try:
+            with open(mf, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError):
+            return False  # torn manifest: treat the snapshot as suspect
+        st = os.stat(fn)
+        key = (fn, st.st_mtime_ns, st.st_size)
+        cache = getattr(self, "_verify_cache", None)
+        if cache is None:
+            cache = self._verify_cache = {}
+        if key in cache:
+            return cache[key]
+        if manifest.get("bytes") not in (None, st.st_size):
+            ok = False  # fast path: truncation shows in the size alone
+        else:
+            try:
+                ok = _sha256_file(fn) == manifest.get("sha256")
+            except OSError:
+                ok = False
+        if len(cache) > 128:
+            cache.clear()
+        cache[key] = ok
+        return ok
+
+    def _valid_iters_on_disk(self) -> List[int]:
+        """This rank's iterations whose snapshot files pass integrity
+        verification — the election's own-file inventory."""
+        return [
+            it for it in self._iters_on_disk()
+            if self._verify_snapshot_file(os.path.join(
+                self.path,
+                f"snapshot_iter_{it}.{self.comm.inter_rank}"))
+        ]
 
     # -- trainer integration --------------------------------------------
 
     def __call__(self, trainer):
         """Trainer-extension protocol (reference idiom:
-        ``trainer.extend(checkpointer)``): snapshot the updater's state at
-        each trigger point."""
-        self.save(trainer.updater.state, trainer.updater.iteration)
+        ``trainer.extend(checkpointer)``): snapshot the updater's state —
+        device pytree plus host state (iterator position, RNG) when the
+        updater provides it — at each trigger point."""
+        host_fn = getattr(trainer.updater, "host_state_dict", None)
+        self.save(trainer.updater.state, trainer.updater.iteration,
+                  host_state=host_fn() if callable(host_fn) else None)
+
+    def emergency_save(self, trainer, deadline_s: Optional[float] = None):
+        """Last-chance synchronous snapshot (preemption / crash path).
+
+        Bypasses the async writer queue entirely — the process is about
+        to die, so the write must be on THIS thread and published before
+        return. No collective is involved (saves are per-rank), so every
+        rank can run it independently inside its own grace window;
+        ``deadline_s`` (monotonic) skips the write when the window has
+        already closed — a partial write past the deadline would only be
+        garbage for the election to reject."""
+        if deadline_s is not None and time.monotonic() >= deadline_s:
+            return None
+        host_fn = getattr(trainer.updater, "host_state_dict", None)
+        state = trainer.updater.state
+        iteration = trainer.updater.iteration
+        fn = os.path.join(
+            self.path, f"snapshot_iter_{iteration}.{self.comm.inter_rank}")
+        if self.backend == "orbax":
+            ck = self._orbax_ck()
+            ck.save(os.path.abspath(fn), _leaf_dict(state), force=True)
+            ck.wait_until_finished()
+            return fn
+        arrays, _ = _flatten_state(state)
+        arrays["__world__"] = np.int64(self.comm.inter_size)
+        host_state = host_fn() if callable(host_fn) else None
+        if host_state is not None:
+            arrays["__host_state__"] = np.frombuffer(
+                pickle.dumps(host_state, pickle.HIGHEST_PROTOCOL),
+                np.uint8).copy()
+        self._publish(arrays, fn)
+        return fn
+
+    def load_host_state(self, iteration: int) -> Any:
+        """The pickled host state stored with this rank's snapshot for
+        ``iteration`` (None when the snapshot predates host state or the
+        file is not this rank's to read)."""
+        fn = os.path.join(
+            self.path, f"snapshot_iter_{iteration}.{self.comm.inter_rank}")
+        if not os.path.exists(fn) or os.path.isdir(fn):
+            return None
+        with np.load(fn, allow_pickle=False) as z:
+            if "__host_state__" not in z.files:
+                return None
+            return pickle.loads(z["__host_state__"].tobytes())
 
     def resume(self, updater) -> Optional[int]:
         """Restore the updater from the newest complete snapshot, if any.
 
         Sets ``updater.state`` and ``updater.iteration`` and returns the
-        restored iteration (None when nothing restorable exists). The data
-        iterator restarts from its beginning — same contract as the
-        reference's restart-based recovery, where resumed epochs reshuffle.
+        restored iteration (None when nothing restorable exists). When the
+        snapshot carries host state and the updater supports it
+        (``load_host_state``), the iterator position, epoch counters, and
+        shuffling RNG are restored too — the resumed run continues on the
+        exact next batch. Otherwise the data iterator restarts from its
+        beginning — the reference's restart-based contract, where resumed
+        epochs reshuffle.
         """
         state, it = self.maybe_load(updater.state)
         if it is not None:
             updater.state = state
             updater.iteration = it
+            host = self.load_host_state(it)
+            restore = getattr(updater, "load_host_state", None)
+            if host is not None and callable(restore):
+                restore(host)
+                return it
             # fast-forward the iterator's epoch counter, or an epoch-based
             # stop trigger would re-run every completed epoch on the
             # restored state (the position WITHIN the epoch restarts —
@@ -491,7 +669,9 @@ class MultiNodeCheckpointer:
                 # peer process cannot np.load, so scale-up (which loads
                 # every leaf from peer files) stays npz-territory — an
                 # orbax new-rank simply never elects, gracefully
-                if m and not os.path.isdir(os.path.join(self.path, f)):
+                if (m and not os.path.isdir(os.path.join(self.path, f))
+                        and self._verify_snapshot_file(
+                            os.path.join(self.path, f))):
                     by_iter.setdefault(int(m.group(1)), set()).add(
                         int(m.group(2)))
         out = []
@@ -531,9 +711,12 @@ class MultiNodeCheckpointer:
         # in-flight save is a race the own-file view never had: barrier
         # first — every process enters the election only after its own
         # saves returned, so post-barrier listings see them all
-        if self.comm.inter_size > 1 and hasattr(self.comm, "barrier"):
-            self.comm.barrier()
-        mine = sorted(set(self._iters_on_disk())
+        self._pre_election_barrier()
+        # VALID files only: a corrupt or torn snapshot (SHA mismatch
+        # against its manifest) is excluded from this rank's inventory,
+        # so the intersection falls back to the newest iteration intact
+        # on every rank instead of electing a file nobody can load
+        mine = sorted(set(self._valid_iters_on_disk())
                       | set(self._complete_iters_on_disk()))
         all_lists = self.comm.allgather_obj(mine)
         common = set(all_lists[0])
@@ -567,6 +750,13 @@ class MultiNodeCheckpointer:
             loaded = self._orbax_ck().restore(
                 os.path.abspath(fn), _leaf_dict(state))
         elif os.path.exists(fn):
+            if not self._verify_snapshot_file(fn):
+                raise ValueError(
+                    f"{fn}: snapshot file fails SHA-256 verification "
+                    "against its manifest (torn write or corruption) — "
+                    "refusing to load; the consensus election excludes "
+                    "such files, so pass no explicit iteration to fall "
+                    "back to the newest intact snapshot")
             loaded = np.load(fn, allow_pickle=False)
         else:
             # scale-up: this rank did not exist in the saving run — every

@@ -72,6 +72,34 @@ class SerialIterator:
     def epoch_detail(self):
         return self.epoch + self._at / max(1, len(self.dataset))
 
+    # -- full-state resume (docs/fault_tolerance.md) --------------------
+
+    def state_dict(self) -> dict:
+        """Position + shuffling-RNG snapshot: restoring it continues the
+        epoch on the exact next batch, with the same future shuffles —
+        unlike the reference's restart semantics, which replayed the
+        epoch from its beginning with a fresh shuffle."""
+        return {
+            "epoch": self.epoch,
+            "is_new_epoch": self.is_new_epoch,
+            "at": self._at,
+            "order": np.asarray(self._order).copy(),
+            "rng": self._rng.get_state(),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        order = np.asarray(state["order"])
+        if len(order) != len(self.dataset):
+            raise ValueError(
+                f"iterator state is for a dataset of {len(order)} samples, "
+                f"this iterator holds {len(self.dataset)} — resuming would "
+                "index out of range or silently skip data")
+        self.epoch = int(state["epoch"])
+        self.is_new_epoch = bool(state["is_new_epoch"])
+        self._at = int(state["at"])
+        self._order = order
+        self._rng.set_state(state["rng"])
+
 
 def create_multi_node_iterator(actual_iterator, communicator: CommunicatorBase,
                                rank_master: int = 0):
@@ -122,6 +150,30 @@ class _MultiNodeIterator:
         return batch
 
     next = __next__
+
+    def state_dict(self) -> dict:
+        """Per-rank resume state: the master saves its inner iterator's
+        full position; every rank saves the shared epoch counters (the
+        broadcast keeps them in agreement, so any rank's copy is the
+        job's)."""
+        inner = getattr(self._it, "state_dict", None)
+        return {
+            "epoch": self.epoch,
+            "is_new_epoch": self.is_new_epoch,
+            "epoch_detail": self.epoch_detail,
+            "inner": inner() if (callable(inner)
+                                 and self._comm.inter_rank == self._master)
+            else None,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        inner = state.get("inner")
+        restore = getattr(self._it, "load_state_dict", None)
+        if inner is not None and callable(restore):
+            restore(inner)
+        self.epoch = state["epoch"]
+        self.is_new_epoch = state["is_new_epoch"]
+        self.epoch_detail = state["epoch_detail"]
 
 
 def create_synchronized_iterator(actual_iterator, communicator: CommunicatorBase):

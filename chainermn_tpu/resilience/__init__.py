@@ -1,0 +1,62 @@
+"""Fault injection + preemption survival for distributed training.
+
+The stack's fault-tolerance story (PAPER.md §2.5/§3.5) was restart-based
+and untested: per-rank snapshots plus a consensus election, assuming
+clean process death and intact files. This package supplies both the
+*machinery* to survive the real failure modes and the *chaos harness*
+that injects them so tests can prove it:
+
+* :mod:`.chaos` — deterministic, seed-driven fault injection (kill a
+  rank at step N, delay/blackhole coordinator RPCs, corrupt/truncate a
+  checkpoint file), activated via ``$CHAINERMN_TPU_CHAOS``;
+* :mod:`.preemption` — SIGTERM/SIGINT → flag → emergency checkpoint →
+  clean exit (the Trainer polls it every step);
+* :mod:`.watchdog` — per-process heartbeat thread that converts a dead
+  peer's infinite collective hang into a bounded ``JobAbortedError``;
+* :mod:`.policy` — the one RPC timeout/backoff policy the host plane's
+  retry logic derives from (``$CHAINERMN_TPU_RPC_TIMEOUT_MS``).
+
+See docs/fault_tolerance.md for the failure-mode table and cookbook.
+"""
+
+from chainermn_tpu.resilience.chaos import (
+    ChaosPlan,
+    Fault,
+    FAULT_KINDS,
+    chaos_from_env,
+    parse_spec,
+)
+from chainermn_tpu.resilience.policy import RpcPolicy, policy, set_policy
+from chainermn_tpu.resilience.preemption import (
+    PREEMPTED_EXIT_CODE,
+    PreemptionGuard,
+    install_preemption_handler,
+    preemption_requested,
+)
+from chainermn_tpu.resilience.watchdog import (
+    Watchdog,
+    current_watchdog,
+    maybe_start_watchdog,
+    start_watchdog,
+    stop_watchdog,
+)
+
+__all__ = [
+    "ChaosPlan",
+    "Fault",
+    "FAULT_KINDS",
+    "chaos_from_env",
+    "parse_spec",
+    "RpcPolicy",
+    "policy",
+    "set_policy",
+    "PREEMPTED_EXIT_CODE",
+    "PreemptionGuard",
+    "install_preemption_handler",
+    "preemption_requested",
+    "Watchdog",
+    "current_watchdog",
+    "maybe_start_watchdog",
+    "start_watchdog",
+    "stop_watchdog",
+]

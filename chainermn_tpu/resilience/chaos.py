@@ -1,0 +1,307 @@
+"""Deterministic, seed-driven fault injection for distributed training.
+
+The restart-based fault-tolerance story (per-rank snapshots + consensus
+election, PAPER.md §2.5/§3.5) is only as good as its worst failure mode —
+and the dominant ones on real pods are preemption, one wedged host, and
+torn snapshot files. This harness *injects* exactly those faults, on a
+deterministic schedule, so the suite can prove the stack survives them:
+
+* ``kill`` — deliver a signal (SIGKILL/SIGTERM/...) to the *own* process
+  when the training loop reaches a given step, on a given rank;
+* ``delay_rpc`` — sleep before coordinator KV RPCs in the object plane
+  (a slow/loaded coordinator);
+* ``blackhole_rpc`` — stall matching RPCs for a long, configurable time
+  (a wedged coordinator link; the guard probes bound the damage);
+* ``corrupt`` / ``truncate`` — damage a named checkpoint file right
+  after it is published (a torn write / bad disk).
+
+Activation is by environment variable so `tests/mp_harness.py` worker
+processes self-inject without any code path knowing about the test:
+
+    CHAINERMN_TPU_CHAOS="kill@step=3,rank=1,signal=SIGKILL"
+    CHAINERMN_TPU_CHAOS="corrupt@match=snapshot_iter_6.1;delay_rpc@op=kv_get,ms=200,prob=0.5,seed=7"
+
+Specs are ``;``-separated faults, each ``kind@key=value,key=value,...``.
+Probabilistic faults draw from a ``seed``-pinned RNG: the same spec
+replays the same failure schedule (the point of *deterministic* chaos).
+
+Hook points (all no-ops when the env var is unset):
+
+* :func:`on_step` — called by the Trainer loop (and any manual step
+  loop) with the global iteration number;
+* :func:`on_rpc` — called by ``comm/object_plane.py`` before each
+  coordinator RPC (ops: ``kv_get``, ``kv_put``, ``barrier``);
+* :func:`on_checkpoint` — called by the checkpointer after publishing a
+  snapshot file, with its path.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal as _signal
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+ENV_VAR = "CHAINERMN_TPU_CHAOS"
+
+#: fault kind -> one-line description (the CLI's --dry-run catalogue)
+FAULT_KINDS: Dict[str, str] = {
+    "kill": ("deliver a signal to this process at a training step: "
+             "step=N[,rank=R|*][,signal=SIGKILL|SIGTERM|...]"),
+    "delay_rpc": ("sleep before matching coordinator RPCs: "
+                  "ms=M[,op=kv_get|kv_put|barrier|*][,prob=P][,seed=S]"
+                  "[,rank=R|*]"),
+    "blackhole_rpc": ("stall matching coordinator RPCs: "
+                      "[ms=M (default 3600000)][,op=...][,prob=P]"
+                      "[,seed=S][,rank=R|*][,after=K (skip first K)]"),
+    "corrupt": ("flip bytes in a checkpoint file right after publish: "
+                "match=SUBSTRING[,rank=R|*][,offset=O]"),
+    "truncate": ("truncate a checkpoint file right after publish: "
+                 "match=SUBSTRING[,rank=R|*][,keep=BYTES (default half)]"),
+}
+
+_INT_KEYS = {"step", "ms", "offset", "keep", "after", "seed"}
+_FLOAT_KEYS = {"prob"}
+
+
+@dataclass
+class Fault:
+    kind: str
+    step: Optional[int] = None
+    rank: Optional[int] = None          # None = every rank ('*')
+    signal: str = "SIGKILL"
+    op: Optional[str] = None            # None = every rpc op ('*')
+    ms: Optional[int] = None
+    prob: float = 1.0
+    seed: Optional[int] = None
+    match: Optional[str] = None
+    offset: int = 0
+    keep: Optional[int] = None
+    after: int = 0
+    fired: int = field(default=0, repr=False)
+    _rng: Optional[random.Random] = field(default=None, repr=False)
+    _skipped: int = field(default=0, repr=False)
+
+    def rng(self) -> random.Random:
+        if self._rng is None:
+            self._rng = random.Random(self.seed)
+        return self._rng
+
+    def applies_to_rank(self, rank: Optional[int]) -> bool:
+        return self.rank is None or rank is None or self.rank == rank
+
+    def roll(self) -> bool:
+        if self.prob >= 1.0:
+            return True
+        return self.rng().random() < self.prob
+
+    def describe(self) -> str:
+        """One-line human rendering of the set fields (the CLI's
+        --dry-run listing)."""
+        parts = []
+        for name in ("step", "signal", "op", "ms", "prob", "seed",
+                     "match", "offset", "keep", "after"):
+            val = getattr(self, name)
+            if val is None:
+                continue
+            if name == "signal" and self.kind != "kill":
+                continue
+            if name == "prob" and val >= 1.0:
+                continue
+            if name in ("offset", "after") and not val:
+                continue
+            parts.append(f"{name}={val}")
+        return " ".join(parts) or "(defaults)"
+
+
+def parse_spec(spec: str) -> List[Fault]:
+    """Parse a ``;``-separated chaos spec into faults (raises ValueError
+    with the offending clause on malformed input)."""
+    faults: List[Fault] = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        kind, _, rest = clause.partition("@")
+        kind = kind.strip()
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown chaos fault {kind!r} in {clause!r} — known: "
+                + ", ".join(sorted(FAULT_KINDS)))
+        kv: Dict[str, object] = {}
+        for item in filter(None, (s.strip() for s in rest.split(","))):
+            key, eq, val = item.partition("=")
+            if not eq:
+                raise ValueError(
+                    f"malformed {key!r} in chaos clause {clause!r} "
+                    "(expected key=value)")
+            key = key.strip()
+            val = val.strip()
+            if key == "rank" and val == "*":
+                kv["rank"] = None
+            elif key in _INT_KEYS or key == "rank":
+                kv[key] = int(val)
+            elif key in _FLOAT_KEYS:
+                kv[key] = float(val)
+            else:
+                kv[key] = val
+        try:
+            fault = Fault(kind=kind, **kv)
+        except TypeError as e:
+            raise ValueError(
+                f"bad field in chaos clause {clause!r}: {e}") from e
+        if fault.kind == "kill" and fault.step is None:
+            raise ValueError(f"kill fault needs step=N: {clause!r}")
+        if fault.kind in ("corrupt", "truncate") and not fault.match:
+            raise ValueError(
+                f"{fault.kind} fault needs match=SUBSTRING: {clause!r}")
+        if fault.kind == "delay_rpc" and fault.ms is None:
+            raise ValueError(f"delay_rpc fault needs ms=M: {clause!r}")
+        if not (0.0 <= fault.prob <= 1.0):
+            raise ValueError(f"prob must be in [0, 1]: {clause!r}")
+        faults.append(fault)
+    return faults
+
+
+def _own_rank() -> Optional[int]:
+    """This process's rank for fault matching: the mp-harness worker id
+    when set, else jax.process_index() if jax is initialized, else None
+    (matches every-rank faults only)."""
+    for var in ("CHAINERMN_TPU_CHAOS_RANK", "JAX_PROCESS_ID"):
+        raw = os.environ.get(var)
+        if raw is not None:
+            try:
+                return int(raw)
+            except ValueError:
+                pass
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            return jax.process_index()
+    except Exception:
+        pass
+    return None
+
+
+class ChaosPlan:
+    """The parsed fault schedule plus the injection hooks.
+
+    ``kill_fn``/``sleep_fn`` are injectable for tests; real use keeps the
+    defaults (``os.kill`` on the own pid, ``time.sleep``).
+    """
+
+    def __init__(self, faults: List[Fault],
+                 kill_fn: Optional[Callable[[int], None]] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        self.faults = faults
+        self._kill = kill_fn or (
+            lambda signum: os.kill(os.getpid(), signum))
+        self._sleep = sleep_fn
+        self.log: List[str] = []  # fired faults, for tests/debugging
+
+    # -- hooks ----------------------------------------------------------
+
+    def on_step(self, iteration: int, rank: Optional[int] = None) -> None:
+        rank = _own_rank() if rank is None else rank
+        for f in self.faults:
+            if f.kind != "kill" or f.step != iteration:
+                continue
+            if not f.applies_to_rank(rank):
+                continue
+            signum = getattr(_signal, f.signal, None)
+            if signum is None:
+                raise ValueError(f"unknown signal {f.signal!r}")
+            f.fired += 1
+            self.log.append(f"kill step={iteration} signal={f.signal}")
+            self._kill(int(signum))
+
+    def on_rpc(self, op: str, rank: Optional[int] = None) -> None:
+        rank = _own_rank() if rank is None else rank
+        for f in self.faults:
+            if f.kind not in ("delay_rpc", "blackhole_rpc"):
+                continue
+            if f.op is not None and f.op != "*" and f.op != op:
+                continue
+            if not f.applies_to_rank(rank):
+                continue
+            if f._skipped < f.after:
+                f._skipped += 1
+                continue
+            if not f.roll():
+                continue
+            ms = f.ms if f.ms is not None else (
+                3_600_000 if f.kind == "blackhole_rpc" else 0)
+            f.fired += 1
+            self.log.append(f"{f.kind} op={op} ms={ms}")
+            self._sleep(ms / 1000.0)
+
+    def on_checkpoint(self, path: str,
+                      rank: Optional[int] = None) -> None:
+        rank = _own_rank() if rank is None else rank
+        base = os.path.basename(path)
+        for f in self.faults:
+            if f.kind not in ("corrupt", "truncate"):
+                continue
+            if not f.applies_to_rank(rank):
+                continue
+            if f.match not in path and f.match not in base:
+                continue
+            if not f.roll():
+                continue
+            f.fired += 1
+            self.log.append(f"{f.kind} path={base}")
+            if f.kind == "truncate":
+                size = os.path.getsize(path)
+                keep = f.keep if f.keep is not None else size // 2
+                with open(path, "rb+") as fh:
+                    fh.truncate(max(0, keep))
+            else:
+                with open(path, "rb+") as fh:
+                    fh.seek(f.offset)
+                    chunk = fh.read(64) or b"\0"
+                    fh.seek(f.offset)
+                    fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+_plan: Optional[ChaosPlan] = None
+_plan_spec: Optional[str] = None
+
+
+def chaos_from_env() -> Optional[ChaosPlan]:
+    """The process-wide plan from $CHAINERMN_TPU_CHAOS (cached; re-parsed
+    when the env var's value changes, so tests can swap specs)."""
+    global _plan, _plan_spec
+    spec = os.environ.get(ENV_VAR)
+    if not spec:
+        _plan, _plan_spec = None, None
+        return None
+    if _plan is None or spec != _plan_spec:
+        _plan = ChaosPlan(parse_spec(spec))
+        _plan_spec = spec
+    return _plan
+
+
+# module-level hook wrappers: callers stay one `if` away from zero cost
+
+def on_step(iteration: int) -> None:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            plan.on_step(iteration)
+
+
+def on_rpc(op: str) -> None:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            plan.on_rpc(op)
+
+
+def on_checkpoint(path: str) -> None:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            plan.on_checkpoint(path)

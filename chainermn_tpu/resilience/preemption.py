@@ -1,0 +1,156 @@
+"""Preemption survival: turn SIGTERM into a checkpoint, not a loss.
+
+TPU pods are preempted with a SIGTERM and a grace window; the default
+disposition kills the process mid-step and throws away every iteration
+since the last periodic snapshot. This module installs a handler that
+only sets a flag; the Trainer loop polls :func:`preemption_requested`
+once per step and, when set, runs an emergency all-rank checkpoint
+(bounded by :func:`grace_deadline`) and exits the run loop cleanly —
+the consensus election finds the emergency snapshot on restart.
+
+The handler is deliberately minimal (async-signal-safe: set a flag,
+remember the signal, chain nothing): all real work happens on the
+training thread. Install/uninstall is idempotent and restores the
+previous handlers, so library users and tests can scope it to a run.
+
+``CHAINERMN_TPU_PREEMPTION_GRACE_S`` configures the grace window the
+emergency checkpoint must fit into (default 30 s).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+_ENV_GRACE = "CHAINERMN_TPU_PREEMPTION_GRACE_S"
+_DEFAULT_GRACE_S = 30.0
+
+#: conventional exit code for a run that stopped on preemption after
+#: checkpointing (distinct from 0 so orchestrators can tell "finished"
+#: from "preempted but resumable"; 128+SIGTERM is what an unhandled
+#: SIGTERM would have produced)
+PREEMPTED_EXIT_CODE = 143
+
+
+class PreemptionGuard:
+    """Flag-and-deadline state shared between the signal handler and the
+    training loop. Thread-safe: the flag is a simple attribute write from
+    the handler, reads are racy-but-monotonic (once True, stays True until
+    :meth:`reset`)."""
+
+    def __init__(self) -> None:
+        self._requested = False
+        self._signum: Optional[int] = None
+        self._at: Optional[float] = None
+        self._prev: Dict[int, object] = {}
+        self._installed: Tuple[int, ...] = ()
+
+    # -- handler side ----------------------------------------------------
+
+    def _handle(self, signum, frame) -> None:  # noqa: ARG002 (signature)
+        self._requested = True
+        self._signum = signum
+        if self._at is None:
+            self._at = time.monotonic()
+
+    # -- training-loop side ----------------------------------------------
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    @property
+    def signum(self) -> Optional[int]:
+        return self._signum
+
+    def grace_deadline(self) -> Optional[float]:
+        """Monotonic deadline the emergency checkpoint must beat (None
+        until a signal arrived)."""
+        if self._at is None:
+            return None
+        return self._at + grace_seconds()
+
+    def remaining(self) -> Optional[float]:
+        dl = self.grace_deadline()
+        return None if dl is None else max(0.0, dl - time.monotonic())
+
+    def reset(self) -> None:
+        self._requested = False
+        self._signum = None
+        self._at = None
+
+    # -- install/uninstall -----------------------------------------------
+
+    def install(self, signals: Tuple[int, ...] = (signal.SIGTERM,
+                                                  signal.SIGINT)) -> bool:
+        """Install the flag-setting handler; returns False when not on the
+        main thread (signal.signal would raise) — callers treat that as
+        "preemption handling unavailable", not an error."""
+        if self._installed:
+            return True
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        prev = {}
+        try:
+            for s in signals:
+                prev[s] = signal.signal(s, self._handle)
+        except ValueError:
+            for s, h in prev.items():
+                signal.signal(s, h)
+            return False
+        self._prev = prev
+        self._installed = tuple(signals)
+        return True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s in self._installed:
+            prev = self._prev.get(s)
+            if prev is not None:
+                try:
+                    signal.signal(s, prev)
+                except (ValueError, TypeError):
+                    pass
+        self._prev = {}
+        self._installed = ()
+
+
+def grace_seconds() -> float:
+    raw = os.environ.get(_ENV_GRACE)
+    if not raw:
+        return _DEFAULT_GRACE_S
+    try:
+        v = float(raw)
+    except ValueError:
+        return _DEFAULT_GRACE_S
+    return v if v > 0 else _DEFAULT_GRACE_S
+
+
+_guard: Optional[PreemptionGuard] = None
+
+
+def guard() -> PreemptionGuard:
+    """The process-wide guard (created on first use, not installed)."""
+    global _guard
+    if _guard is None:
+        _guard = PreemptionGuard()
+    return _guard
+
+
+def install_preemption_handler(
+        signals: Tuple[int, ...] = (signal.SIGTERM,
+                                    signal.SIGINT)) -> PreemptionGuard:
+    """Install the process-wide guard's handler (idempotent) and return
+    the guard. Safe to call off the main thread (it just won't install)."""
+    g = guard()
+    g.install(signals)
+    return g
+
+
+def preemption_requested() -> bool:
+    """Has a preemption signal arrived? (False when no guard installed.)"""
+    return _guard is not None and _guard.requested

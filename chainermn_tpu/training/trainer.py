@@ -100,6 +100,31 @@ class StandardUpdater:
         self.last_metrics = metrics
         self.iteration += 1
 
+    # -- full-state resume (docs/fault_tolerance.md) --------------------
+
+    def host_state_dict(self) -> Dict[str, Any]:
+        """Host-side training position for checkpoints: iteration count,
+        iterator position/epoch/RNG, and the global NumPy RNG (augment
+        pipelines draw from it). Everything here is small and picklable;
+        the device pytree (``self.state``) is snapshotted separately."""
+        it_state = getattr(self.iterator, "state_dict", None)
+        return {
+            "iteration": self.iteration,
+            "iterator": it_state() if callable(it_state) else None,
+            "np_random": np.random.get_state(),
+        }
+
+    def load_host_state(self, host: Dict[str, Any]) -> None:
+        """Restore :meth:`host_state_dict` output — the resumed run draws
+        the exact next batch the interrupted run would have."""
+        self.iteration = int(host.get("iteration", self.iteration))
+        it_state = host.get("iterator")
+        restore = getattr(self.iterator, "load_state_dict", None)
+        if it_state is not None and callable(restore):
+            restore(it_state)
+        if host.get("np_random") is not None:
+            np.random.set_state(host["np_random"])
+
 
 class _Entry:
     def __init__(self, ext, trigger, name):
@@ -125,14 +150,26 @@ class Trainer:
     Reference convention preserved: attach reporting extensions only on the
     master (``if comm.rank == 0: trainer.extend(...)``) — metric reduction
     happens in-graph or via the multi-node evaluator, not here.
+
+    Resilience (docs/fault_tolerance.md): with ``handle_preemption=True``
+    (default) the run installs a SIGTERM/SIGINT flag handler and polls it
+    every step — a preemption triggers an emergency checkpoint on every
+    extension that offers ``emergency_save`` (the multi-node
+    checkpointer), then a clean loop exit with ``trainer.preempted`` set.
+    Any exception escaping the step loop also gets the last-chance
+    checkpoint before extensions are finalized, so partial-epoch progress
+    survives crashes. The chaos harness's step hook and the peer-death
+    watchdog (``$CHAINERMN_TPU_WATCHDOG``) ride the same per-step poll.
     """
 
     def __init__(self, updater: StandardUpdater,
                  stop_trigger: Tuple[int, str] = (1, "epoch"),
-                 out: str = "result"):
+                 out: str = "result", handle_preemption: bool = True):
         self.updater = updater
         self.stop_n, self.stop_unit = stop_trigger
         self.out = out
+        self.handle_preemption = handle_preemption
+        self.preempted = False
         self._extensions = []
         self.observation: Dict[str, float] = {}
 
@@ -157,6 +194,24 @@ class Trainer:
         self.observation["epoch"] = self.updater.epoch
         self.observation["elapsed_time"] = time.time() - start
 
+    def _emergency_checkpoint(self, deadline_s=None) -> bool:
+        """Fire ``emergency_save`` on every extension offering it (the
+        multi-node checkpointer). Failures are printed, not raised — this
+        runs on the way OUT of a dying/preempted run, where a save error
+        must not mask the original exit path."""
+        fired = False
+        for e in self._extensions:
+            fn = getattr(e.ext, "emergency_save", None)
+            if callable(fn):
+                try:
+                    fn(self, deadline_s=deadline_s)
+                    fired = True
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
+        return fired
+
     def run(self):
         if any(e.closed for e in self._extensions):
             # a prior run() finalized extensions holding external
@@ -166,20 +221,45 @@ class Trainer:
                 "this Trainer already ran and finalized its extensions; "
                 "construct a new Trainer (re-attaching extensions) to "
                 "resume")
+        from chainermn_tpu.resilience import chaos, preemption, watchdog
+
+        guard = None
+        if self.handle_preemption:
+            guard = preemption.install_preemption_handler()
+        wd = watchdog.maybe_start_watchdog()
         start = time.time()
         try:
-            while not self._stopped():
-                try:
-                    self.updater.update()
-                except StopIteration:
-                    break  # non-repeating iterator exhausted
-                due = [e for e in self._extensions if e.due(self.updater)]
-                if due:
-                    self._materialize_observation(start)
-                    for e in due:
-                        e.ext(self)
-            self._materialize_observation(start)
+            try:
+                while not self._stopped():
+                    # chaos first: an injected SIGTERM at this step is
+                    # visible to the preemption poll on the next line
+                    chaos.on_step(self.updater.iteration)
+                    if wd is not None:
+                        wd.check()
+                    if guard is not None and guard.requested:
+                        self.preempted = True
+                        self._emergency_checkpoint(guard.grace_deadline())
+                        break
+                    try:
+                        self.updater.update()
+                    except StopIteration:
+                        break  # non-repeating iterator exhausted
+                    due = [e for e in self._extensions
+                           if e.due(self.updater)]
+                    if due:
+                        self._materialize_observation(start)
+                        for e in due:
+                            e.ext(self)
+                self._materialize_observation(start)
+            except BaseException:
+                # last-chance checkpoint: partial-epoch progress survives
+                # any exception leaving the step loop (the consensus
+                # election picks it up on restart); then re-raise
+                self._emergency_checkpoint()
+                raise
         finally:
+            if guard is not None:
+                guard.uninstall()
             # finalize extensions that hold external resources (an open
             # jax.profiler trace, checkpoint writers) even when the run ends
             # before their stop condition or raises

@@ -22,7 +22,7 @@ def _only(findings, rule):
 
 
 def test_registry_has_every_documented_rule():
-    assert {"DL101", "DL102", "DL103", "DL104",
+    assert {"DL101", "DL102", "DL103", "DL104", "DL105",
             "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
@@ -400,3 +400,116 @@ def test_string_literal_cannot_suppress():
         return doc
     '''
     assert [f.rule for f in _lint(src)] == ["DL101"]
+
+
+# ---------------------------------------------------------------------------
+# DL105 — unguarded object-plane call
+# ---------------------------------------------------------------------------
+
+
+def test_dl105_flags_bare_except_around_obj_call():
+    src = """\
+    def pull(comm):
+        try:
+            return comm.recv_obj(src=0)
+        except:
+            return None
+    """
+    (f,) = _only(_lint(src), "DL105")
+    assert f.line == 3
+    assert "JobAbortedError" in f.message
+
+
+def test_dl105_flags_broad_exception_swallow():
+    src = """\
+    def push(comm, payload):
+        try:
+            comm.send_obj(payload, dest=1)
+        except Exception:
+            pass
+    """
+    assert len(_only(_lint(src), "DL105")) == 1
+
+
+def test_dl105_flags_named_jobabortederror_swallow():
+    src = """\
+    from chainermn_tpu.comm.object_plane import JobAbortedError
+
+    def sync(comm, obj):
+        try:
+            return comm.bcast_obj(obj, root=0)
+        except JobAbortedError:
+            return obj
+    """
+    assert len(_only(_lint(src), "DL105")) == 1
+
+
+def test_dl105_flags_runtimeerror_in_tuple():
+    src = """\
+    def f(comm, obj):
+        try:
+            comm.bcast_obj(obj)
+        except (ValueError, RuntimeError):
+            obj = None
+    """
+    assert len(_only(_lint(src), "DL105")) == 1
+
+
+def test_dl105_clean_when_handler_reraises():
+    src = """\
+    def f(comm, obj):
+        try:
+            return comm.bcast_obj(obj)
+        except Exception as e:
+            log(e)
+            raise
+    """
+    assert _only(_lint(src), "DL105") == []
+
+
+def test_dl105_clean_with_narrow_except():
+    src = """\
+    def f(comm, obj):
+        try:
+            return comm.bcast_obj(obj)
+        except ValueError:
+            return None
+    """
+    assert _only(_lint(src), "DL105") == []
+
+
+def test_dl105_clean_without_obj_call_in_try():
+    src = """\
+    def f(comm, obj):
+        try:
+            return transform(obj)
+        except Exception:
+            return None
+    """
+    assert _only(_lint(src), "DL105") == []
+
+
+def test_dl105_nested_function_in_try_is_not_claimed():
+    src = """\
+    def f(comm):
+        try:
+            def later():
+                return comm.recv_obj(src=0)
+            return later
+        except Exception:
+            return None
+    """
+    assert _only(_lint(src), "DL105") == []
+
+
+def test_dl105_suppression_with_rationale():
+    src = """\
+    def probe(comm):
+        try:
+            # best-effort telemetry: a dead peer here is fine, the next
+            # guarded collective raises for real
+            return comm.recv_obj(src=0)  # dlint: disable=DL105
+        except Exception:
+            return None
+    """
+    assert _only(_lint(src), "DL105") == []

@@ -1,0 +1,175 @@
+"""Checkpoint hardening: atomic publish + SHA-256 manifests + the
+election's corrupt-snapshot fallback (single process; the cross-process
+matrix lives in test_multiprocess_chaos.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import chainermn_tpu
+from chainermn_tpu.resilience import chaos
+
+
+@pytest.fixture
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _ck(comm, tmp_path, **kw):
+    kw.setdefault("cp_interval", 5)
+    return chainermn_tpu.create_multi_node_checkpointer(
+        "hard", comm, path=str(tmp_path), **kw)
+
+
+def _state(it):
+    return {"w": jnp.full((8,), float(it), jnp.float32)}
+
+
+def _snap(tmp_path, it, rank=0):
+    return os.path.join(str(tmp_path), "hard", f"snapshot_iter_{it}.{rank}")
+
+
+def test_save_publishes_manifest_with_matching_sha(tmp_path, comm):
+    ck = _ck(comm, tmp_path)
+    fn = ck.save(_state(10), 10)
+    manifest = json.load(open(fn + ".json"))
+    assert manifest["format"] == 1
+    assert manifest["bytes"] == os.path.getsize(fn)
+    import hashlib
+
+    assert manifest["sha256"] == hashlib.sha256(
+        open(fn, "rb").read()).hexdigest()
+    assert not os.path.exists(fn + ".npz")  # tmp name gone after publish
+    assert ck._verify_snapshot_file(fn)
+
+
+def test_corrupt_snapshot_excluded_from_election(tmp_path, comm):
+    ck = _ck(comm, tmp_path)
+    ck.save(_state(10), 10)
+    ck.save(_state(20), 20)
+    # flip bytes in the newest file (what a bad disk would do)
+    fn = _snap(tmp_path, 20)
+    with open(fn, "rb+") as fh:
+        fh.seek(30)
+        fh.write(b"\xff" * 16)
+    assert not ck._verify_snapshot_file(fn)
+    assert ck.latest_common_iteration() == 10  # falls back
+    restored, it = ck.maybe_load(_state(0))
+    assert it == 10
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full((8,), 10.0, np.float32))
+
+
+def test_truncated_snapshot_fails_size_fast_path(tmp_path, comm):
+    ck = _ck(comm, tmp_path)
+    ck.save(_state(10), 10)
+    fn = _snap(tmp_path, 10)
+    with open(fn, "rb+") as fh:
+        fh.truncate(os.path.getsize(fn) // 2)
+    assert not ck._verify_snapshot_file(fn)
+    assert ck.latest_common_iteration() is None
+
+
+def test_explicit_load_of_corrupt_snapshot_raises(tmp_path, comm):
+    ck = _ck(comm, tmp_path)
+    ck.save(_state(10), 10)
+    fn = _snap(tmp_path, 10)
+    with open(fn, "rb+") as fh:
+        fh.seek(0)
+        fh.write(b"\x00" * 8)
+    with pytest.raises(ValueError, match="SHA-256"):
+        ck.maybe_load(_state(0), iteration=10)
+
+
+def test_legacy_snapshot_without_manifest_still_elects(tmp_path, comm):
+    ck = _ck(comm, tmp_path)
+    ck.save(_state(10), 10)
+    os.remove(_snap(tmp_path, 10) + ".json")  # pre-hardening snapshot
+    assert ck._verify_snapshot_file(_snap(tmp_path, 10))
+    assert ck.latest_common_iteration() == 10
+
+
+def test_torn_manifest_marks_snapshot_suspect(tmp_path, comm):
+    ck = _ck(comm, tmp_path)
+    ck.save(_state(10), 10)
+    with open(_snap(tmp_path, 10) + ".json", "w") as fh:
+        fh.write('{"format": 1, "sha')  # torn mid-write
+    assert not ck._verify_snapshot_file(_snap(tmp_path, 10))
+
+
+def test_gc_removes_manifest_with_snapshot(tmp_path, comm):
+    ck = _ck(comm, tmp_path, cp_interval=2)
+    for it in (10, 20, 30):
+        ck.save(_state(it), it)
+    assert not os.path.exists(_snap(tmp_path, 10))
+    assert not os.path.exists(_snap(tmp_path, 10) + ".json")
+    assert os.path.exists(_snap(tmp_path, 30) + ".json")
+
+
+def test_host_state_rides_snapshot_and_sha(tmp_path, comm):
+    ck = _ck(comm, tmp_path)
+    host = {"iteration": 10, "np_random": np.random.get_state(),
+            "note": "host side"}
+    ck.save(_state(10), 10, host_state=host)
+    got = ck.load_host_state(10)
+    assert got["iteration"] == 10
+    assert got["note"] == "host side"
+    assert got["np_random"][0] == host["np_random"][0]
+    np.testing.assert_array_equal(got["np_random"][1],
+                                  host["np_random"][1])
+    # snapshots without host state read back as None
+    ck.save(_state(20), 20)
+    assert ck.load_host_state(20) is None
+
+
+def test_chaos_corrupt_hook_fires_on_publish(tmp_path, comm, monkeypatch):
+    """End-to-end: $CHAINERMN_TPU_CHAOS damages the file right after a
+    fully valid publish, and the manifest proves it."""
+    ck = _ck(comm, tmp_path)
+    ck.save(_state(10), 10)
+    monkeypatch.setenv(chaos.ENV_VAR, "corrupt@match=snapshot_iter_20")
+    ck.save(_state(20), 20)
+    monkeypatch.delenv(chaos.ENV_VAR)
+    assert not ck._verify_snapshot_file(_snap(tmp_path, 20))
+    assert ck.latest_common_iteration() == 10
+
+
+def test_emergency_save_publishes_synchronously(tmp_path, comm):
+    ck = _ck(comm, tmp_path, async_write=True)
+
+    class FakeUpdater:
+        state = _state(7)
+        iteration = 7
+
+        def host_state_dict(self):
+            return {"iteration": 7}
+
+    class FakeTrainer:
+        updater = FakeUpdater()
+
+    fn = ck.emergency_save(FakeTrainer())
+    assert fn and os.path.exists(fn) and os.path.exists(fn + ".json")
+    assert ck._verify_snapshot_file(fn)
+    assert ck.load_host_state(7) == {"iteration": 7}
+    ck.close()
+
+
+def test_emergency_save_respects_expired_deadline(tmp_path, comm):
+    import time
+
+    ck = _ck(comm, tmp_path)
+
+    class FakeUpdater:
+        state = _state(7)
+        iteration = 7
+
+    class FakeTrainer:
+        updater = FakeUpdater()
+
+    assert ck.emergency_save(
+        FakeTrainer(), deadline_s=time.monotonic() - 1) is None
+    assert not os.path.exists(_snap(tmp_path, 7))

@@ -1,0 +1,266 @@
+"""Chaos matrix with REAL jax.distributed processes: the acceptance
+criteria of the resilience layer.
+
+* kill/resume — SIGKILL one rank mid-run (chaos harness, env-injected),
+  restart the job, and the consensus election resumes from the last
+  snapshot BOTH ranks hold, with the resumed loss sequence matching an
+  uninterrupted run exactly (full-state resume: iterator position +
+  shuffle RNG ride the snapshot);
+* corruption fallback — one rank's newest snapshot is damaged right
+  after publish; the SHA-256 manifest catches it and the election falls
+  back to the previous window entry;
+* SIGTERM preemption — both ranks get SIGTERM mid-step; the preemption
+  guard fires an emergency all-rank checkpoint and exits cleanly;
+* watchdog (slow) — a rank dies while its peer waits in an object-plane
+  collective; the heartbeat watchdog converts the infinite wait into a
+  bounded JobAbortedError.
+
+Workers self-inject faults from $CHAINERMN_TPU_CHAOS — the training code
+never knows it is under test."""
+
+import os
+import signal
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mp_harness import assert_all_ok, run_workers
+
+# common prelude: a deterministic host-only training job (no device
+# collectives — every rank computes identical arithmetic from identically
+# seeded iterators, so cross-process device support is not required and
+# loss sequences are exactly comparable)
+_TRAIN_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["CHAINERMN_TPU_CHAOS_RANK"] = str(proc_id)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import numpy as np
+import chainermn_tpu
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.training import StandardUpdater, Trainer
+
+comm = chainermn_tpu.create_communicator("xla")
+TOTAL = 12
+
+def dataset():
+    return [(np.full((2,), float(i), np.float32), np.asarray(i, np.int32))
+            for i in range(40)]
+
+def step(state, x, y):
+    new = state + np.float32(np.asarray(x).mean())
+    return new, {"loss": float(new)}
+
+def make_updater():
+    it = SerialIterator(dataset(), 8, shuffle=True, seed=3)
+    u = StandardUpdater(it, step, np.float32(0.0), comm)
+    u.shard_batch = lambda arrays: arrays
+    return u
+
+def make_ck():
+    return chainermn_tpu.create_multi_node_checkpointer(
+        "chaos", comm, path=os.environ["CKPT_DIR"], cp_interval=5)
+
+# the expected uninterrupted loss sequence, replayed locally
+exp = []
+_s, _it = np.float32(0.0), SerialIterator(dataset(), 8, shuffle=True, seed=3)
+for _ in range(TOTAL):
+    batch = next(_it)
+    _s = _s + np.float32(np.stack([b[0] for b in batch]).mean())
+    exp.append(float(_s))
+
+phase = os.environ["CHAOS_PHASE"]
+"""
+
+
+_KILL_PHASE = _TRAIN_WORKER + r"""
+# phase 1: rank 1 is SIGKILLed at step 7 (chaos env); rank 0 finishes
+ck = make_ck()
+u = make_updater()
+t = Trainer(u, stop_trigger=(TOTAL, "iteration"))
+losses = []
+t.extend(lambda tr: losses.append(tr.updater.last_metrics["loss"]),
+         trigger=(1, "iteration"))
+t.extend(ck, trigger=(3, "iteration"))
+t.run()
+assert proc_id == 0, "rank 1 should have been killed before finishing"
+assert losses == exp, f"rank0 losses diverged: {losses}"
+print(f"WORKER{proc_id} OK", flush=True)
+os._exit(0)
+"""
+
+
+_RESUME_PHASE = _TRAIN_WORKER + r"""
+# phase 2: restart — both ranks elect the last COMMON snapshot (6: rank 1
+# died at 7, so its window holds 3 and 6) and continue to completion
+ck = make_ck()
+u = make_updater()
+elected = ck.resume(u)
+assert elected == 6, f"rank{proc_id}: elected {elected}"
+assert u.iteration == 6
+assert float(u.state) == float(np.float32(exp[5])), (
+    f"rank{proc_id}: resumed state {float(u.state)} != {exp[5]}")
+losses = []
+t = Trainer(u, stop_trigger=(TOTAL, "iteration"))
+t.extend(lambda tr: losses.append(tr.updater.last_metrics["loss"]),
+         trigger=(1, "iteration"))
+t.run()
+assert losses == exp[6:], (
+    f"rank{proc_id}: resumed losses diverged: {losses} vs {exp[6:]}")
+print(f"WORKER{proc_id} OK", flush=True)
+os._exit(0)
+"""
+
+
+@pytest.mark.timeout(240)
+def test_kill_one_rank_then_resume_matches_uninterrupted(tmp_path):
+    ckpt = str(tmp_path / "snaps")
+    # phase 1: chaos kills rank 1 at step 7 (snapshots at 3 and 6 exist)
+    procs, outs = run_workers(
+        _KILL_PHASE, tmp_path, timeout=110,
+        env_extra={"CKPT_DIR": ckpt, "CHAOS_PHASE": "kill",
+                   "CHAINERMN_TPU_CHAOS": "kill@step=7,rank=1"})
+    if any("aren't implemented on the CPU backend" in o for o in outs):
+        pytest.skip("jaxlib CPU backend lacks cross-process computations")
+    assert procs[0].returncode == 0, f"rank0 failed:\n{outs[0][-3000:]}"
+    assert "WORKER0 OK" in outs[0]
+    assert procs[1].returncode == -signal.SIGKILL, (
+        f"rank1 should die by SIGKILL, got {procs[1].returncode}:"
+        f"\n{outs[1][-3000:]}")
+    # rank 1's window stops at 6; rank 0 kept snapshotting to 12
+    assert os.path.exists(os.path.join(ckpt, "chaos", "snapshot_iter_6.1"))
+    assert not os.path.exists(
+        os.path.join(ckpt, "chaos", "snapshot_iter_9.1"))
+
+    # phase 2: restart the job — consensus resume from 6, losses must
+    # match the uninterrupted run exactly
+    procs, outs = run_workers(
+        _RESUME_PHASE, tmp_path, timeout=110,
+        env_extra={"CKPT_DIR": ckpt, "CHAOS_PHASE": "resume"})
+    assert_all_ok(procs, outs)
+
+
+_CORRUPT_PHASE = _TRAIN_WORKER + r"""
+# rank 1's newest snapshot (iter 6) is corrupted right after publish by
+# the chaos harness; the election must fall back to 3
+ck = make_ck()
+u = make_updater()
+t = Trainer(u, stop_trigger=(6, "iteration"))
+t.extend(ck, trigger=(3, "iteration"))
+t.run()
+elected = ck.latest_common_iteration()
+assert elected == 3, f"rank{proc_id}: elected {elected}, wanted 3"
+state, it = ck.maybe_load(np.float32(0.0))
+assert it == 3
+assert float(state) == float(np.float32(exp[2])), float(state)
+print(f"WORKER{proc_id} OK", flush=True)
+os._exit(0)
+"""
+
+
+@pytest.mark.timeout(240)
+def test_corrupt_newest_snapshot_falls_back_to_previous(tmp_path):
+    procs, outs = run_workers(
+        _CORRUPT_PHASE, tmp_path, timeout=110,
+        env_extra={
+            "CKPT_DIR": str(tmp_path / "snaps"),
+            "CHAOS_PHASE": "corrupt",
+            "CHAINERMN_TPU_CHAOS": "corrupt@match=snapshot_iter_6,rank=1",
+        })
+    assert_all_ok(procs, outs)
+
+
+_SIGTERM_PHASE = _TRAIN_WORKER + r"""
+# both ranks get SIGTERM at step 5 (self-injected): the preemption guard
+# fires an emergency checkpoint and the loop exits cleanly
+ck = make_ck()
+u = make_updater()
+t = Trainer(u, stop_trigger=(TOTAL, "iteration"))
+t.extend(ck, trigger=(3, "iteration"))
+t.run()
+assert t.preempted, "SIGTERM did not set trainer.preempted"
+it5 = u.iteration
+assert 5 <= it5 <= 6, it5
+fn = os.path.join(os.environ["CKPT_DIR"], "chaos",
+                  f"snapshot_iter_{it5}.{proc_id}")
+assert os.path.exists(fn), f"no emergency snapshot {fn}"
+assert os.path.exists(fn + ".json"), "no manifest for emergency snapshot"
+assert ck._verify_snapshot_file(fn)
+print(f"WORKER{proc_id} OK", flush=True)
+os._exit(0)
+"""
+
+
+@pytest.mark.timeout(240)
+def test_sigterm_both_ranks_emergency_checkpoint_clean_exit(tmp_path):
+    procs, outs = run_workers(
+        _SIGTERM_PHASE, tmp_path, timeout=110,
+        env_extra={
+            "CKPT_DIR": str(tmp_path / "snaps"),
+            "CHAOS_PHASE": "sigterm",
+            "CHAINERMN_TPU_CHAOS": "kill@step=5,signal=SIGTERM",
+        })
+    assert_all_ok(procs, outs)
+
+
+_WATCHDOG_WORKER = r"""
+import os, sys, time
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import chainermn_tpu
+from chainermn_tpu.comm.object_plane import ObjectPlane, JobAbortedError
+from chainermn_tpu.resilience.watchdog import start_watchdog
+
+op = ObjectPlane()
+wd = start_watchdog(interval_ms=200, timeout_ms=1000)
+assert wd is not None
+assert op.allgather_obj(proc_id) == [0, 1]  # both alive, hearts beating
+
+if proc_id == 1:
+    time.sleep(0.5)
+    os._exit(9)  # simulated SIGKILL: no hook, no goodbye
+
+# survivor: the next collective would wait on the dead peer forever
+# without the watchdog; with it, the wait must become a bounded abort
+t0 = time.monotonic()
+try:
+    op.allgather_obj("after-death")
+    print("WORKER0 COLLECTIVE SUCCEEDED UNEXPECTEDLY", flush=True)
+    os._exit(1)
+except JobAbortedError as e:
+    took = time.monotonic() - t0
+    assert took < 60, f"abort took {took:.1f}s - not bounded enough"
+    print(f"WORKER0 OK abort after {took:.1f}s: {e}", flush=True)
+    os._exit(0)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(240)
+def test_watchdog_converts_dead_peer_into_bounded_abort(tmp_path):
+    procs, outs = run_workers(
+        _WATCHDOG_WORKER, tmp_path, timeout=150,
+        env_extra={"CHAINERMN_TPU_RPC_PROBE_MS": "500"})
+    if any("aren't implemented on the CPU backend" in o for o in outs):
+        pytest.skip("jaxlib CPU backend lacks cross-process computations")
+    assert procs[1].returncode == 9
+    assert procs[0].returncode == 0, f"survivor:\n{outs[0][-3000:]}"
+    assert "WORKER0 OK" in outs[0]

@@ -1,0 +1,70 @@
+"""The abort poison key must be readable WITHOUT blocking on every
+jaxlib client generation — newer ones have ``key_value_try_get``, older
+ones only ``key_value_dir_get`` (which lists children, which is why the
+flag is a child of the abort directory). A probe that cannot see the
+key silently disables the watchdog's whole bounded-abort contract, so
+both read paths are pinned here."""
+
+from chainermn_tpu.comm.object_plane import (
+    _ABORT_FLAG,
+    _ABORT_KEY,
+    _read_abort,
+)
+
+
+class TryGetClient:
+    """Newer client: non-blocking point read, raises on missing key."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_try_get(self, key):
+        if key in self.kv:
+            return self.kv[key]
+        raise KeyError(key)
+
+
+class DirGetClient:
+    """Older client: no try_get; only the directory listing read."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def key_value_dir_get(self, prefix):
+        return sorted(
+            (k, v) for k, v in self.kv.items()
+            if k.startswith(prefix + "/"))
+
+
+def test_flag_is_a_child_of_the_abort_directory():
+    # the property the dir_get fallback depends on
+    assert _ABORT_FLAG.startswith(_ABORT_KEY + "/")
+
+
+def test_try_get_client_reads_abort():
+    client = TryGetClient()
+    assert _read_abort(client) is None
+    client.kv[_ABORT_FLAG] = "peer 1 died"
+    assert _read_abort(client) == "peer 1 died"
+
+
+def test_dir_get_client_reads_abort():
+    client = DirGetClient()
+    assert _read_abort(client) is None
+    client.kv[_ABORT_FLAG] = "peer 1 died"
+    assert _read_abort(client) == "peer 1 died"
+
+
+def test_dir_get_ignores_unrelated_keys():
+    client = DirGetClient()
+    client.kv["og/abortive/other"] = "not an abort"
+    client.kv["og/liveness/seed"] = "1"
+    assert _read_abort(client) is None
+
+
+def test_read_abort_swallows_client_errors():
+    class BrokenClient:
+        def key_value_dir_get(self, prefix):
+            raise RuntimeError("coordinator gone")
+
+    assert _read_abort(BrokenClient()) is None

@@ -1,0 +1,53 @@
+"""tools/chaos.py smoke tests: the tier-1 CI gate for the chaos front
+door (spec validation + catalogue; no training runs launched here)."""
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CLI = os.path.join(_REPO, "tools", "chaos.py")
+
+
+def _run(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, _CLI, *args], env=env, capture_output=True,
+        text=True, timeout=60)
+
+
+def test_dry_run_valid_spec():
+    r = _run("--dry-run", "--spec",
+             "kill@step=3,rank=1,signal=SIGTERM;"
+             "corrupt@match=snapshot_iter_6.1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "2 fault(s)" in r.stdout
+    assert "kill@rank=1" in r.stdout
+
+
+def test_dry_run_rejects_bad_spec():
+    r = _run("--dry-run", "--spec", "kill@rank=1")
+    assert r.returncode == 2
+    assert "bad spec" in r.stderr
+
+
+def test_list_faults_catalogue():
+    r = _run("--list-faults")
+    assert r.returncode == 0
+    for kind in ("kill", "delay_rpc", "blackhole_rpc", "corrupt",
+                 "truncate"):
+        assert kind in r.stdout
+
+
+def test_no_spec_is_usage_error():
+    r = _run("--dry-run")
+    assert r.returncode == 2
+
+
+def test_exec_injects_env():
+    r = _run("--spec", "kill@step=9999", "--",
+             sys.executable, "-c",
+             "import os; print(os.environ['CHAINERMN_TPU_CHAOS'])")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "kill@step=9999" in r.stdout

@@ -1,0 +1,173 @@
+"""Chaos harness unit tests: spec grammar, determinism, and the three
+hook points with injected kill/sleep functions (no process ever actually
+dies here — the mp chaos matrix does that with real workers)."""
+
+import os
+
+import pytest
+
+from chainermn_tpu.resilience import chaos
+
+
+def _plan(spec, **kw):
+    return chaos.ChaosPlan(chaos.parse_spec(spec), **kw)
+
+
+# -- grammar ----------------------------------------------------------------
+
+
+def test_parse_single_kill():
+    (f,) = chaos.parse_spec("kill@step=3,rank=1,signal=SIGTERM")
+    assert (f.kind, f.step, f.rank, f.signal) == ("kill", 3, 1, "SIGTERM")
+
+
+def test_parse_multiple_clauses_and_wildcard_rank():
+    faults = chaos.parse_spec(
+        "kill@step=2,rank=*;delay_rpc@ms=5,op=kv_get,prob=0.5,seed=7")
+    assert [f.kind for f in faults] == ["kill", "delay_rpc"]
+    assert faults[0].rank is None
+    assert faults[1].seed == 7
+
+
+@pytest.mark.parametrize("bad", [
+    "explode@step=1",                 # unknown kind
+    "kill@rank=1",                    # kill without step
+    "corrupt@rank=0",                 # corrupt without match
+    "truncate@",                      # truncate without match
+    "delay_rpc@op=kv_get",            # delay without ms
+    "delay_rpc@ms=5,prob=1.5",        # prob out of range
+    "kill@step",                      # key without value
+    "kill@step=1,bogus=2",            # unknown field
+])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_empty_clauses_skipped():
+    assert chaos.parse_spec(";;") == []
+
+
+# -- kill hook --------------------------------------------------------------
+
+
+def test_kill_fires_at_step_on_matching_rank():
+    killed = []
+    p = _plan("kill@step=3,rank=1", kill_fn=killed.append)
+    for it in range(5):
+        p.on_step(it, rank=1)
+    import signal
+
+    assert killed == [int(signal.SIGKILL)]
+    assert p.faults[0].fired == 1
+
+
+def test_kill_skips_other_ranks():
+    killed = []
+    p = _plan("kill@step=3,rank=1", kill_fn=killed.append)
+    for it in range(5):
+        p.on_step(it, rank=0)
+    assert killed == []
+
+
+def test_kill_wildcard_rank_fires_everywhere():
+    killed = []
+    p = _plan("kill@step=2,signal=SIGTERM", kill_fn=killed.append)
+    p.on_step(2, rank=0)
+    import signal
+
+    assert killed == [int(signal.SIGTERM)]
+
+
+# -- rpc hooks --------------------------------------------------------------
+
+
+def test_delay_rpc_sleeps_matching_op_only():
+    slept = []
+    p = _plan("delay_rpc@ms=250,op=kv_get", sleep_fn=slept.append)
+    p.on_rpc("kv_put", rank=0)
+    assert slept == []
+    p.on_rpc("kv_get", rank=0)
+    assert slept == [0.25]
+
+
+def test_blackhole_defaults_to_an_hour_and_honors_after():
+    slept = []
+    p = _plan("blackhole_rpc@op=kv_get,after=2", sleep_fn=slept.append)
+    p.on_rpc("kv_get", rank=0)   # skipped (after=2)
+    p.on_rpc("kv_get", rank=0)   # skipped
+    assert slept == []
+    p.on_rpc("kv_get", rank=0)   # fires
+    assert slept == [3600.0]
+
+
+def test_probabilistic_fault_replays_with_seed():
+    def run():
+        slept = []
+        p = _plan("delay_rpc@ms=1,prob=0.5,seed=11", sleep_fn=slept.append)
+        for _ in range(32):
+            p.on_rpc("kv_get", rank=0)
+        return len(slept)
+
+    a, b = run(), run()
+    assert a == b          # deterministic schedule
+    assert 0 < a < 32      # and actually probabilistic
+
+
+# -- checkpoint hooks -------------------------------------------------------
+
+
+def test_truncate_halves_file(tmp_path):
+    fn = tmp_path / "snapshot_iter_6.1"
+    fn.write_bytes(b"x" * 1000)
+    p = _plan("truncate@match=snapshot_iter_6.1")
+    p.on_checkpoint(str(fn), rank=1)
+    assert fn.stat().st_size == 500
+
+
+def test_corrupt_flips_bytes_at_offset(tmp_path):
+    fn = tmp_path / "snapshot_iter_6.0"
+    original = bytes(range(200))
+    fn.write_bytes(original)
+    p = _plan("corrupt@match=snapshot_iter_6,offset=10")
+    p.on_checkpoint(str(fn), rank=0)
+    damaged = fn.read_bytes()
+    assert len(damaged) == len(original)
+    assert damaged[:10] == original[:10]
+    assert damaged[10:74] == bytes(b ^ 0xFF for b in original[10:74])
+    assert damaged[74:] == original[74:]
+
+
+def test_checkpoint_fault_skips_non_matching_path(tmp_path):
+    fn = tmp_path / "snapshot_iter_5.0"
+    fn.write_bytes(b"x" * 100)
+    p = _plan("corrupt@match=snapshot_iter_6")
+    p.on_checkpoint(str(fn), rank=0)
+    assert fn.read_bytes() == b"x" * 100
+
+
+# -- env activation ---------------------------------------------------------
+
+
+def test_env_wrappers_noop_when_unset(monkeypatch):
+    monkeypatch.delenv(chaos.ENV_VAR, raising=False)
+    chaos.on_step(0)
+    chaos.on_rpc("kv_get")
+    chaos.on_checkpoint("/nonexistent")
+
+
+def test_chaos_from_env_reparses_on_change(monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR, "kill@step=1")
+    p1 = chaos.chaos_from_env()
+    assert p1 is chaos.chaos_from_env()   # cached
+    monkeypatch.setenv(chaos.ENV_VAR, "kill@step=2")
+    p2 = chaos.chaos_from_env()
+    assert p2 is not p1
+    assert p2.faults[0].step == 2
+    monkeypatch.delenv(chaos.ENV_VAR)
+    assert chaos.chaos_from_env() is None
+
+
+def test_own_rank_prefers_harness_var(monkeypatch):
+    monkeypatch.setenv("CHAINERMN_TPU_CHAOS_RANK", "3")
+    assert chaos._own_rank() == 3

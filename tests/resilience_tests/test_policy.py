@@ -1,0 +1,80 @@
+"""RpcPolicy unit tests: env derivation, the jittered backoff ladder, and
+the derived budgets the object plane consumes."""
+
+import pytest
+
+import importlib
+
+from chainermn_tpu.resilience.policy import RpcPolicy
+
+# the subpackage re-exports the policy() accessor under the same name as
+# this module, shadowing the attribute path `a.b.policy` — resolve the
+# module through the import system instead
+policy_mod = importlib.import_module("chainermn_tpu.resilience.policy")
+
+
+@pytest.fixture(autouse=True)
+def _restore_policy():
+    prev = policy_mod.set_policy(None)
+    yield
+    policy_mod.set_policy(prev)
+
+
+def test_defaults_match_historical_constants():
+    p = RpcPolicy()
+    assert p.timeout_ms == 600_000
+    assert p.probe_ms == 10_000
+    assert p.liveness_ladder_ms() == (2_000, 5_000)
+    assert p.barrier_ms() == 60_000
+
+
+def test_from_env(monkeypatch):
+    monkeypatch.setenv("CHAINERMN_TPU_RPC_TIMEOUT_MS", "30000")
+    monkeypatch.setenv("CHAINERMN_TPU_RPC_PROBE_MS", "500")
+    p = RpcPolicy.from_env()
+    assert p.timeout_ms == 30_000
+    assert p.probe_ms == 500
+    assert p.barrier_ms() == 3_000
+    assert p.liveness_ladder_ms() == (100, 250)
+
+
+@pytest.mark.parametrize("val", ["abc", "-5", "0"])
+def test_from_env_rejects_bad_values(monkeypatch, val):
+    monkeypatch.setenv("CHAINERMN_TPU_RPC_TIMEOUT_MS", val)
+    with pytest.raises(ValueError):
+        RpcPolicy.from_env()
+
+
+def test_backoff_grows_exponentially_and_caps():
+    p = RpcPolicy(jitter=0.0, seed=0)
+    delays = list(p.backoffs_ms(8))
+    assert delays[:4] == [100, 200, 400, 800]
+    assert delays[-1] == 5_000  # capped at backoff_max_ms
+
+
+def test_backoff_jitter_stays_in_band_and_replays_with_seed():
+    p = RpcPolicy(seed=42)
+    a = list(p.backoffs_ms(6))
+    b = list(p.backoffs_ms(6))
+    assert a == b  # seeded: reproducible schedule
+    for k, d in enumerate(a):
+        base = min(100 * 2.0 ** k, 5_000.0)
+        assert base * 0.75 <= d <= base * 1.25
+
+
+def test_put_budget_scales_with_chunks():
+    p = RpcPolicy()
+    assert p.put_budget_ms(1) == 610_000
+    assert p.put_budget_ms(10) == 700_000
+    assert p.put_budget_ms(0) == 610_000  # floor of one chunk
+
+
+def test_process_policy_cached_and_swappable(monkeypatch):
+    monkeypatch.setenv("CHAINERMN_TPU_RPC_TIMEOUT_MS", "12345")
+    assert policy_mod.policy().timeout_ms == 12_345
+    monkeypatch.setenv("CHAINERMN_TPU_RPC_TIMEOUT_MS", "99999")
+    assert policy_mod.policy().timeout_ms == 12_345  # cached
+    prev = policy_mod.set_policy(RpcPolicy(timeout_ms=7))
+    assert policy_mod.policy().timeout_ms == 7
+    policy_mod.set_policy(prev)
+    assert policy_mod.policy().timeout_ms == 12_345

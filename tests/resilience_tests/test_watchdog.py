@@ -1,0 +1,116 @@
+"""Watchdog unit tests with a fake (dict-backed) coordinator client —
+the staleness logic, the versioned-key fallback for clients without
+allow_overwrite, and the check() contract the Trainer loop polls."""
+
+import time
+
+import pytest
+
+from chainermn_tpu.comm.object_plane import JobAbortedError
+from chainermn_tpu.resilience.watchdog import Watchdog
+
+
+class FakeClient:
+    """Duck-types the jax.distributed coordinator KV client."""
+
+    def __init__(self, allow_overwrite_supported=True):
+        self.kv = {}
+        self._ovw = allow_overwrite_supported
+
+    def key_value_set(self, key, value, allow_overwrite=None):
+        if allow_overwrite is not None and not self._ovw:
+            raise TypeError("no allow_overwrite")
+        if not self._ovw and key in self.kv:
+            raise RuntimeError("already set")
+        self.kv[key] = value
+
+    def key_value_try_get(self, key):
+        if key not in self.kv:
+            raise KeyError(key)
+        return self.kv[key]
+
+
+def _wd(client, rank=0, world=2, timeout_ms=80, **kw):
+    dead = []
+    wd = Watchdog(rank, world, client=client, interval_ms=20,
+                  timeout_ms=timeout_ms,
+                  on_dead=lambda p, why: dead.append((p, why)), **kw)
+    return wd, dead
+
+
+def test_live_peer_is_not_declared_dead():
+    client = FakeClient()
+    wd, dead = _wd(client)
+    for beat in range(5):
+        client.kv["og/hb/1"] = str(beat)  # peer advances
+        wd._publish(client)
+        wd._check_peers(client)
+        time.sleep(0.03)
+    assert wd.dead_peer is None and dead == []
+    wd.check()  # no raise
+
+
+def test_stalled_peer_is_declared_dead_and_check_raises():
+    client = FakeClient()
+    wd, dead = _wd(client, timeout_ms=50)
+    client.kv["og/hb/1"] = "7"  # beats once, then stalls
+    wd._check_peers(client)
+    assert wd.dead_peer is None
+    time.sleep(0.12)
+    wd._check_peers(client)
+    assert wd.dead_peer == 1
+    assert dead and dead[0][0] == 1
+    with pytest.raises(JobAbortedError):
+        wd.check()
+
+
+def test_never_published_peer_gets_double_grace():
+    client = FakeClient()
+    wd, dead = _wd(client, timeout_ms=40)
+    wd._check_peers(client)
+    time.sleep(0.05)  # one timeout: still within the 2x startup grace
+    wd._check_peers(client)
+    assert wd.dead_peer is None
+    time.sleep(0.06)  # now past 2 * timeout
+    wd._check_peers(client)
+    assert wd.dead_peer == 1
+    assert "never published" in wd.dead_reason
+
+
+def test_versioned_key_fallback_without_allow_overwrite():
+    client = FakeClient(allow_overwrite_supported=False)
+    wd, dead = _wd(client, timeout_ms=60)
+    wd._publish(client)
+    wd._publish(client)
+    assert "og/hb/0/1" in client.kv and "og/hb/0/2" in client.kv
+    # a peer advancing via versioned keys reads as alive
+    client.kv["og/hb/1/1"] = "1"
+    wd._overwrite_ok = False
+    wd._check_peers(client)
+    assert wd._seen[1][0] == "1"
+    client.kv["og/hb/1/2"] = "1"
+    wd._check_peers(client)
+    assert wd._seen[1][0] == "2"
+    assert wd.dead_peer is None
+
+
+def test_thread_lifecycle_and_stop():
+    client = FakeClient()
+    wd, _ = _wd(client, timeout_ms=10_000)
+    wd.start()
+    assert wd._thread.is_alive()
+    deadline = time.monotonic() + 2.0
+    while "og/hb/0" not in client.kv and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert "og/hb/0" in client.kv, "heartbeat never published"
+    wd.stop()
+    assert wd._thread is None
+
+
+def test_declare_dead_is_latched_to_first_peer():
+    client = FakeClient()
+    wd, dead = _wd(client, world=3, timeout_ms=1)
+    wd._declare_dead(2, "test")
+    wd._declare_dead(1, "test")
+    assert wd.dead_peer == 2
+    assert len(dead) == 1

@@ -1,0 +1,142 @@
+"""Full-state resume: a resumed run continues on the EXACT next batch the
+interrupted run would have drawn — iterator position, shuffling RNG, and
+epoch counters all ride the snapshot (docs/fault_tolerance.md)."""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.training import StandardUpdater, Trainer
+
+
+def _dataset(n=40):
+    # per-sample values make the loss sequence a fingerprint of the exact
+    # batch order: any deviation in position or shuffle shows immediately
+    return [(np.full((2,), float(i), np.float32),
+             np.asarray(i, np.int32)) for i in range(n)]
+
+
+def _step(state, x, y):
+    new = state + np.float32(np.asarray(x).mean())
+    return new, {"loss": float(new)}
+
+
+def _updater(comm, seed=3):
+    it = SerialIterator(_dataset(), 8, shuffle=True, seed=seed)
+    u = StandardUpdater(it, _step, np.float32(0.0), comm)
+    u.shard_batch = lambda arrays: arrays  # host-only arithmetic
+    return u
+
+
+def _run(trainer, losses):
+    trainer.extend(lambda t: losses.append(
+        t.updater.last_metrics["loss"]), trigger=(1, "iteration"))
+    trainer.run()
+
+
+def test_resumed_run_matches_uninterrupted_losses(tmp_path):
+    comm = chainermn_tpu.create_communicator("xla")
+    total, cut = 15, 7
+
+    # reference: one uninterrupted run
+    ref_losses = []
+    _run(Trainer(_updater(comm), stop_trigger=(total, "iteration"),
+                 handle_preemption=False), ref_losses)
+    assert len(ref_losses) == total
+
+    # interrupted run: stops at `cut` with a snapshot (host state included)
+    ck = chainermn_tpu.create_multi_node_checkpointer(
+        "resume", comm, path=str(tmp_path))
+    first_losses = []
+    u1 = _updater(comm)
+    t1 = Trainer(u1, stop_trigger=(cut, "iteration"),
+                 handle_preemption=False)
+    t1.extend(ck, trigger=(cut, "iteration"))
+    _run(t1, first_losses)
+    assert first_losses == ref_losses[:cut]
+
+    # "restart": everything rebuilt from scratch, then consensus resume
+    ck2 = chainermn_tpu.create_multi_node_checkpointer(
+        "resume", comm, path=str(tmp_path))
+    u2 = _updater(comm, seed=999)  # wrong seed: resume must overwrite it
+    it = ck2.resume(u2)
+    assert it == cut
+    assert u2.iteration == cut
+    assert float(u2.state) == pytest.approx(ref_losses[cut - 1])
+
+    second_losses = []
+    _run(Trainer(u2, stop_trigger=(total, "iteration"),
+                 handle_preemption=False), second_losses)
+    assert second_losses == ref_losses[cut:]
+
+
+def test_resume_crosses_epoch_boundary(tmp_path):
+    # cut INSIDE epoch 2 (5 batches/epoch): position and the already-drawn
+    # epoch-2 shuffle must both survive
+    comm = chainermn_tpu.create_communicator("xla")
+    total, cut = 12, 7
+
+    ref = []
+    _run(Trainer(_updater(comm), stop_trigger=(total, "iteration"),
+                 handle_preemption=False), ref)
+
+    ck = chainermn_tpu.create_multi_node_checkpointer(
+        "epochs", comm, path=str(tmp_path))
+    u1 = _updater(comm)
+    t1 = Trainer(u1, stop_trigger=(cut, "iteration"),
+                 handle_preemption=False)
+    t1.extend(ck, trigger=(cut, "iteration"))
+    _run(t1, [])
+    assert u1.iterator.epoch == 1  # mid-epoch 2
+
+    u2 = _updater(comm, seed=0)
+    ck2 = chainermn_tpu.create_multi_node_checkpointer(
+        "epochs", comm, path=str(tmp_path))
+    assert ck2.resume(u2) == cut
+    assert u2.iterator.epoch == 1
+    out = []
+    _run(Trainer(u2, stop_trigger=(total, "iteration"),
+                 handle_preemption=False), out)
+    assert out == ref[cut:]
+
+
+def test_serial_iterator_state_roundtrip():
+    data = _dataset(20)
+    it = SerialIterator(data, 6, shuffle=True, seed=5)
+    for _ in range(4):  # crosses into epoch 2
+        next(it)
+    state = it.state_dict()
+    expect = [next(it) for _ in range(5)]
+
+    it2 = SerialIterator(data, 6, shuffle=True, seed=777)
+    it2.load_state_dict(state)
+    assert it2.epoch == state["epoch"]
+    got = [next(it2) for _ in range(5)]
+    for a, b in zip(expect, got):
+        np.testing.assert_array_equal(
+            np.asarray([s[1] for s in a]), np.asarray([s[1] for s in b]))
+
+
+def test_serial_iterator_rejects_mismatched_dataset():
+    it = SerialIterator(_dataset(20), 4)
+    state = it.state_dict()
+    other = SerialIterator(_dataset(10), 4)
+    with pytest.raises(ValueError, match="dataset"):
+        other.load_state_dict(state)
+
+
+def test_resume_without_host_state_falls_back_to_epoch_forward(tmp_path):
+    # legacy snapshot (no host state): the reference's restart semantics —
+    # iteration and epoch counter restored, position restarts
+    comm = chainermn_tpu.create_communicator("xla")
+    ck = chainermn_tpu.create_multi_node_checkpointer(
+        "legacy", comm, path=str(tmp_path))
+    u1 = _updater(comm)
+    for _ in range(10):
+        u1.update()
+    ck.save(u1.state, u1.iteration)  # NO host_state
+    u2 = _updater(comm)
+    assert ck.resume(u2) == 10
+    assert u2.iteration == 10
+    assert u2.iterator.epoch == 2  # 10 iters * 8 batch / 40 samples
