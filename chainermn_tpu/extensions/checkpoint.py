@@ -32,6 +32,11 @@ import jax.numpy as jnp
 from chainermn_tpu.comm.base import CommunicatorBase
 from chainermn_tpu.resilience import chaos as _chaos
 
+#: subdirectory (under the checkpointer's path) where ring-neighbor
+#: replicas land — written by resilience/replica.py, read by the
+#: election/restore fallbacks below
+REPLICA_DIRNAME = "replicas"
+
 
 def _sha256_file(fn: str) -> str:
     h = hashlib.sha256()
@@ -204,20 +209,31 @@ class _SpliceTargets:
 class _PeerSnapshots:
     """Lazy, cached handles on peer processes' snapshot files for one
     restore — opened only if the local file cannot cover a spliced
-    leaf's ranges, reused across leaves, closed by ``maybe_load``."""
+    leaf's ranges, reused across leaves, closed by ``maybe_load``.
+
+    Ring replicas (``replicas/snapshot_iter_N.R``, pushed by
+    resilience/replica.py) are searched after the primaries: a dead
+    host's shard is recoverable from the copy its neighbor holds, and
+    the splice dedup (``_SpliceTargets._seen``) makes a
+    primary+replica double listing harmless."""
 
     def __init__(self, path: str, it: int, inter_rank: int,
                  inter_size: int):
         # enumerate by GLOB, not by the restoring run's inter_size: the
         # saving run may have had more processes (reshard 2-proc → 1-proc
-        # must still read file .1)
+        # must still read file .1). A strict \.\d+$ match keeps manifest
+        # sidecars (snapshot_iter_N.R.json) and tmp files out.
         import glob as _glob
 
-        self._files = sorted(
-            fn for fn in _glob.glob(os.path.join(
-                path, f"snapshot_iter_{it}.*"))
-            if not fn.endswith(f".{inter_rank}")
-            and not os.path.isdir(fn))  # orbax snapshots are directories
+        pat = re.compile(rf"snapshot_iter_{it}\.(\d+)$")
+        self._files = []
+        for d in (path, os.path.join(path, REPLICA_DIRNAME)):
+            self._files.extend(sorted(
+                fn for fn in _glob.glob(os.path.join(
+                    d, f"snapshot_iter_{it}.*"))
+                if (m := pat.search(os.path.basename(fn)))
+                and int(m.group(1)) != inter_rank
+                and not os.path.isdir(fn)))  # orbax snapshots are dirs
         self._open: dict = {}
 
     def __iter__(self):
@@ -279,6 +295,14 @@ class MultiNodeCheckpointer:
         self._queue: Optional[queue.Queue] = None
         self._writer: Optional[threading.Thread] = None
         self._write_error: Optional[BaseException] = None
+        self.replica_path = os.path.join(self.path, REPLICA_DIRNAME)
+        # iterations GC must never delete: anything a caller pinned via
+        # protect(), plus the last consensus winner (`_elected`, a single
+        # slot REPLACED at each election — the only iteration known
+        # valid on EVERY rank). A GC racing a failed save would
+        # otherwise delete the one file the election can still agree on.
+        self._protected: set = set()
+        self._elected: Optional[int] = None
         # every process writes its own snapshot file and may have its own
         # (non-shared) filesystem — each must create the directory
         os.makedirs(self.path, exist_ok=True)
@@ -406,6 +430,9 @@ class MultiNodeCheckpointer:
         data file whose manifest proves it intact — a torn or corrupted
         file FAILS verification and is excluded from the consensus
         election instead of poisoning the restore."""
+        # chaos harness: pre-publish injection point — a full disk
+        # (enospc) raises HERE with nothing published; slow_disk stalls
+        _chaos.on_publish(fn)
         tmp = fn + ".npz"
         np.savez(tmp, **arrays)
         _fsync_file(tmp)
@@ -493,11 +520,47 @@ class MultiNodeCheckpointer:
                     out.append(int(m.group(1)))
         return sorted(out)
 
+    def protect(self, iteration: int) -> None:
+        """Pin ``iteration`` against the rolling-window GC (idempotent,
+        permanent for this process — e.g. a milestone snapshot).
+
+        The election separately pins its CURRENT winner (a single slot,
+        replaced at each election, so pins don't accumulate across a
+        long run). Protection is per-process state — a restarted
+        process re-derives it from its next election."""
+        self._protected.add(int(iteration))
+
     def _gc(self):
+        """Rolling-window GC, consensus-aware.
+
+        Deletes this rank's snapshots older than the ``cp_interval``
+        window, EXCEPT (1) protected iterations — the last consensus
+        winner, still possibly the only iteration valid on every rank —
+        and (2) the newest iteration whose own file passes integrity
+        verification: when the latest save failed or published a file
+        that doesn't verify (full disk, torn write, chaos), the window
+        would otherwise slide past the last GOOD snapshot and strand the
+        next election with only broken files."""
         import shutil
 
         iters = self._iters_on_disk()
-        for it in iters[:-self.cp_interval]:
+        drop = iters[:-self.cp_interval] if self.cp_interval else iters
+        if not drop:
+            return
+        keep = set(self._protected)
+        if self._elected is not None:
+            keep.add(self._elected)
+        valid = [
+            it for it in iters
+            if self._verify_snapshot_file(os.path.join(
+                self.path,
+                f"snapshot_iter_{it}.{self.comm.inter_rank}"))
+        ]
+        if valid:
+            keep.add(max(valid))
+        for it in drop:
+            if it in keep:
+                continue
             fn = os.path.join(
                 self.path, f"snapshot_iter_{it}.{self.comm.inter_rank}")
             try:
@@ -552,15 +615,56 @@ class MultiNodeCheckpointer:
         cache[key] = ok
         return ok
 
+    def _replica_file(self, it: int, rank: Optional[int] = None) -> str:
+        """Path a ring replica of (iteration, rank) would live at —
+        pushed by a neighbor via resilience/replica.py."""
+        if rank is None:
+            rank = self.comm.inter_rank
+        return os.path.join(self.replica_path, f"snapshot_iter_{it}.{rank}")
+
+    def _own_file(self, it: int) -> Optional[str]:
+        """This rank's readable copy of iteration ``it``: the primary
+        snapshot file when it verifies, else the ring replica a neighbor
+        pushed back (the dead-host recovery path), else None."""
+        primary = os.path.join(
+            self.path, f"snapshot_iter_{it}.{self.comm.inter_rank}")
+        if os.path.isdir(primary):
+            return primary  # orbax: tensorstore checksums itself
+        for fn in (primary, self._replica_file(it)):
+            if (os.path.exists(fn) and not os.path.isdir(fn)
+                    and self._verify_snapshot_file(fn)):
+                return fn
+        return None
+
+    def _replica_iters_on_disk(self) -> List[int]:
+        """Iterations for which a VALID replica of THIS rank's shard sits
+        in the replica directory (written by a ring neighbor; on a shared
+        filesystem, or restored to this host out of band)."""
+        pat = re.compile(
+            rf"snapshot_iter_(\d+)\.{self.comm.inter_rank}$")
+        out = []
+        if os.path.isdir(self.replica_path):
+            for f in os.listdir(self.replica_path):
+                m = pat.match(f)
+                if m and self._verify_snapshot_file(
+                        os.path.join(self.replica_path, f)):
+                    out.append(int(m.group(1)))
+        return sorted(out)
+
     def _valid_iters_on_disk(self) -> List[int]:
         """This rank's iterations whose snapshot files pass integrity
-        verification — the election's own-file inventory."""
-        return [
+        verification — the election's own-file inventory. Ring replicas
+        of this rank's shard count too: a restarted rank whose local
+        disk is gone still votes for the iterations its neighbor
+        preserved, so the election can land on the NEWEST iteration
+        instead of falling back to an older common one."""
+        own = [
             it for it in self._iters_on_disk()
             if self._verify_snapshot_file(os.path.join(
                 self.path,
                 f"snapshot_iter_{it}.{self.comm.inter_rank}"))
         ]
+        return sorted(set(own) | set(self._replica_iters_on_disk()))
 
     # -- trainer integration --------------------------------------------
 
@@ -607,11 +711,11 @@ class MultiNodeCheckpointer:
 
     def load_host_state(self, iteration: int) -> Any:
         """The pickled host state stored with this rank's snapshot for
-        ``iteration`` (None when the snapshot predates host state or the
-        file is not this rank's to read)."""
-        fn = os.path.join(
-            self.path, f"snapshot_iter_{iteration}.{self.comm.inter_rank}")
-        if not os.path.exists(fn) or os.path.isdir(fn):
+        ``iteration`` — primary file or ring replica (None when the
+        snapshot predates host state or the file is not this rank's to
+        read)."""
+        fn = self._own_file(iteration)
+        if fn is None or os.path.isdir(fn):
             return None
         with np.load(fn, allow_pickle=False) as z:
             if "__host_state__" not in z.files:
@@ -662,16 +766,21 @@ class MultiNodeCheckpointer:
         resharding). Snapshots without the marker (orbax directories,
         pre-marker files) fall back to rank-suffix contiguity."""
         by_iter: dict = {}
-        if os.path.isdir(self.path):
-            for f in os.listdir(self.path):
+        for d in (self.path, self.replica_path):
+            if not os.path.isdir(d):
+                continue
+            for f in os.listdir(d):
                 m = re.match(r"snapshot_iter_(\d+)\.(\d+)$", f)
                 # regular files only: orbax snapshots are DIRECTORIES a
                 # peer process cannot np.load, so scale-up (which loads
                 # every leaf from peer files) stays npz-territory — an
-                # orbax new-rank simply never elects, gracefully
-                if (m and not os.path.isdir(os.path.join(self.path, f))
+                # orbax new-rank simply never elects, gracefully.
+                # Replicas count toward completeness: a dead rank's
+                # shard held by its ring neighbor still makes the set
+                # loadable (the splice path reads the replica).
+                if (m and not os.path.isdir(os.path.join(d, f))
                         and self._verify_snapshot_file(
-                            os.path.join(self.path, f))):
+                            os.path.join(d, f))):
                     by_iter.setdefault(int(m.group(1)), set()).add(
                         int(m.group(2)))
         out = []
@@ -684,17 +793,26 @@ class MultiNodeCheckpointer:
         return sorted(out)
 
     def _saved_world(self, it: int) -> Optional[int]:
-        """The saving run's process count, from any file of iteration
-        ``it`` (None when unknowable: orbax directory or no marker)."""
-        fn = os.path.join(self.path, f"snapshot_iter_{it}.0")
-        if not os.path.exists(fn) or os.path.isdir(fn):
-            return None
-        try:
-            with np.load(fn, allow_pickle=False) as z:
-                if "__world__" in z.files:
-                    return int(z["__world__"])
-        except Exception:  # noqa: BLE001 — unreadable file = unknown
-            return None
+        """The saving run's process count, from ANY surviving file of
+        iteration ``it`` — primary or replica, any rank: when rank 0's
+        file is the one that died with its host, the marker must still
+        be readable (None when unknowable: orbax directory, no marker,
+        or no file at all)."""
+        import glob as _glob
+
+        pat = re.compile(rf"snapshot_iter_{it}\.\d+$")
+        for d in (self.path, self.replica_path):
+            for fn in sorted(_glob.glob(
+                    os.path.join(d, f"snapshot_iter_{it}.*"))):
+                if (not pat.search(os.path.basename(fn))
+                        or os.path.isdir(fn)):
+                    continue
+                try:
+                    with np.load(fn, allow_pickle=False) as z:
+                        if "__world__" in z.files:
+                            return int(z["__world__"])
+                except Exception:  # noqa: BLE001 — unreadable = skip
+                    continue
         return None
 
     def latest_common_iteration(self) -> Optional[int]:
@@ -722,9 +840,17 @@ class MultiNodeCheckpointer:
         common = set(all_lists[0])
         for lst in all_lists[1:]:
             common &= set(lst)
-        return max(common) if common else None
+        if common:
+            # pin the winner against the rolling-window GC: until the
+            # NEXT election it may be the only iteration every rank
+            # still agrees on, and a GC racing a failed save must not
+            # delete it out from under a retry of the restore
+            self._elected = max(common)
+            return max(common)
+        return None
 
-    def maybe_load(self, state: Any, iteration: Optional[int] = None):
+    def maybe_load(self, state: Any, iteration: Optional[int] = None,
+                   allow_incomplete: bool = False):
         """Restore ``state`` from the newest complete snapshot (or the given
         iteration). Returns (state, iteration) — unchanged state and None if
         nothing restorable exists.
@@ -734,11 +860,21 @@ class MultiNodeCheckpointer:
         discovered by glob) and onto MORE (a rank with no own snapshot
         file loads every leaf from the peers' files). Cross-process
         resharding is npz-backend territory; orbax snapshots reshard
-        within one process's file set."""
+        within one process's file set.
+
+        ``allow_incomplete=True`` is the elastic shrink-to-fit escape
+        hatch (resilience/elastic.py): bypass the complete-file-set gate
+        when this rank has no own file, and let the splice-level
+        completeness check (``_SpliceTargets.require_complete``) decide —
+        for fully-replicated leaves any one surviving file holds the
+        whole state, so a dead rank's missing file need not block the
+        resume. Leave it False everywhere else: the gate is what keeps a
+        scale-up from silently loading wrong state."""
         self._drain()
         it = iteration if iteration is not None else self.latest_common_iteration()
         if it is None:
             return state, None
+        self._elected = it
         fn = os.path.join(
             self.path, f"snapshot_iter_{it}.{self.comm.inter_rank}"
         )
@@ -749,22 +885,26 @@ class MultiNodeCheckpointer:
                     "onto more processes than saved is npz-backend only")
             loaded = self._orbax_ck().restore(
                 os.path.abspath(fn), _leaf_dict(state))
+        elif self._own_file(it) is not None:
+            # primary when it verifies, else the ring replica a neighbor
+            # pushed back — the restarted-host recovery path
+            loaded = np.load(self._own_file(it), allow_pickle=False)
         elif os.path.exists(fn):
-            if not self._verify_snapshot_file(fn):
-                raise ValueError(
-                    f"{fn}: snapshot file fails SHA-256 verification "
-                    "against its manifest (torn write or corruption) — "
-                    "refusing to load; the consensus election excludes "
-                    "such files, so pass no explicit iteration to fall "
-                    "back to the newest intact snapshot")
-            loaded = np.load(fn, allow_pickle=False)
+            raise ValueError(
+                f"{fn}: snapshot file fails SHA-256 verification "
+                "against its manifest (torn write or corruption) — "
+                "refusing to load; the consensus election excludes "
+                "such files, so pass no explicit iteration to fall "
+                "back to the newest intact snapshot")
         else:
             # scale-up: this rank did not exist in the saving run — every
             # leaf comes from the peers' files. Only COMPLETE snapshots
             # qualify: a file set short of its saved world means a rank's
             # file is missing, not a smaller saving run, and loading a
-            # peer's copy would silently hand this rank wrong state.
-            if it not in self._complete_iters_on_disk():
+            # peer's copy would silently hand this rank wrong state
+            # (unless the elastic caller explicitly opted in, above).
+            if (not allow_incomplete
+                    and it not in self._complete_iters_on_disk()):
                 raise FileNotFoundError(
                     f"{fn}: no snapshot file for this rank and iteration "
                     f"{it} is not a complete smaller-world snapshot")

@@ -100,6 +100,20 @@ class SerialIterator:
         self._order = order
         self._rng.set_state(state["rng"])
 
+    def set_position(self, at: int, epoch: int = 0) -> None:
+        """Jump to sample offset ``at`` within ``epoch``, with a freshly
+        shuffled order — the elastic shrink-to-fit rebalance
+        (resilience/elastic.py): after resharding onto a different world
+        size the saved per-shard position no longer maps 1:1, so the
+        resumed run continues APPROXIMATELY (epoch counters and overall
+        progress preserved; the exact next batch is not — unlike
+        :meth:`load_state_dict`, which is exact but shape-preserving)."""
+        n = len(self.dataset)
+        self.epoch = int(epoch)
+        self.is_new_epoch = False
+        self._at = int(at) % n if n else 0
+        self._order = self._new_order()
+
 
 def create_multi_node_iterator(actual_iterator, communicator: CommunicatorBase,
                                rank_master: int = 0):

@@ -14,7 +14,14 @@ that injects them so tests can prove it:
 * :mod:`.watchdog` — per-process heartbeat thread that converts a dead
   peer's infinite collective hang into a bounded ``JobAbortedError``;
 * :mod:`.policy` — the one RPC timeout/backoff policy the host plane's
-  retry logic derives from (``$CHAINERMN_TPU_RPC_TIMEOUT_MS``).
+  retry logic derives from (``$CHAINERMN_TPU_RPC_TIMEOUT_MS``);
+* :mod:`.supervisor` — per-host restart loop with a bounded crash
+  budget (``tools/supervise.py`` is the CLI): crashes heal by
+  relaunch, crash-loops stop with a diagnostic;
+* :mod:`.replica` — ring replication of each rank's newest verified
+  snapshot to its neighbor, so a dead host's shard survives;
+* :mod:`.elastic` — shrink-to-fit resume: when a host is permanently
+  gone, re-splice the surviving shards onto the smaller world.
 
 See docs/fault_tolerance.md for the failure-mode table and cookbook.
 """
@@ -26,7 +33,24 @@ from chainermn_tpu.resilience.chaos import (
     chaos_from_env,
     parse_spec,
 )
+from chainermn_tpu.resilience.elastic import (
+    ElasticPlan,
+    ElasticResumeError,
+    ElasticTopologyError,
+    elastic_resume,
+    plan_elastic_resume,
+)
 from chainermn_tpu.resilience.policy import RpcPolicy, policy, set_policy
+from chainermn_tpu.resilience.replica import PeerReplicator
+from chainermn_tpu.resilience.supervisor import (
+    ABORTED_EXIT_CODE,
+    BUDGET_EXHAUSTED_EXIT_CODE,
+    RESTART_COUNT_ENV,
+    RestartBudget,
+    Supervisor,
+    classify_exit,
+    main_exit_code,
+)
 from chainermn_tpu.resilience.preemption import (
     PREEMPTED_EXIT_CODE,
     PreemptionGuard,
@@ -47,9 +71,22 @@ __all__ = [
     "FAULT_KINDS",
     "chaos_from_env",
     "parse_spec",
+    "ElasticPlan",
+    "ElasticResumeError",
+    "ElasticTopologyError",
+    "elastic_resume",
+    "plan_elastic_resume",
     "RpcPolicy",
     "policy",
     "set_policy",
+    "PeerReplicator",
+    "ABORTED_EXIT_CODE",
+    "BUDGET_EXHAUSTED_EXIT_CODE",
+    "RESTART_COUNT_ENV",
+    "RestartBudget",
+    "Supervisor",
+    "classify_exit",
+    "main_exit_code",
     "PREEMPTED_EXIT_CODE",
     "PreemptionGuard",
     "install_preemption_handler",

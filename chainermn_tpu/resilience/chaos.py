@@ -13,7 +13,19 @@ deterministic schedule, so the suite can prove the stack survives them:
 * ``blackhole_rpc`` — stall matching RPCs for a long, configurable time
   (a wedged coordinator link; the guard probes bound the damage);
 * ``corrupt`` / ``truncate`` — damage a named checkpoint file right
-  after it is published (a torn write / bad disk).
+  after it is published (a torn write / bad disk);
+* ``enospc`` — fail a matching snapshot publish with ``OSError(ENOSPC)``
+  before any byte is written (a full disk — the save raises, nothing is
+  published, the election must fall back);
+* ``slow_disk`` — sleep before a matching snapshot publish (a
+  overloaded/slow disk stretching the write window).
+
+Faults can be pinned to one supervised incarnation with ``run=K``: the
+supervisor (:mod:`chainermn_tpu.resilience.supervisor`) exports
+``$CHAINERMN_TPU_RESTART_COUNT`` to each child, and a fault carrying
+``run=K`` fires only when that counter equals ``K`` — so "kill at step 7,
+first run only" heals on restart, while the same fault *without* ``run=``
+reproduces a crash loop that must trip the restart budget.
 
 Activation is by environment variable so `tests/mp_harness.py` worker
 processes self-inject without any code path knowing about the test:
@@ -32,11 +44,14 @@ Hook points (all no-ops when the env var is unset):
 * :func:`on_rpc` — called by ``comm/object_plane.py`` before each
   coordinator RPC (ops: ``kv_get``, ``kv_put``, ``barrier``);
 * :func:`on_checkpoint` — called by the checkpointer after publishing a
-  snapshot file, with its path.
+  snapshot file, with its path;
+* :func:`on_publish` — called by the checkpointer right BEFORE writing a
+  snapshot file (fires ``enospc``/``slow_disk``).
 """
 
 from __future__ import annotations
 
+import errno
 import os
 import random
 import signal as _signal
@@ -60,9 +75,15 @@ FAULT_KINDS: Dict[str, str] = {
                 "match=SUBSTRING[,rank=R|*][,offset=O]"),
     "truncate": ("truncate a checkpoint file right after publish: "
                  "match=SUBSTRING[,rank=R|*][,keep=BYTES (default half)]"),
+    "enospc": ("fail a matching snapshot publish with OSError(ENOSPC): "
+               "match=SUBSTRING[,rank=R|*][,after=K][,prob=P][,seed=S]"),
+    "slow_disk": ("sleep before a matching snapshot publish: "
+                  "ms=M,match=SUBSTRING[,rank=R|*][,prob=P][,seed=S]"),
 }
 
-_INT_KEYS = {"step", "ms", "offset", "keep", "after", "seed"}
+#: every fault kind also accepts ``run=K`` — fire only in supervised
+#: incarnation K ($CHAINERMN_TPU_RESTART_COUNT, 0 when unsupervised)
+_INT_KEYS = {"step", "ms", "offset", "keep", "after", "seed", "run"}
 _FLOAT_KEYS = {"prob"}
 
 
@@ -80,6 +101,7 @@ class Fault:
     offset: int = 0
     keep: Optional[int] = None
     after: int = 0
+    run: Optional[int] = None           # None = every incarnation
     fired: int = field(default=0, repr=False)
     _rng: Optional[random.Random] = field(default=None, repr=False)
     _skipped: int = field(default=0, repr=False)
@@ -92,6 +114,13 @@ class Fault:
     def applies_to_rank(self, rank: Optional[int]) -> bool:
         return self.rank is None or rank is None or self.rank == rank
 
+    def applies_to_run(self) -> bool:
+        """Supervised-incarnation match: the supervisor exports the
+        restart counter; unsupervised processes count as incarnation 0."""
+        if self.run is None:
+            return True
+        return _own_run() == self.run
+
     def roll(self) -> bool:
         if self.prob >= 1.0:
             return True
@@ -102,7 +131,7 @@ class Fault:
         --dry-run listing)."""
         parts = []
         for name in ("step", "signal", "op", "ms", "prob", "seed",
-                     "match", "offset", "keep", "after"):
+                     "match", "offset", "keep", "after", "run"):
             val = getattr(self, name)
             if val is None:
                 continue
@@ -154,15 +183,29 @@ def parse_spec(spec: str) -> List[Fault]:
                 f"bad field in chaos clause {clause!r}: {e}") from e
         if fault.kind == "kill" and fault.step is None:
             raise ValueError(f"kill fault needs step=N: {clause!r}")
-        if fault.kind in ("corrupt", "truncate") and not fault.match:
+        if (fault.kind in ("corrupt", "truncate", "enospc", "slow_disk")
+                and not fault.match):
             raise ValueError(
                 f"{fault.kind} fault needs match=SUBSTRING: {clause!r}")
-        if fault.kind == "delay_rpc" and fault.ms is None:
-            raise ValueError(f"delay_rpc fault needs ms=M: {clause!r}")
+        if fault.kind in ("delay_rpc", "slow_disk") and fault.ms is None:
+            raise ValueError(f"{fault.kind} fault needs ms=M: {clause!r}")
         if not (0.0 <= fault.prob <= 1.0):
             raise ValueError(f"prob must be in [0, 1]: {clause!r}")
         faults.append(fault)
     return faults
+
+
+def _own_run() -> int:
+    """This process's supervised-incarnation number: 0 on the first
+    launch (or unsupervised), incremented by the supervisor per restart
+    (resilience/supervisor.py exports $CHAINERMN_TPU_RESTART_COUNT)."""
+    raw = os.environ.get("CHAINERMN_TPU_RESTART_COUNT")
+    if raw is not None:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return 0
 
 
 def _own_rank() -> Optional[int]:
@@ -209,7 +252,7 @@ class ChaosPlan:
         for f in self.faults:
             if f.kind != "kill" or f.step != iteration:
                 continue
-            if not f.applies_to_rank(rank):
+            if not f.applies_to_rank(rank) or not f.applies_to_run():
                 continue
             signum = getattr(_signal, f.signal, None)
             if signum is None:
@@ -225,7 +268,7 @@ class ChaosPlan:
                 continue
             if f.op is not None and f.op != "*" and f.op != op:
                 continue
-            if not f.applies_to_rank(rank):
+            if not f.applies_to_rank(rank) or not f.applies_to_run():
                 continue
             if f._skipped < f.after:
                 f._skipped += 1
@@ -245,7 +288,7 @@ class ChaosPlan:
         for f in self.faults:
             if f.kind not in ("corrupt", "truncate"):
                 continue
-            if not f.applies_to_rank(rank):
+            if not f.applies_to_rank(rank) or not f.applies_to_run():
                 continue
             if f.match not in path and f.match not in base:
                 continue
@@ -264,6 +307,35 @@ class ChaosPlan:
                     chunk = fh.read(64) or b"\0"
                     fh.seek(f.offset)
                     fh.write(bytes(b ^ 0xFF for b in chunk))
+
+    def on_publish(self, path: str,
+                   rank: Optional[int] = None) -> None:
+        """Pre-publish hook (the checkpointer calls it before any byte of
+        a snapshot is written): ``slow_disk`` sleeps, ``enospc`` raises
+        ``OSError(ENOSPC)`` so the save fails with nothing published —
+        the election falls back, exactly like a full disk."""
+        rank = _own_rank() if rank is None else rank
+        base = os.path.basename(path)
+        for f in self.faults:
+            if f.kind not in ("enospc", "slow_disk"):
+                continue
+            if not f.applies_to_rank(rank) or not f.applies_to_run():
+                continue
+            if f.match not in path and f.match not in base:
+                continue
+            if f._skipped < f.after:
+                f._skipped += 1
+                continue
+            if not f.roll():
+                continue
+            f.fired += 1
+            self.log.append(f"{f.kind} path={base}")
+            if f.kind == "slow_disk":
+                self._sleep((f.ms or 0) / 1000.0)
+            else:
+                raise OSError(
+                    errno.ENOSPC,
+                    f"No space left on device (chaos enospc: {base})")
 
 
 _plan: Optional[ChaosPlan] = None
@@ -305,3 +377,10 @@ def on_checkpoint(path: str) -> None:
         plan = chaos_from_env()
         if plan is not None:
             plan.on_checkpoint(path)
+
+
+def on_publish(path: str) -> None:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            plan.on_publish(path)

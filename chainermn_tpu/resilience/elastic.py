@@ -1,0 +1,222 @@
+"""Shrink-to-fit resume: continue on a smaller mesh when a host is
+permanently gone.
+
+The consensus election and restart loop assume the SAME world size
+comes back; when a host (and its disk) is gone for good, that never
+happens — yet the surviving ranks still hold (or ring-hold, see
+resilience/replica.py) every byte of state needed to continue. This
+module plans and executes that continuation:
+
+1. :func:`plan_elastic_resume` — elect the newest iteration the
+   CURRENT (smaller) world can recover, compare against the saved
+   world size recorded in each snapshot (``__world__``), and decide:
+   ``resume`` (same world — the normal path), ``shrink`` (fewer
+   processes than saved — re-splice), or ``give_up`` (nothing
+   recoverable) — the decision table in
+   docs/fault_tolerance.md#elastic-recovery.
+2. :func:`elastic_resume` — execute the plan: load the device pytree
+   through the checkpointer's splice path (``allow_incomplete=True``
+   bypasses the complete-file-set gate; the per-leaf coverage check in
+   ``_SpliceTargets.require_complete`` still rejects a genuinely
+   missing shard), then rebalance the HOST side — re-scatter the
+   dataset over the surviving processes and reposition the iterator.
+
+What shrinking preserves and what it does not:
+
+* device state — exact (replicated leaves load from any file; sharded
+  leaves are spliced from all surviving files, and a shard nobody
+  holds fails loudly);
+* overall progress (iteration count, epoch counters) — exact;
+* the data order — approximate: per-rank shards are re-split for the
+  new world, so the resumed run draws from a freshly balanced shard at
+  the equivalent position instead of replaying the exact batch
+  schedule of the dead configuration;
+* loss/grad averaging — automatic for steps built on
+  ``allreduce_grad(op="mean")`` against the CURRENT communicator
+  (they divide by the live world size); steps that baked the OLD
+  world size into a constant must multiply by
+  :attr:`ElasticPlan.averaging_rescale`.
+
+Topology guard: only a single (data-parallel) mesh axis is supported.
+Tensor/pipeline-parallel shards are rank-position-dependent — dropping
+a rank re-maps which parameters live where, and re-splicing them onto
+a smaller axis would produce silently wrong math; those topologies
+raise :class:`ElasticTopologyError` instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from chainermn_tpu.datasets import scatter_dataset
+
+
+class ElasticResumeError(RuntimeError):
+    """Elastic resume cannot proceed (nothing recoverable)."""
+
+
+class ElasticTopologyError(ElasticResumeError):
+    """The mesh topology does not support elastic resharding."""
+
+
+@dataclass
+class ElasticPlan:
+    """The decision :func:`plan_elastic_resume` reached.
+
+    ``action`` is ``resume`` / ``shrink`` / ``give_up``;
+    ``averaging_rescale`` is ``saved_world / new_world`` — multiply
+    into any loss/grad normalization that baked in the OLD world size
+    (steps averaging through the live communicator need no fix)."""
+
+    action: str
+    iteration: Optional[int]
+    saved_world: Optional[int]
+    new_world: int
+    reason: str
+    averaging_rescale: float = 1.0
+
+    def describe(self) -> str:
+        return (f"elastic plan: {self.action} at iteration "
+                f"{self.iteration} (saved world {self.saved_world}, "
+                f"current {self.new_world}) — {self.reason}")
+
+
+def _check_topology(comm) -> None:
+    axes = tuple(getattr(comm, "axis_names", ()) or ())
+    if len(axes) > 1:
+        raise ElasticTopologyError(
+            f"shrink-to-fit supports a single data-parallel mesh axis; "
+            f"this communicator spans axes {axes}. Tensor/pipeline "
+            "shards are rank-position-dependent — re-splicing them onto "
+            "a different world size would be silently wrong math, so "
+            "elastic resume refuses. Restore at the original world "
+            "size, or re-partition from a converted full checkpoint.")
+
+
+def _recoverable_iters(ck) -> List[int]:
+    """Iterations THIS rank can contribute to a shrunken election: any
+    iteration with at least one valid file visible on this filesystem
+    (own primary, a peer's primary on shared storage, or a ring
+    replica). Per-leaf completeness is checked at load time — this is
+    the cheap inventory, not the guarantee."""
+    import os
+    import re
+
+    seen = set(ck._valid_iters_on_disk())
+    pat = re.compile(r"snapshot_iter_(\d+)\.(\d+)$")
+    for d in (ck.path, ck.replica_path):
+        if not os.path.isdir(d):
+            continue
+        for f in os.listdir(d):
+            m = pat.match(f)
+            if not m or int(m.group(1)) in seen:
+                continue
+            fn = os.path.join(d, f)
+            if not os.path.isdir(fn) and ck._verify_snapshot_file(fn):
+                seen.add(int(m.group(1)))
+    return sorted(seen)
+
+
+def plan_elastic_resume(ck) -> ElasticPlan:
+    """Elect over the CURRENT world and classify the resume.
+
+    Collective: every surviving process must call it (the inventory is
+    allgathered). Raises :class:`ElasticTopologyError` on unsupported
+    meshes; never raises for "nothing found" — that returns a
+    ``give_up`` plan so the caller can report and exit."""
+    comm = ck.comm
+    _check_topology(comm)
+    world = comm.inter_size
+    ck._drain()
+    ck._pre_election_barrier()
+    mine = _recoverable_iters(ck)
+    all_lists = comm.allgather_obj(mine)
+    common = set(all_lists[0])
+    for lst in all_lists[1:]:
+        common &= set(lst)
+    if not common:
+        return ElasticPlan(
+            action="give_up", iteration=None, saved_world=None,
+            new_world=world,
+            reason="no snapshot iteration is recoverable on every "
+                   "surviving process — nothing to resume from "
+                   f"(per-rank inventories: {all_lists})")
+    it = max(common)
+    ck._elected = it  # pin against GC, same as the strict election
+    saved = ck._saved_world(it)
+    if saved is None or saved == world:
+        return ElasticPlan(
+            action="resume", iteration=it, saved_world=saved,
+            new_world=world,
+            reason="saved world matches the current world"
+                   if saved == world else
+                   "saved world unknown (pre-marker snapshot) — "
+                   "assuming shape-preserving resume")
+    rescale = saved / world
+    return ElasticPlan(
+        action="shrink", iteration=it, saved_world=saved,
+        new_world=world, averaging_rescale=rescale,
+        reason=f"snapshot was saved by {saved} process(es), "
+               f"{world} survive — re-splicing shards onto the "
+               "smaller mesh")
+
+
+def elastic_resume(ck, updater, global_dataset: Any = None,
+                   shuffle: bool = False,
+                   seed: Optional[int] = None) -> ElasticPlan:
+    """Plan + execute: restore ``updater`` at the newest recoverable
+    iteration on the current world size, rebalancing the host side.
+
+    ``global_dataset`` is the FULL dataset (the thing originally passed
+    to ``scatter_dataset``); when given, it is re-scattered over the
+    surviving processes and installed as the iterator's dataset —
+    without it, the iterator keeps its existing (old-world) shard and
+    only the position is rebalanced. Returns the executed
+    :class:`ElasticPlan`; raises :class:`ElasticResumeError` on a
+    ``give_up`` plan."""
+    plan = plan_elastic_resume(ck)
+    if plan.action == "give_up":
+        raise ElasticResumeError(plan.describe())
+    state, it = ck.maybe_load(updater.state, iteration=plan.iteration,
+                              allow_incomplete=(plan.action == "shrink"))
+    updater.state = state
+    updater.iteration = it
+    if plan.action == "resume":
+        # the normal shape-preserving path: exact host-state restore
+        host = ck.load_host_state(it)
+        restore = getattr(updater, "load_host_state", None)
+        if host is not None and callable(restore):
+            restore(host)
+        return plan
+    _rebalance_host(ck, updater, plan, global_dataset, shuffle, seed)
+    return plan
+
+
+def _rebalance_host(ck, updater, plan: ElasticPlan, global_dataset,
+                    shuffle, seed) -> None:
+    """Shrink path: new data shard + approximate iterator position.
+
+    The np RNG from the host state is restored when available (augment
+    pipelines keep their stream); the iterator position is recomputed —
+    the saved one indexes a shard that no longer exists."""
+    host = ck.load_host_state(plan.iteration)
+    if host is not None and host.get("np_random") is not None:
+        import numpy as np
+
+        np.random.set_state(host["np_random"])
+    iterator = getattr(updater, "iterator", None)
+    if iterator is None:
+        return
+    if global_dataset is not None:
+        iterator.dataset = scatter_dataset(
+            global_dataset, ck.comm, shuffle=shuffle, seed=seed)
+    n = len(getattr(iterator, "dataset", ()) or ())
+    bs = getattr(iterator, "batch_size", None)
+    if not n or not bs:
+        return
+    consumed = plan.iteration * bs  # per-rank samples drawn so far
+    if hasattr(iterator, "set_position"):
+        iterator.set_position(consumed % n, consumed // n)
+    elif hasattr(iterator, "epoch"):
+        iterator.epoch = consumed // n

@@ -212,6 +212,18 @@ class Trainer:
                     traceback.print_exc()
         return fired
 
+    def exit_code(self) -> int:
+        """Process exit status under the supervisor contract
+        (resilience/supervisor.py): :data:`PREEMPTED_EXIT_CODE` (143)
+        after a preempted run — the supervisor restarts it for free —
+        else 0. Train scripts: ``sys.exit(trainer.exit_code())``, or
+        wrap the whole main in
+        :func:`chainermn_tpu.resilience.supervisor.main_exit_code`
+        (which also maps ``JobAbortedError`` to the aborted code)."""
+        from chainermn_tpu.resilience.preemption import PREEMPTED_EXIT_CODE
+
+        return PREEMPTED_EXIT_CODE if self.preempted else 0
+
     def run(self):
         if any(e.closed for e in self._extensions):
             # a prior run() finalized extensions holding external

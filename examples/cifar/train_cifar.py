@@ -103,11 +103,16 @@ def main():
              "elapsed_time"]), trigger=(1, "epoch"))
 
     trainer.run()
-    if comm.is_master:
+    # preempted runs have no final observation — and must not crash
+    # here, or exit 143 never reaches the supervisor
+    if comm.is_master and not trainer.preempted:
         print(f"final: loss={trainer.observation['main/loss']:.4f} "
               f"acc={trainer.observation['main/accuracy']:.4f}")
     return trainer
 
 
 if __name__ == "__main__":
-    main()
+    # supervisor exit-status contract (docs/fault_tolerance.md):
+    # 0 clean, 143 preempted-and-checkpointed, 75 watchdog abort
+    from chainermn_tpu.resilience.supervisor import main_exit_code
+    sys.exit(main_exit_code(main))

@@ -124,7 +124,9 @@ def main():
              "elapsed_time"]), trigger=(1, "epoch"))
 
     trainer.run()
-    if comm.is_master:
+    # preempted runs have no final observation — and must not crash
+    # here, or exit 143 never reaches the supervisor
+    if comm.is_master and not trainer.preempted:
         final = trainer.observation
         print(f"final: loss={final.get('main/loss'):.4f} "
               f"val_acc={final.get('validation/main/accuracy'):.4f}")
@@ -132,4 +134,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # supervisor exit-status contract (docs/fault_tolerance.md):
+    # 0 clean, 143 preempted-and-checkpointed, 75 watchdog abort
+    from chainermn_tpu.resilience.supervisor import main_exit_code
+    sys.exit(main_exit_code(main))

@@ -197,4 +197,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    # supervisor exit-status contract (docs/fault_tolerance.md):
+    # 0 clean, 143 preempted-and-checkpointed, 75 watchdog abort
+    from chainermn_tpu.resilience.supervisor import main_exit_code
+    sys.exit(main_exit_code(main))

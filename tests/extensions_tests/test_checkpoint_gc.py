@@ -1,0 +1,93 @@
+"""Consensus-aware GC (ISSUE 4 satellite): the rolling window must
+never delete (1) the elected consensus winner, (2) explicitly
+protected iterations, or (3) the newest iteration whose own file still
+verifies — a GC racing a failed/corrupted save must not strand the
+next election with only broken files."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import chainermn_tpu
+from chainermn_tpu.extensions import create_multi_node_checkpointer
+
+
+@pytest.fixture()
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _state(v):
+    return {"w": jnp.full((2,), float(v))}
+
+
+def _corrupt(fn):
+    """Damage the published file in place, leaving the manifest: the
+    SHA check must now reject it."""
+    with open(fn, "rb+") as fh:
+        fh.seek(0)
+        chunk = fh.read(64)
+        fh.seek(0)
+        fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def test_gc_keeps_elected_winner_outside_window(comm, tmp_path):
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                        cp_interval=2)
+    cp.save(_state(1), iteration=10)
+    cp.save(_state(2), iteration=20)
+    assert cp.latest_common_iteration() == 20  # pins 20
+    cp.save(_state(3), iteration=30)
+    cp.save(_state(4), iteration=40)
+    cp.save(_state(5), iteration=50)
+    # window is [40, 50]; the elected 20 survives, 10 and 30 are gone
+    assert cp._iters_on_disk() == [20, 40, 50]
+
+
+def test_gc_elected_pin_is_replaced_not_accumulated(comm, tmp_path):
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                        cp_interval=2)
+    for i in range(1, 7):
+        cp.save(_state(i), iteration=i * 10)
+        assert cp.latest_common_iteration() == i * 10
+    # every save was immediately elected, but the pin is a single slot:
+    # the window still prunes normally
+    assert cp._iters_on_disk() == [50, 60]
+
+
+def test_gc_protect_pins_permanently(comm, tmp_path):
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                        cp_interval=1)
+    cp.save(_state(1), iteration=10)
+    cp.protect(10)
+    cp.save(_state(2), iteration=20)
+    cp.save(_state(3), iteration=30)
+    assert cp._iters_on_disk() == [10, 30]
+
+
+def test_gc_keeps_newest_valid_when_newer_is_corrupt(comm, tmp_path):
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                        cp_interval=5)
+    cp.save(_state(1), iteration=10)
+    cp.save(_state(2), iteration=20)
+    _corrupt(os.path.join(cp.path, "snapshot_iter_20.0"))
+    # shrink the window so 10 falls outside it, then GC: 10 is the
+    # newest iteration that still VERIFIES — it must survive, or the
+    # next election would find only the broken 20
+    cp.cp_interval = 1
+    cp._gc()
+    assert cp._iters_on_disk() == [10, 20]
+    restored, it = cp.maybe_load(_state(0))
+    assert it == 10
+    np.testing.assert_allclose(np.asarray(restored["w"]), 1.0)
+
+
+def test_gc_still_prunes_normally(comm, tmp_path):
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                        cp_interval=3)
+    for i in range(6):
+        cp.save(_state(i), iteration=i * 10)
+    assert cp._iters_on_disk() == [30, 40, 50]

@@ -96,7 +96,7 @@ t.run()
 assert proc_id == 0, "rank 1 should have been killed before finishing"
 assert losses == exp, f"rank0 losses diverged: {losses}"
 print(f"WORKER{proc_id} OK", flush=True)
-os._exit(0)
+os._exit(0)  # peer is dead: the shutdown barrier would hang, skip it
 """
 
 
@@ -118,6 +118,7 @@ t.run()
 assert losses == exp[6:], (
     f"rank{proc_id}: resumed losses diverged: {losses} vs {exp[6:]}")
 print(f"WORKER{proc_id} OK", flush=True)
+jax.distributed.shutdown()  # barrier: no rank dies while a peer works
 os._exit(0)
 """
 
@@ -164,6 +165,7 @@ state, it = ck.maybe_load(np.float32(0.0))
 assert it == 3
 assert float(state) == float(np.float32(exp[2])), float(state)
 print(f"WORKER{proc_id} OK", flush=True)
+jax.distributed.shutdown()  # barrier: no rank dies while a peer works
 os._exit(0)
 """
 
@@ -197,6 +199,7 @@ assert os.path.exists(fn), f"no emergency snapshot {fn}"
 assert os.path.exists(fn + ".json"), "no manifest for emergency snapshot"
 assert ck._verify_snapshot_file(fn)
 print(f"WORKER{proc_id} OK", flush=True)
+jax.distributed.shutdown()  # barrier: no rank dies while a peer works
 os._exit(0)
 """
 

@@ -1,0 +1,345 @@
+"""Elastic matrix with REAL jax.distributed processes (ISSUE 4
+acceptance): the supervisor + shrink-to-fit + replication layers under
+actual process death.
+
+* supervisor smoke — both ranks run under in-worker Supervisors on a
+  per-incarnation coordinator port; chaos SIGKILLs BOTH first
+  incarnations at step 7 (``run=0`` pins the fault to incarnation 0,
+  so the restart heals), the supervisors relaunch, and the second
+  incarnations elect the last common snapshot and finish with losses
+  matching an uninterrupted run. The kill is symmetric on purpose:
+  every rank crashes exactly once, so the incarnation counters (and
+  with them the per-incarnation coordinator port) stay aligned without
+  cross-host agreement — the asymmetric death → watchdog-abort → 75
+  leg is covered by tests/resilience_tests/test_supervisor.py and the
+  watchdog case in test_multiprocess_chaos.py;
+* shrink-to-fit — a 2-rank run snapshots to completion, then rank 1's
+  host (and every one of its files) is permanently gone: a world-1
+  resume re-splices rank 0's shard, re-scatters the dataset, and keeps
+  training with finite losses;
+* replica recovery (slow) — ring replication during training leaves
+  each rank's shard on its neighbor; with ALL of rank 1's primaries
+  deleted, a same-world restart still elects the NEWEST iteration and
+  rank 1 restores from the pushed-back replica.
+
+Workers self-inject faults from $CHAINERMN_TPU_CHAOS — the training
+code never knows it is under test."""
+
+import glob
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from mp_harness import assert_all_ok, run_workers
+
+_NO_MP_CPU = "Multiprocess computations aren't implemented on the CPU backend"
+
+# deterministic host-only training job, identical to the chaos matrix:
+# identically seeded iterators on every rank make loss sequences exactly
+# comparable without cross-process device support
+TOTAL = 12
+BS = 8
+
+
+def _dataset():
+    return [(np.full((2,), float(i), np.float32), np.asarray(i, np.int32))
+            for i in range(40)]
+
+
+def _expected_losses():
+    from chainermn_tpu.iterators import SerialIterator
+
+    exp, s = [], np.float32(0.0)
+    it = SerialIterator(_dataset(), BS, shuffle=True, seed=3)
+    for _ in range(TOTAL):
+        batch = next(it)
+        s = s + np.float32(np.stack([b[0] for b in batch]).mean())
+        exp.append(float(s))
+    return exp
+
+
+_TRAIN_COMMON = r"""
+import numpy as np
+import chainermn_tpu
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.training import StandardUpdater, Trainer
+
+comm = chainermn_tpu.create_communicator("xla")
+TOTAL = 12
+
+def dataset():
+    return [(np.full((2,), float(i), np.float32), np.asarray(i, np.int32))
+            for i in range(40)]
+
+def step(state, x, y):
+    new = state + np.float32(np.asarray(x).mean())
+    return new, {"loss": float(new)}
+
+def make_updater():
+    it = SerialIterator(dataset(), 8, shuffle=True, seed=3)
+    u = StandardUpdater(it, step, np.float32(0.0), comm)
+    u.shard_batch = lambda arrays: arrays
+    return u
+
+def make_ck():
+    return chainermn_tpu.create_multi_node_checkpointer(
+        "elastic", comm, path=os.environ["CKPT_DIR"], cp_interval=5)
+
+exp = []
+_s, _it = np.float32(0.0), SerialIterator(dataset(), 8, shuffle=True, seed=3)
+for _ in range(TOTAL):
+    batch = next(_it)
+    _s = _s + np.float32(np.stack([b[0] for b in batch]).mean())
+    exp.append(float(_s))
+"""
+
+
+# -- supervisor smoke ---------------------------------------------------
+
+_SUPERVISED_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+base_port = int(sys.argv[2])
+mode = sys.argv[3] if len(sys.argv) > 3 else "supervise"
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+if mode == "supervise":
+    # the per-host parent: wraps THIS script in inner mode and restarts
+    # it per the exit-status contract
+    from chainermn_tpu.resilience.supervisor import Supervisor
+
+    sup = Supervisor([sys.executable, sys.argv[0], sys.argv[1],
+                      sys.argv[2], "inner"],
+                     max_restarts=3, window_s=120.0)
+    sys.exit(sup.run())
+
+# ---- one training incarnation ----
+# each incarnation gets its own coordinator port: the previous
+# incarnation's coordinator (hosted by rank 0's dead process) must not
+# be confused with the new job
+incarnation = int(os.environ.get("CHAINERMN_TPU_RESTART_COUNT", "0"))
+port = base_port + incarnation
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["CHAINERMN_TPU_CHAOS_RANK"] = str(proc_id)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id, initialization_timeout=60)
+
+""" + _TRAIN_COMMON + r"""
+from chainermn_tpu.resilience.supervisor import main_exit_code
+
+def main():
+    ck = make_ck()
+    u = make_updater()
+    if incarnation > 0:
+        # restarted job: consensus resume — both ranks died at step 7,
+        # so the last common snapshot is 6
+        elected = ck.resume(u)
+        assert elected == 6, f"rank{proc_id} inc{incarnation}: {elected}"
+        assert float(u.state) == float(np.float32(exp[5])), float(u.state)
+    losses = []
+    t = Trainer(u, stop_trigger=(TOTAL, "iteration"))
+    t.extend(lambda tr: losses.append(tr.updater.last_metrics["loss"]),
+             trigger=(1, "iteration"))
+    t.extend(ck, trigger=(3, "iteration"))
+    t.run()
+    if incarnation > 0:
+        assert losses == exp[6:], f"rank{proc_id}: {losses} vs {exp[6:]}"
+    # all-rank fence: both second incarnations must be alive and agree
+    comm.allgather_obj(("done", proc_id))
+    print(f"WORKER{proc_id} OK incarnation {incarnation}", flush=True)
+    return t
+
+code = main_exit_code(main)
+if code == 0:
+    # clean finish means every peer is alive: deregister through the
+    # coordination shutdown barrier, so the leader's exit cannot be
+    # mistaken for a death and SIGABRT a peer that is still deregistering
+    jax.distributed.shutdown()
+os._exit(code)  # crashed/aborted: skip teardown, the peer may be gone
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(240)
+def test_supervised_kill_restart_resumes_and_finishes(tmp_path):
+    procs, outs = run_workers(
+        _SUPERVISED_WORKER, tmp_path, timeout=150,
+        env_extra={
+            "CKPT_DIR": str(tmp_path / "snaps"),
+            "CHAINERMN_TPU_CHAOS": "kill@step=7,run=0",
+        })
+    if any(_NO_MP_CPU in o for o in outs):
+        pytest.skip("jaxlib CPU backend lacks cross-process computations")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"supervisor {i} failed:\n{out[-4000:]}"
+        assert f"WORKER{i} OK incarnation 1" in out
+        # the supervisor observed the SIGKILL, then the healed rerun
+        assert "(crash)" in out, out[-2000:]
+        assert "(clean)" in out, out[-2000:]
+
+
+# -- shrink-to-fit: world 2 -> world 1 ----------------------------------
+
+_SHRINK_PHASE1 = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+""" + _TRAIN_COMMON + r"""
+ck = make_ck()
+u = make_updater()
+t = Trainer(u, stop_trigger=(TOTAL, "iteration"))
+t.extend(ck, trigger=(3, "iteration"))
+t.run()
+assert u.iteration == TOTAL
+print(f"WORKER{proc_id} OK", flush=True)
+jax.distributed.shutdown()  # barrier: no rank dies while a peer works
+os._exit(0)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(240)
+def test_shrink_to_fit_resumes_at_world_one(tmp_path):
+    ckpt = str(tmp_path / "snaps")
+    procs, outs = run_workers(
+        _SHRINK_PHASE1, tmp_path, timeout=110,
+        env_extra={"CKPT_DIR": ckpt})
+    assert_all_ok(procs, outs)
+
+    # rank 1's host is permanently gone: every file it ever wrote too
+    job = os.path.join(ckpt, "elastic")
+    gone = glob.glob(os.path.join(job, "snapshot_iter_*.1*"))
+    assert gone, "phase 1 produced no rank-1 snapshots"
+    for f in gone:
+        os.remove(f)
+
+    # world-1 resume IN-PROCESS (a single survivor needs no coordinator)
+    import chainermn_tpu
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.training import StandardUpdater
+    from chainermn_tpu.resilience.elastic import elastic_resume
+
+    exp = _expected_losses()
+    comm = chainermn_tpu.create_communicator("xla")
+    ck = chainermn_tpu.create_multi_node_checkpointer(
+        "elastic", comm, path=ckpt, cp_interval=5)
+    data = _dataset()
+
+    def step(state, x, y):
+        new = state + np.float32(np.asarray(x).mean())
+        return new, {"loss": float(new)}
+
+    it = SerialIterator(data, BS, shuffle=True, seed=3)
+    u = StandardUpdater(it, step, np.float32(0.0), comm)
+    u.shard_batch = lambda arrays: arrays
+
+    plan = elastic_resume(ck, u, global_dataset=data)
+    assert plan.action == "shrink"
+    assert plan.saved_world == 2 and plan.new_world == 1
+    assert u.iteration == TOTAL
+    # the state is replicated in this job shape: rank 0's shard is the
+    # whole state, restored exactly
+    assert float(u.state) == float(np.float32(exp[-1])), float(u.state)
+    # training continues on the rebalanced world with finite losses
+    for _ in range(4):
+        u.update()
+        assert np.isfinite(u.last_metrics["loss"])
+    assert u.iteration == TOTAL + 4
+
+
+# -- replica recovery: newest iteration survives its host ---------------
+
+_REPLICA_PHASE1 = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+""" + _TRAIN_COMMON + r"""
+from chainermn_tpu.resilience import PeerReplicator
+
+ck = make_ck()
+u = make_updater()
+t = Trainer(u, stop_trigger=(TOTAL, "iteration"))
+t.extend(ck, trigger=(3, "iteration"))
+t.extend(PeerReplicator(ck), trigger=(3, "iteration"))  # after the save
+t.run()
+print(f"WORKER{proc_id} OK", flush=True)
+jax.distributed.shutdown()  # barrier: no rank dies while a peer works
+os._exit(0)
+"""
+
+
+_REPLICA_PHASE2 = r"""
+import os, sys
+proc_id = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+    process_id=proc_id)
+sys.path.insert(0, os.environ["REPO_ROOT"])
+""" + _TRAIN_COMMON + r"""
+# rank 1's primaries are gone, but the ring pushed its shards back:
+# the election must still find the NEWEST iteration, and rank 1 must
+# restore from the replica
+ck = make_ck()
+u = make_updater()
+elected = ck.resume(u)
+assert elected == TOTAL, f"rank{proc_id}: elected {elected}"
+assert u.iteration == TOTAL
+assert float(u.state) == float(np.float32(exp[-1])), float(u.state)
+print(f"WORKER{proc_id} OK", flush=True)
+jax.distributed.shutdown()  # barrier: no rank dies while a peer works
+os._exit(0)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(240)
+def test_replica_recovery_elects_newest_iteration(tmp_path):
+    ckpt = str(tmp_path / "snaps")
+    procs, outs = run_workers(
+        _REPLICA_PHASE1, tmp_path, timeout=110,
+        env_extra={"CKPT_DIR": ckpt})
+    assert_all_ok(procs, outs)
+
+    job = os.path.join(ckpt, "elastic")
+    replicas = os.path.join(job, "replicas")
+    # the ring left each rank's newest shard on its neighbor (shared
+    # tmpdir in this harness, so both land in the same replicas/)
+    assert os.path.exists(os.path.join(replicas, "snapshot_iter_12.0"))
+    assert os.path.exists(os.path.join(replicas, "snapshot_iter_12.1"))
+
+    # rank 1's host dies and is replaced: ALL its primaries are gone
+    gone = [f for f in glob.glob(os.path.join(job, "snapshot_iter_*.1*"))
+            if os.path.dirname(f) == job]
+    assert gone
+    for f in gone:
+        os.remove(f)
+
+    procs, outs = run_workers(
+        _REPLICA_PHASE2, tmp_path, timeout=110,
+        env_extra={"CKPT_DIR": ckpt})
+    assert_all_ok(procs, outs)

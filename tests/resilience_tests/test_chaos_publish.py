@@ -1,0 +1,164 @@
+"""New chaos fault kinds (ISSUE 4): enospc / slow_disk on the snapshot
+publish path, and the run= incarnation pin every fault kind accepts."""
+
+import errno
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import chainermn_tpu
+from chainermn_tpu.extensions import create_multi_node_checkpointer
+from chainermn_tpu.resilience import chaos
+
+
+@pytest.fixture()
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_chaos(monkeypatch):
+    monkeypatch.delenv(chaos.ENV_VAR, raising=False)
+    monkeypatch.delenv("CHAINERMN_TPU_RESTART_COUNT", raising=False)
+
+
+# -- spec parsing -------------------------------------------------------
+
+def test_parse_enospc_and_slow_disk():
+    faults = chaos.parse_spec(
+        "enospc@match=snapshot_iter_4,rank=1,after=2;"
+        "slow_disk@ms=250,match=snapshot_iter,prob=0.5,seed=3")
+    assert [f.kind for f in faults] == ["enospc", "slow_disk"]
+    assert faults[0].match == "snapshot_iter_4"
+    assert faults[0].after == 2
+    assert faults[1].ms == 250
+
+
+def test_parse_enospc_requires_match():
+    with pytest.raises(ValueError, match="match"):
+        chaos.parse_spec("enospc@rank=1")
+
+
+def test_parse_slow_disk_requires_ms():
+    with pytest.raises(ValueError, match="ms"):
+        chaos.parse_spec("slow_disk@match=snapshot")
+
+
+def test_parse_run_field_on_any_kind():
+    (f,) = chaos.parse_spec("kill@step=3,run=1")
+    assert f.run == 1
+    assert "run=1" in f.describe()
+
+
+def test_catalogue_lists_new_kinds():
+    assert "enospc" in chaos.FAULT_KINDS
+    assert "slow_disk" in chaos.FAULT_KINDS
+
+
+# -- hook behavior ------------------------------------------------------
+
+def test_on_publish_enospc_raises():
+    plan = chaos.ChaosPlan(chaos.parse_spec("enospc@match=snapshot_iter_4"))
+    plan.on_publish("/ck/snapshot_iter_3.0", rank=0)  # no match: silent
+    with pytest.raises(OSError) as ei:
+        plan.on_publish("/ck/snapshot_iter_4.0", rank=0)
+    assert ei.value.errno == errno.ENOSPC
+
+
+def test_on_publish_slow_disk_sleeps():
+    slept = []
+    plan = chaos.ChaosPlan(
+        chaos.parse_spec("slow_disk@ms=1500,match=snapshot"),
+        sleep_fn=slept.append)
+    plan.on_publish("/ck/snapshot_iter_1.0", rank=0)
+    assert slept == [1.5]
+
+
+def test_on_publish_after_skips_first_k():
+    plan = chaos.ChaosPlan(chaos.parse_spec("enospc@match=snap,after=2"))
+    plan.on_publish("/snap.0", rank=0)
+    plan.on_publish("/snap.0", rank=0)  # first two matches pass
+    with pytest.raises(OSError):
+        plan.on_publish("/snap.0", rank=0)
+
+
+def test_on_publish_respects_rank():
+    plan = chaos.ChaosPlan(chaos.parse_spec("enospc@match=snap,rank=1"))
+    plan.on_publish("/snap.0", rank=0)  # other rank: untouched
+    with pytest.raises(OSError):
+        plan.on_publish("/snap.1", rank=1)
+
+
+# -- run= incarnation gating --------------------------------------------
+
+def test_run_gating(monkeypatch):
+    (f,) = chaos.parse_spec("enospc@match=snap,run=1")
+    assert not f.applies_to_run()  # no env: incarnation 0
+    monkeypatch.setenv("CHAINERMN_TPU_RESTART_COUNT", "1")
+    assert f.applies_to_run()
+    monkeypatch.setenv("CHAINERMN_TPU_RESTART_COUNT", "2")
+    assert not f.applies_to_run()
+
+
+def test_run_gating_in_on_step(monkeypatch):
+    killed = []
+    plan = chaos.ChaosPlan(chaos.parse_spec("kill@step=3,run=1"),
+                           kill_fn=killed.append)
+    plan.on_step(3, rank=0)
+    assert killed == []  # incarnation 0: the pinned fault stays quiet
+    monkeypatch.setenv("CHAINERMN_TPU_RESTART_COUNT", "1")
+    plan.on_step(3, rank=0)
+    assert len(killed) == 1
+
+
+# -- checkpointer integration -------------------------------------------
+
+def _state(v):
+    return {"w": jnp.full((2,), float(v))}
+
+
+def test_enospc_fails_save_and_election_falls_back(comm, tmp_path,
+                                                   monkeypatch):
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+    cp.save(_state(1), iteration=10)
+    monkeypatch.setenv(chaos.ENV_VAR, "enospc@match=snapshot_iter_20")
+    with pytest.raises(OSError) as ei:
+        cp.save(_state(2), iteration=20)
+    assert ei.value.errno == errno.ENOSPC
+    monkeypatch.delenv(chaos.ENV_VAR)
+    # nothing of iteration 20 was published — not even a tmp file —
+    # and the election still finds 10
+    assert not any("20" in f for f in os.listdir(cp.path))
+    restored, it = cp.maybe_load(_state(0))
+    assert it == 10
+    np.testing.assert_allclose(np.asarray(restored["w"]), 1.0)
+
+
+def test_enospc_failed_async_save_does_not_block_election(comm, tmp_path,
+                                                          monkeypatch):
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path),
+                                        async_write=True)
+    cp.save(_state(1), iteration=10)
+    cp.flush()
+    monkeypatch.setenv(chaos.ENV_VAR, "enospc@match=snapshot_iter_20")
+    cp.save(_state(2), iteration=20)  # fails on the writer thread
+    # keep the spec active until the queue is drained — the writer may
+    # not have picked the item up yet (the election's _drain joins it)
+    with pytest.warns(UserWarning, match="election will skip"):
+        it = cp.latest_common_iteration()
+    assert it == 10
+    monkeypatch.delenv(chaos.ENV_VAR)
+    cp.close()
+
+
+def test_slow_disk_save_still_publishes(comm, tmp_path, monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR, "slow_disk@ms=50,match=snapshot")
+    cp = create_multi_node_checkpointer("job", comm, path=str(tmp_path))
+    cp.save(_state(3), iteration=5)
+    monkeypatch.delenv(chaos.ENV_VAR)
+    restored, it = cp.maybe_load(_state(0))
+    assert it == 5
+    np.testing.assert_allclose(np.asarray(restored["w"]), 3.0)

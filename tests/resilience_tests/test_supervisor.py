@@ -1,0 +1,225 @@
+"""Per-host supervisor: exit classification, the rolling restart
+budget, the restart loop against real child processes, and the
+crash-loop chaos case that must trip the budget instead of looping
+forever (ISSUE 4 acceptance)."""
+
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from chainermn_tpu.resilience.supervisor import (
+    ABORTED_EXIT_CODE,
+    BUDGET_EXHAUSTED_EXIT_CODE,
+    RESTART_COUNT_ENV,
+    RestartBudget,
+    Supervisor,
+    classify_exit,
+    main_exit_code,
+)
+from chainermn_tpu.resilience.preemption import PREEMPTED_EXIT_CODE
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# -- classification -----------------------------------------------------
+
+def test_classify_exit():
+    assert classify_exit(0) == "clean"
+    assert classify_exit(PREEMPTED_EXIT_CODE) == "preempted"
+    assert classify_exit(-signal.SIGTERM) == "preempted"
+    assert classify_exit(ABORTED_EXIT_CODE) == "aborted"
+    assert classify_exit(-signal.SIGKILL) == "crash"
+    assert classify_exit(1) == "crash"
+    assert classify_exit(134) == "crash"  # SIGABRT via shell
+
+
+# -- budget -------------------------------------------------------------
+
+def test_budget_counts_within_window():
+    b = RestartBudget(max_restarts=2, window_s=10.0)
+    assert b.try_spend(now=0.0)
+    assert b.try_spend(now=1.0)
+    assert not b.try_spend(now=2.0)
+    assert b.remaining(now=2.0) == 0
+
+
+def test_budget_rolls_off():
+    b = RestartBudget(max_restarts=1, window_s=10.0)
+    assert b.try_spend(now=0.0)
+    assert not b.try_spend(now=5.0)
+    assert b.try_spend(now=11.0)  # the old crash aged out
+
+
+def test_budget_zero_means_no_restarts():
+    b = RestartBudget(max_restarts=0, window_s=10.0)
+    assert not b.try_spend(now=0.0)
+
+
+def test_budget_rejects_bad_config():
+    with pytest.raises(ValueError):
+        RestartBudget(max_restarts=-1)
+    with pytest.raises(ValueError):
+        RestartBudget(window_s=0)
+
+
+# -- the restart loop (real children) -----------------------------------
+
+def _sup(code_body, **kw):
+    kw.setdefault("sleep", lambda _s: None)
+    return Supervisor([sys.executable, "-c", code_body], **kw)
+
+
+def test_clean_exit_stops_immediately():
+    s = _sup("raise SystemExit(0)", max_restarts=3)
+    assert s.run() == 0
+    assert [r.kind for r in s.history] == ["clean"]
+
+
+def test_crash_heals_via_restart_count_env():
+    # the child crashes until its incarnation counter reaches 2 — the
+    # supervisor must export $CHAINERMN_TPU_RESTART_COUNT per launch
+    body = (f"import os, sys; "
+            f"sys.exit(0 if os.environ['{RESTART_COUNT_ENV}'] == '2' "
+            f"else 7)")
+    s = _sup(body, max_restarts=3)
+    assert s.run() == 0
+    assert [r.kind for r in s.history] == ["crash", "crash", "clean"]
+
+
+def test_budget_trips_with_diagnostic(capsys):
+    s = _sup("raise SystemExit(7)", max_restarts=2, window_s=60)
+    assert s.run() == BUDGET_EXHAUSTED_EXIT_CODE
+    # initial launch + 2 budgeted restarts, then give up
+    assert len(s.history) == 3
+    err = capsys.readouterr().err
+    assert "restart budget exhausted" in err
+    assert "crash-looping" in err
+
+
+def test_preemption_restart_is_free():
+    # exits 143 twice, then clean — with a ZERO crash budget: preempted
+    # restarts must not spend it
+    body = (f"import os, sys; "
+            f"sys.exit(0 if os.environ['{RESTART_COUNT_ENV}'] == '2' "
+            f"else {PREEMPTED_EXIT_CODE})")
+    s = _sup(body, max_restarts=0)
+    assert s.run() == 0
+    assert [r.kind for r in s.history] == [
+        "preempted", "preempted", "clean"]
+
+
+def test_no_restart_on_preempt_returns_143():
+    s = _sup(f"raise SystemExit({PREEMPTED_EXIT_CODE})",
+             restart_on_preempt=False)
+    assert s.run() == PREEMPTED_EXIT_CODE
+
+
+def test_aborted_exit_counts_against_budget():
+    s = _sup(f"raise SystemExit({ABORTED_EXIT_CODE})",
+             max_restarts=1, window_s=60)
+    assert s.run() == BUDGET_EXHAUSTED_EXIT_CODE
+    assert [r.kind for r in s.history] == ["aborted", "aborted"]
+
+
+# -- crash-loop chaos (ISSUE 4 acceptance) ------------------------------
+
+_CHAOS_CHILD = """
+import os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+from chainermn_tpu.resilience import chaos
+for i in range(10):
+    chaos.on_step(i)
+os._exit(0)
+"""
+
+
+def _chaos_env(spec):
+    env = dict(os.environ)
+    env["REPO_ROOT"] = REPO_ROOT
+    env["CHAINERMN_TPU_CHAOS"] = spec
+    env.pop(RESTART_COUNT_ENV, None)
+    return env
+
+
+def test_chaos_crash_loop_trips_budget(capsys):
+    # kill@step=3 with no run= pin fires in EVERY incarnation: the
+    # supervisor must stop after the budget, not loop forever
+    s = Supervisor([sys.executable, "-c", _CHAOS_CHILD],
+                   max_restarts=2, window_s=60,
+                   env=_chaos_env("kill@step=3"), sleep=lambda _s: None)
+    assert s.run() == BUDGET_EXHAUSTED_EXIT_CODE
+    assert [r.kind for r in s.history] == ["crash"] * 3
+    assert all(r.returncode == -signal.SIGKILL for r in s.history)
+    assert "restart budget exhausted" in capsys.readouterr().err
+
+
+def test_chaos_run_pinned_kill_heals_on_restart():
+    # the same kill pinned to run=0 fires once; the supervisor's restart
+    # (which exports RESTART_COUNT=1) runs clean — SIGKILLs heal without
+    # human action
+    s = Supervisor([sys.executable, "-c", _CHAOS_CHILD],
+                   max_restarts=2, window_s=60,
+                   env=_chaos_env("kill@step=3,run=0"),
+                   sleep=lambda _s: None)
+    assert s.run() == 0
+    assert [r.kind for r in s.history] == ["crash", "clean"]
+
+
+# -- main_exit_code (the child side of the contract) --------------------
+
+def test_main_exit_code_clean_and_preempted():
+    class FakeTrainer:
+        preempted = False
+
+    assert main_exit_code(lambda: FakeTrainer()) == 0
+    FakeTrainer.preempted = True
+    assert main_exit_code(lambda: FakeTrainer()) == PREEMPTED_EXIT_CODE
+    assert main_exit_code(lambda: None) == 0
+    assert main_exit_code(lambda: 3.14) == 0  # non-trainer returns
+
+
+def test_main_exit_code_maps_job_aborted():
+    from chainermn_tpu.comm.object_plane import JobAbortedError
+
+    def aborts():
+        raise JobAbortedError("peer died")
+
+    assert main_exit_code(aborts) == ABORTED_EXIT_CODE
+
+
+def test_main_exit_code_reraises_other_errors():
+    def crashes():
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        main_exit_code(crashes)
+
+
+# -- the CLI ------------------------------------------------------------
+
+def test_supervise_cli_smoke(tmp_path):
+    # wrap a child that crashes once then exits clean; also proves the
+    # CLI parses and forwards budget flags
+    marker = tmp_path / "ran"
+    child = (f"import os, sys; p={str(marker)!r}; "
+             "first = not os.path.exists(p); open(p, 'a').close(); "
+             "sys.exit(7 if first else 0)")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "supervise.py"),
+         "--max-restarts", "2", "--window-s", "60", "--",
+         sys.executable, "-c", child],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "exited 7 (crash)" in r.stderr
+    assert "exited 0 (clean)" in r.stderr
+
+
+def test_supervise_cli_usage_error():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "supervise.py")],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 2
