@@ -19,7 +19,8 @@ from chainermn_tpu.comm import (
     XlaCommunicator,
     create_communicator,
 )
-from chainermn_tpu import functions, links
+from chainermn_tpu import collectives, functions, links
+from chainermn_tpu.collectives import make_grad_reducer
 from chainermn_tpu.datasets import (
     create_empty_dataset,
     scatter_dataset,
@@ -44,6 +45,8 @@ __all__ = [
     "XlaCommunicator",
     "create_communicator",
     "create_multi_node_optimizer",
+    "collectives",
+    "make_grad_reducer",
     "scatter_dataset",
     "create_empty_dataset",
     "create_multi_node_iterator",

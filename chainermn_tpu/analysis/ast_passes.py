@@ -632,3 +632,129 @@ def check_unguarded_object_plane(tree, src, path) -> List[Finding]:
 
 register(Rule("DL105", "unguarded-object-plane-call", f"{_DOC}#dl105",
               check_unguarded_object_plane))
+
+
+# ---------------------------------------------------------------------------
+# DL106 — hand-rolled gradient collective bypassing GradReducer
+# ---------------------------------------------------------------------------
+
+#: raw reduction primitives a train step should route through a
+#: GradReducer (pmean/all_gather excluded: metrics and param gathers)
+GRAD_COLLECTIVES = {"psum", "psum_scatter"}
+
+#: gradient producers: assignments whose RHS calls these taint targets
+_GRAD_SOURCES = {"grad", "value_and_grad"}
+
+
+def _grad_tainted_names(func: ast.AST) -> Set[str]:
+    """Names holding gradients inside one step function's subtree
+    (nested closures included — the scan/micro bodies gradients flow
+    into). Sources are ``jax.grad``/``jax.value_and_grad`` results; for
+    the 2-tuple ``value_and_grad`` unpack only the gradient half taints
+    (the loss/aux half feeds metric psums legitimately). Propagates
+    through assignments, for-loops, and comprehension binders."""
+    tainted: Set[str] = set()
+    flows: List[Tuple[Set[str], ast.AST]] = []
+    for node in ast.walk(func):
+        targets: List[ast.expr] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.For):
+            targets, value = [node.target], node.iter
+        elif isinstance(node, (ast.ListComp, ast.SetComp,
+                               ast.GeneratorExp, ast.DictComp)):
+            for comp in node.generators:
+                names = {n.id for n in ast.walk(comp.target)
+                         if isinstance(n, ast.Name)}
+                if names:
+                    flows.append((names, comp.iter))
+            continue
+        elif isinstance(node, ast.Call):
+            # tree_map(lambda g: ..., grads): the mapped-over tree's
+            # taint enters through the lambda's parameters
+            lams = [a for a in node.args if isinstance(a, ast.Lambda)]
+            others = ([a for a in node.args
+                       if not isinstance(a, ast.Lambda)]
+                      + [kw.value for kw in node.keywords])
+            if lams and others:
+                carrier = ast.Tuple(elts=others, ctx=ast.Load())
+                for lam in lams:
+                    names = {a.arg for a in lam.args.args}
+                    if names:
+                        flows.append((names, carrier))
+            continue
+        if value is None:
+            continue
+        src_kind = next(
+            (_callee_name(n) for n in ast.walk(value)
+             if isinstance(n, ast.Call)
+             and _callee_name(n) in _GRAD_SOURCES), None)
+        if src_kind is not None:
+            grad_targets = targets
+            if (src_kind == "value_and_grad" and len(targets) == 1
+                    and isinstance(targets[0], ast.Tuple)
+                    and len(targets[0].elts) == 2):
+                grad_targets = [targets[0].elts[1]]
+            for t in grad_targets:
+                tainted |= {n.id for n in ast.walk(t)
+                            if isinstance(n, ast.Name)}
+            continue
+        names = {n.id for t in targets for n in ast.walk(t)
+                 if isinstance(n, ast.Name)}
+        if names:
+            flows.append((names, value))
+
+    def _reads_tainted(expr: ast.AST) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in tainted
+                   for n in ast.walk(expr))
+
+    changed = True
+    while changed:
+        changed = False
+        for names, value in flows:
+            if names <= tainted:
+                continue
+            if _reads_tainted(value):
+                tainted |= names
+                changed = True
+    return tainted
+
+
+def check_handrolled_grad_collective(tree, src, path) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if "step" not in node.name:
+            continue
+        tainted = _grad_tainted_names(node)
+        if not tainted:
+            continue
+        for n in ast.walk(node):
+            if not (isinstance(n, ast.Call)
+                    and _callee_name(n) in GRAD_COLLECTIVES):
+                continue
+            exprs = list(n.args) + [kw.value for kw in n.keywords]
+            if any(isinstance(x, ast.Name) and x.id in tainted
+                   for e in exprs for x in ast.walk(e)):
+                findings.append(Finding(
+                    "DL106", path, n.lineno,
+                    f"hand-rolled '{_callee_name(n)}' on a gradient "
+                    "inside a train step bypasses the GradReducer "
+                    "strategy registry: the reduction algorithm stops "
+                    "being selectable (hierarchical/quantized/auto), "
+                    "invisible to ReductionReport, and numerically "
+                    "unaudited against the flat reference. Route it "
+                    "through grad_reducer= / reducer.reduce() or "
+                    "reducer.reduce_scatter_flat() "
+                    f"({_DOC}#dl106)."))
+    return findings
+
+
+register(Rule("DL106", "handrolled-grad-collective", f"{_DOC}#dl106",
+              check_handrolled_grad_collective))

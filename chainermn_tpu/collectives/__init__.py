@@ -1,0 +1,48 @@
+"""Pluggable gradient-reduction strategies (the reference's communicator
+zoo, rebuilt as in-graph reduction algorithms — docs/collectives.md).
+
+Public surface::
+
+    reducer = make_grad_reducer("hierarchical", comm, intra=4)
+    opt = create_multi_node_optimizer(optax.adam(1e-3), comm,
+                                      grad_reducer=reducer)   # or the name
+
+Strategies: ``flat`` (the numerical reference), ``hierarchical``,
+``quantized`` (error feedback), ``auto`` (cost model).
+"""
+
+from chainermn_tpu.collectives.auto import (  # noqa: F401
+    AutoReducer,
+    CostModel,
+    measure_strategies,
+)
+from chainermn_tpu.collectives.base import (  # noqa: F401
+    REDUCERS,
+    GradReducer,
+    make_grad_reducer,
+    register_reducer,
+)
+from chainermn_tpu.collectives.flat import FlatReducer  # noqa: F401
+from chainermn_tpu.collectives.hierarchical import (  # noqa: F401
+    HierarchicalReducer,
+    HierTopology,
+)
+from chainermn_tpu.collectives.quantized import (  # noqa: F401
+    QuantizedReducer,
+    quantize_allreduce,
+)
+
+__all__ = [
+    "GradReducer",
+    "make_grad_reducer",
+    "register_reducer",
+    "REDUCERS",
+    "FlatReducer",
+    "HierarchicalReducer",
+    "HierTopology",
+    "QuantizedReducer",
+    "quantize_allreduce",
+    "AutoReducer",
+    "CostModel",
+    "measure_strategies",
+]

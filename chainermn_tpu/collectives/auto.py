@@ -1,0 +1,232 @@
+"""The ``auto`` strategy: a bytes/hop-latency cost model picks flat vs
+hierarchical vs quantized per bucket.
+
+The model is the standard alpha-beta form per tier (latency ``alpha`` +
+bytes/bandwidth ``beta``), with ring-allreduce byte counts
+(``2·b·(k-1)/k`` per rank over a k-ring). Defaults are v5e-flavored
+order-of-magnitude numbers (ICI ~100 GB/s per link / ~1 µs, DCN
+~25 GB/s per host / ~100 µs — docs/scaling_model.md); the point is the
+*crossover structure*, not the absolute numbers:
+
+* tiny buckets are launch-latency bound → ``flat`` (one collective);
+* large buckets on a multi-tier mesh → ``hierarchical`` (the inter tier
+  carries ``1/intra`` of the bytes);
+* with ``lossy=True``, very large buckets → ``quantized`` bf16 (half
+  the wire bytes; OFF by default — a strategy named "auto" must not
+  silently change numerics).
+
+Override with measurement (:func:`measure_strategies`): on TPU it times
+real compiled reductions per size and the picker interpolates the
+table; off TPU it returns ``{}`` untimed — on a CPU host-platform mesh
+every "collective" is a memcpy and the numbers would be fiction (the
+``ops/autotune.py`` honest-null convention; BASELINE.md records the
+null).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.collectives.base import (
+    GradReducer,
+    group_leaves_for_buckets,
+    register_reducer,
+)
+from chainermn_tpu.collectives.hierarchical import HierTopology
+from chainermn_tpu.collectives.quantized import (
+    WIRE_ITEMSIZE,
+    quantize_allreduce,
+)
+
+
+@dataclasses.dataclass
+class CostModel:
+    """Per-tier alpha-beta parameters, microseconds and GB/s."""
+
+    ici_latency_us: float = 1.0
+    ici_bw_gbps: float = 100.0
+    dcn_latency_us: float = 100.0
+    dcn_bw_gbps: float = 25.0
+    quant_overhead_us: float = 2.0  # quantize/dequantize kernels
+
+    @staticmethod
+    def _xfer_us(nbytes: float, bw_gbps: float) -> float:
+        return nbytes / (bw_gbps * 1e3)  # 1 GB/s == 1e3 bytes/us
+
+    def estimate_us(self, strategy: str, nbytes: int,
+                    topo: HierTopology) -> float:
+        """Modeled time for ONE reduction of ``nbytes`` payload."""
+        n, intra, inter = topo.n, topo.intra, topo.inter
+        ring = lambda b, k: 2.0 * b * (k - 1) / max(k, 1)
+        slow_lat = self.dcn_latency_us if inter > 1 else self.ici_latency_us
+        slow_bw = self.dcn_bw_gbps if inter > 1 else self.ici_bw_gbps
+        if strategy == "flat":
+            # one allreduce whose ring crosses the slowest tier
+            return slow_lat + self._xfer_us(ring(nbytes, n), slow_bw)
+        if strategy == "hierarchical":
+            t = 2 * self.ici_latency_us + self._xfer_us(
+                ring(nbytes, intra), self.ici_bw_gbps)  # rs + ag, ICI
+            if inter > 1:
+                t += self.dcn_latency_us + self._xfer_us(
+                    ring(nbytes / intra, inter), self.dcn_bw_gbps)
+            return t
+        if strategy == "quantized":
+            wire = nbytes * WIRE_ITEMSIZE["bf16"] / 4.0
+            return (slow_lat + self.quant_overhead_us
+                    + self._xfer_us(ring(wire, n), slow_bw))
+        raise ValueError(f"unknown strategy {strategy!r}")
+
+
+_CACHE: Dict[tuple, Dict[Tuple[str, int], float]] = {}
+
+
+def measure_strategies(
+    comm,
+    sizes: Sequence[int] = (1 << 16, 1 << 20, 1 << 22, 1 << 24),
+    strategies: Sequence[str] = ("flat", "hierarchical", "quantized"),
+    steps: int = 10,
+    intra: Optional[int] = None,
+) -> Dict[Tuple[str, int], float]:
+    """Measured sweep: {(strategy, payload_bytes): microseconds}.
+
+    Times real compiled reductions on the communicator's mesh. Memoized
+    per (mesh shape, sizes, strategies). Off TPU this returns ``{}``
+    UNTIMED — host-platform "collectives" are memcpys and any number
+    would mislead the picker (honest-null convention, BASELINE.md).
+    Feed the result to ``AutoReducer(measured=...)``.
+    """
+    key = (tuple(comm.mesh.devices.shape), tuple(comm.axis_names),
+           tuple(sizes), tuple(strategies), intra)
+    if key in _CACHE:
+        return _CACHE[key]
+    if jax.devices()[0].platform != "tpu":
+        _CACHE[key] = {}
+        return {}
+    import time
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    topo = HierTopology(comm, intra=intra)
+    axes = comm.axis_names
+    ax = axes if len(axes) > 1 else axes[0]
+    out: Dict[Tuple[str, int], float] = {}
+    for nbytes in sizes:
+        nelem = max(1, nbytes // 4)
+        x = jnp.ones((comm.size, nelem), jnp.float32)
+        kernels = {
+            "flat": lambda v: lax.psum(v, axes),
+            "hierarchical": lambda v: topo.allreduce(v),
+            "quantized": lambda v: quantize_allreduce(v, axes, "bf16")[0],
+        }
+        for s in strategies:
+            f = jax.jit(shard_map(
+                lambda v: kernels[s](v[0])[None], mesh=comm.mesh,
+                in_specs=P(ax), out_specs=P(ax)))
+            f(x).block_until_ready()  # compile
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                r = f(x)
+            r.block_until_ready()
+            out[(s, nbytes)] = (time.perf_counter() - t0) / steps * 1e6
+    _CACHE[key] = out
+    return out
+
+
+class AutoReducer(GradReducer):
+    """Cost-model-driven per-bucket strategy choice (see module doc).
+
+    Args (beyond the base): ``cost`` — a :class:`CostModel`;
+    ``measured`` — a sweep table from :func:`measure_strategies`
+    overriding the model where it has data; ``lossy`` — allow the
+    quantized (bf16, no error feedback — this strategy is stateless)
+    candidate; ``intra`` — fast-tier width, as in
+    :class:`~chainermn_tpu.collectives.hierarchical.HierarchicalReducer`.
+    """
+
+    name = "auto"
+
+    def __init__(self, comm, op: str = "mean",
+                 bucket_bytes: Optional[int] = None,
+                 intra: Optional[int] = None,
+                 cost: Optional[CostModel] = None,
+                 measured: Optional[Dict[Tuple[str, int], float]] = None,
+                 lossy: bool = False):
+        super().__init__(comm, op, bucket_bytes)
+        self.topology = HierTopology(comm, intra=intra)
+        self.cost = cost or CostModel()
+        self.measured = dict(measured or {})
+        self.lossy = lossy
+
+    def _estimate(self, strategy: str, nbytes: int) -> float:
+        if self.measured:
+            pts = [(abs(sz - nbytes), us) for (s, sz), us
+                   in self.measured.items() if s == strategy]
+            if pts:  # nearest measured size wins over the model
+                return min(pts)[1]
+        return self.cost.estimate_us(strategy, nbytes, self.topology)
+
+    def choose(self, nbytes: int) -> str:
+        cands = ["flat", "hierarchical"] + (
+            ["quantized"] if self.lossy else [])
+        # stable tie-break: flat first (fewest launches, exact)
+        return min(cands, key=lambda s: (self._estimate(s, nbytes),
+                                         cands.index(s)))
+
+    def reduce(self, grads, state=()):
+        comm = self.comm
+        axes = comm.axis_names
+        n = comm.size
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        out = [None] * len(leaves)
+        passthrough, groups = group_leaves_for_buckets(
+            leaves, axes, self.bucket_bytes)
+        for i in passthrough:
+            out[i] = leaves[i] / n if self.op == "mean" else leaves[i]
+        for (va, cdt), buckets in groups.items():
+            full_tier = tuple(va) == tuple(axes)
+            lossy_ok = self.lossy and jnp.issubdtype(cdt, jnp.floating)
+            for bucket in buckets:
+                flat = jnp.concatenate(
+                    [leaves[i].astype(cdt).ravel() for i in bucket])
+                nbytes = flat.size * cdt.itemsize
+                algo = self.choose(nbytes)
+                if algo == "hierarchical" and full_tier:
+                    red = self.topology.allreduce(flat)
+                elif algo == "quantized" and lossy_ok:
+                    red = quantize_allreduce(flat, va, "bf16")[0]
+                else:
+                    red = lax.psum(flat, va)
+                off = 0
+                for i in bucket:
+                    l = leaves[i]
+                    piece = red[off:off + l.size].reshape(l.shape).astype(
+                        l.dtype)
+                    off += l.size
+                    out[i] = piece / n if self.op == "mean" else piece
+        return jax.tree_util.tree_unflatten(treedef, out), state
+
+    def reduce_scatter_flat(self, g, ax: str, n: int):
+        nbytes = g.size * g.dtype.itemsize
+        if self.choose(nbytes) == "hierarchical":
+            return self.topology.reduce_scatter(g, ax) / n
+        return lax.psum_scatter(g, ax, tiled=True) / n
+
+    def plan(self, tree):
+        rows = super().plan(tree)
+        for b in rows:
+            algo = self.choose(b["bytes"])
+            b["algorithm"] = f"auto:{algo}"
+            b["wire_bytes"] = (
+                int(b["bytes"] * WIRE_ITEMSIZE["bf16"] / 4)
+                if algo == "quantized" else b["bytes"])
+            b["est_us"] = round(self._estimate(algo, b["bytes"]), 2)
+        return rows
+
+
+register_reducer("auto", AutoReducer)

@@ -1,0 +1,220 @@
+"""GradReducer — the strategy registry for gradient reduction.
+
+Reference: ChainerMN's communicator zoo (SURVEY.md §2.1) was a set of
+*algorithms* for turning per-rank gradients into reduced gradients —
+pure_nccl (one flat ring, optional fp16 comm), hierarchical (intra-node
+reduce → inter-node allreduce → intra-node bcast), two_dimensional
+(reduce-scatter / allreduce / all-gather). The TPU rebuild collapsed the
+*communicator* taxonomy into one mesh (comm/xla.py), but the *reduction
+algorithm* axis is real and hardware-visible: over DCN the message
+schedule, compression, and hierarchy of the gradient reduction are the
+tuning surface (HiCCL, arxiv 2408.05962; EQuARX, arxiv 2506.17615).
+
+A :class:`GradReducer` owns how a gradient pytree becomes a reduced
+gradient pytree *inside the compiled step*.  Strategies:
+
+==============  =====================================================
+``flat``        today's psum (``allreduce_grad``) — default, the
+                numerical reference
+``hierarchical``  bucket-fused reduce-scatter over the intra/ICI tier
+                → cross-inter allreduce → all-gather
+``quantized``   bf16/int8 per-bucket scaled allreduce with
+                error-feedback residuals carried as reducer state
+``auto``        bytes/hop-latency cost model picks one of the above
+                per bucket
+==============  =====================================================
+
+See docs/collectives.md for the catalogue and the cost model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.comm.xla import DEFAULT_DCN_BUCKET_BYTES, plan_buckets
+
+
+def varying_axes(leaf, axes: Sequence[str]) -> Tuple[str, ...]:
+    """The subset of ``axes`` the leaf still varies on.
+
+    Same probe as ``XlaCommunicator.allreduce_grad``: when shard_map's
+    varying-axis tracking is off (``check_rep=False`` on pre-vma jax),
+    every axis is reported — the conservative reduce-everything answer.
+    Must be called under a shard_map trace with ``axes`` bound.
+    """
+    if not jax.typeof(lax.axis_index(axes[0])).vma:
+        return tuple(axes)
+    vma = jax.typeof(leaf).vma
+    return tuple(a for a in axes if a in vma)
+
+
+class GradReducer:
+    """Base strategy: how a gradient pytree becomes a reduced one.
+
+    Subclasses implement :meth:`reduce` (and, when ``stateful``,
+    :meth:`init` / :meth:`init_global`).  ``op`` is ``'mean'`` (the
+    reference ``allreduce_grad`` contract) or ``'sum'``.
+
+    The contract mirrors an optax transformation, with the state
+    threaded explicitly so error-feedback residuals survive the step::
+
+        reduced, new_state = reducer.reduce(grads, state)
+
+    ``reduce`` must run inside the compiled (shard_map) step; the
+    collectives lower into the same program as the backward, and XLA's
+    latency-hiding scheduler overlaps them with adjacent compute.
+    """
+
+    name = "base"
+    #: True when :meth:`reduce` threads state (error-feedback residuals).
+    stateful = False
+
+    def __init__(self, comm, op: str = "mean",
+                 bucket_bytes: Optional[int] = None):
+        if op not in ("mean", "sum"):
+            raise ValueError(f"unsupported grad-reduction op: {op!r}")
+        self.comm = comm
+        self.op = op
+        self.bucket_bytes = (bucket_bytes if bucket_bytes is not None
+                             else (comm._bucket_bytes
+                                   or DEFAULT_DCN_BUCKET_BYTES))
+
+    # -- state ----------------------------------------------------------
+    def init(self, params):
+        """Per-rank reducer state for a grads-shaped pytree (the view a
+        single shard carries inside the compiled step). Stateless
+        strategies return ``()``."""
+        return ()
+
+    def init_global(self, params):
+        """Driver-level (global-view) reducer state: per-rank states
+        stacked on a leading ``comm.size`` axis, ready to be sharded
+        ``P(axis)`` into the step. Stateless strategies return ``()``."""
+        return ()
+
+    # -- the hot path ---------------------------------------------------
+    def reduce(self, grads, state=()):
+        raise NotImplementedError
+
+    def reduce_scatter_flat(self, g, ax: str, n: int):
+        """ZeRO-1/2 hook: mean-reduce-scatter one flat gradient vector
+        (length divisible by ``n``) so rank ``r`` holds tile ``r``.
+        The base implementation is today's flat path — subclasses that
+        decompose or compress override it, but must preserve the exact
+        tile-``r``-to-rank-``r`` layout (the ZeRO state layout depends
+        on it)."""
+        return lax.psum_scatter(g, ax, tiled=True) / n
+
+    # -- introspection --------------------------------------------------
+    def plan(self, tree) -> List[Dict[str, Any]]:
+        """Host-side bucket plan for a grads-shaped pytree (concrete or
+        abstract leaves): one dict per bucket with ``keys``, ``bytes``
+        (payload), ``wire_bytes`` (what actually crosses the wire),
+        ``algorithm``. Pure bookkeeping — safe off-device."""
+        leaves_kp, _ = jax.tree_util.tree_flatten_with_path(tree)
+        sized = []
+        for kp, leaf in leaves_kp:
+            key = jax.tree_util.keystr(kp)
+            dt = jnp.dtype(getattr(leaf, "dtype", jnp.float32))
+            nb = int(jnp.size(leaf)) * dt.itemsize
+            sized.append((key, nb))
+        out = []
+        for i, bucket in enumerate(plan_buckets(sized, self.bucket_bytes)):
+            sizes = dict(sized)
+            nb = sum(sizes[k] for k in bucket)
+            out.append({
+                "bucket": i,
+                "keys": list(bucket),
+                "bytes": nb,
+                "wire_bytes": self.wire_bytes(nb),
+                "algorithm": self.name,
+            })
+        return out
+
+    def wire_bytes(self, payload_bytes: int) -> int:
+        """Bytes this strategy actually moves for a payload (per rank,
+        one reduction). Compressing strategies override."""
+        return payload_bytes
+
+    def describe_rows(self, rows) -> List[str]:
+        """One human line per :meth:`plan` row (ReductionReport/bench)."""
+        out = []
+        for b in rows:
+            line = (
+                f"bucket {b['bucket']:>3}  {b['algorithm']:>12}  "
+                f"{b['bytes']:>12,} B payload  "
+                f"{b['wire_bytes']:>12,} B wire  {len(b['keys'])} leaves")
+            if "est_us" in b:
+                line += f"  ~{b['est_us']} us"
+            out.append(line)
+        return out
+
+    def describe(self, tree) -> str:
+        """One human line per bucket (used by ReductionReport/bench)."""
+        return "\n".join(self.describe_rows(self.plan(tree)))
+
+
+#: name -> GradReducer subclass (strategies self-register on import)
+REDUCERS: Dict[str, Type[GradReducer]] = {}
+
+
+def register_reducer(name: str, cls: Type[GradReducer]) -> None:
+    REDUCERS[name] = cls
+
+
+def make_grad_reducer(spec, comm, op: str = "mean", **kwargs) -> Optional[GradReducer]:
+    """Resolve a ``grad_reducer=`` argument.
+
+    ``spec`` may be ``None`` (no reducer — callers keep their legacy
+    path), an already-constructed :class:`GradReducer` (returned as-is),
+    or a registered strategy name (``'flat' | 'hierarchical' |
+    'quantized' | 'auto'``) with ``kwargs`` forwarded to the
+    constructor.
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, GradReducer):
+        return spec
+    try:
+        cls = REDUCERS[spec]
+    except KeyError:
+        raise ValueError(
+            f"unknown grad_reducer {spec!r}; registered strategies: "
+            f"{sorted(REDUCERS)}") from None
+    return cls(comm, op=op, **kwargs)
+
+
+def group_leaves_for_buckets(leaves, axes, bucket_bytes,
+                             comm_dtype_of=None):
+    """Shared bucket grouping: leaves are grouped by (varying axes,
+    communication dtype) — only same-typed leaves share a flat buffer —
+    then packed greedily in pytree order (:func:`plan_buckets`, same
+    rule as ``XlaCommunicator._bucketed_allreduce_grad``).
+
+    Returns ``(passthrough, groups)`` where ``passthrough`` is the list
+    of leaf indices with no varying axis (already global sums under vma
+    tracking — they skip communication) and ``groups`` maps
+    ``(varying_axes, dtype)`` to a list of buckets (lists of leaf
+    indices).
+    """
+    from collections import defaultdict
+
+    passthrough, by_type = [], defaultdict(list)
+    for i, l in enumerate(leaves):
+        va = varying_axes(l, axes)
+        if not va:
+            passthrough.append(i)
+            continue
+        cdt = jnp.dtype(comm_dtype_of(l) if comm_dtype_of else l.dtype)
+        by_type[(va, cdt)].append(i)
+    groups = {}
+    for key, idxs in by_type.items():
+        cdt = key[1]
+        groups[key] = plan_buckets(
+            [(i, leaves[i].size * cdt.itemsize) for i in idxs],
+            bucket_bytes)
+    return passthrough, groups

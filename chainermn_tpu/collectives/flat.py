@@ -1,0 +1,25 @@
+"""The flat strategy — today's psum, the numerical reference.
+
+Reference: pure_nccl_communicator.py — pack, ONE ring allreduce, unpack.
+Here it simply delegates to ``XlaCommunicator.allreduce_grad``, so
+``grad_reducer='flat'`` is **bit-identical** to not passing a reducer at
+all (same primitives in the same order; the acceptance bar for every
+other strategy is measured against this one).
+"""
+
+from __future__ import annotations
+
+from chainermn_tpu.collectives.base import GradReducer, register_reducer
+
+
+class FlatReducer(GradReducer):
+    """One flat (bucketed, if the communicator buckets) psum per leaf
+    group — exactly ``comm.allreduce_grad``."""
+
+    name = "flat"
+
+    def reduce(self, grads, state=()):
+        return self.comm.allreduce_grad(grads, self.op), state
+
+
+register_reducer("flat", FlatReducer)

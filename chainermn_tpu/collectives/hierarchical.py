@@ -1,0 +1,181 @@
+"""Hierarchical two-level reduction: intra-tier reduce-scatter →
+inter-tier allreduce → intra-tier all-gather.
+
+Reference: hierarchical_communicator.py (intra-node NCCL reduce →
+inter-node MPI allreduce → intra-node NCCL bcast) and
+two_dimensional_communicator.py (reduce-scatter / allreduce /
+all-gather) — the composition HiCCL (arxiv 2408.05962) generalizes:
+shrink the payload on the fast tier (ICI) before it crosses the slow
+tier (DCN), so each inter link carries ``1/intra`` of the gradient.
+
+Two topology sources:
+
+* the communicator spans ≥ 2 mesh axes (the ``('dcn', 'ici')`` mesh the
+  ``hierarchical``/``two_dimensional`` factory aliases build): last axis
+  is the intra/ICI tier, the rest the inter tier;
+* a single-axis communicator: the axis is factored into
+  ``inter × intra`` contiguous blocks addressed with
+  ``axis_index_groups`` (``intra`` defaults to ``comm.intra_size`` when
+  that properly divides the axis — override with ``intra=``).
+
+Numerics: the three-phase sum visits addends in a different order than
+one flat psum, so float results can differ in the last ulp (observed
+4.8e-7 on the 8-device CPU mesh); on integer-valued floats ("sum-
+reducible" payloads) it is bitwise identical to ``flat`` — that is the
+exact-parity contract tests/collectives_tests/test_reducers.py pins.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.collectives.base import (
+    GradReducer,
+    group_leaves_for_buckets,
+    register_reducer,
+)
+
+
+class HierTopology:
+    """Resolved two-tier topology over a communicator's mesh axes."""
+
+    def __init__(self, comm, intra: Optional[int] = None):
+        axes = comm.axis_names
+        self.n = comm.size
+        if len(axes) >= 2 and intra is None:
+            # ('dcn', 'ici')-style mesh: last axis is the fast tier
+            self.mode = "axes"
+            self.intra_ax = axes[-1]
+            self.inter_axes = tuple(axes[:-1])
+            sizes = dict(zip(comm.mesh.axis_names, comm.mesh.devices.shape))
+            self.intra = sizes[self.intra_ax]
+            self.inter = self.n // self.intra
+            return
+        if len(axes) != 1:
+            raise ValueError(
+                "explicit intra= factoring needs a single-axis "
+                f"communicator, got axes {axes}")
+        self.mode = "groups"
+        self.ax = axes[0]
+        n = self.n
+        if intra is None:
+            intra = comm.intra_size
+            if not (1 <= intra <= n and n % intra == 0):
+                intra = n  # degenerate: one tier (still rs → ag)
+        if not (1 <= intra <= n and n % intra == 0):
+            raise ValueError(
+                f"intra {intra} must divide communicator size {n}")
+        self.intra = intra
+        self.inter = n // intra
+        # rank d = g * intra + j: intra group g walks j, inter group j
+        # walks g (validated bitwise vs flat psum on the CPU mesh)
+        self.intra_groups = [
+            [g * intra + j for j in range(intra)] for g in range(self.inter)]
+        self.inter_groups = [
+            [j + g * intra for g in range(self.inter)] for j in range(intra)]
+
+    # -- kernels (flat f32/bf16 vectors, inside shard_map) --------------
+
+    def allreduce(self, v):
+        """reduce-scatter(intra) → allreduce(inter) → all-gather(intra)
+        of a flat vector; pads to a multiple of ``intra`` internally."""
+        size = v.size
+        pad = (-size) % self.intra
+        if pad:
+            v = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+        if self.mode == "axes":
+            s = lax.psum_scatter(v, self.intra_ax, tiled=True)
+            if self.inter > 1:
+                s = lax.psum(s, self.inter_axes)
+            out = lax.all_gather(s, self.intra_ax, tiled=True)
+        else:
+            s = lax.psum_scatter(v, self.ax,
+                                 axis_index_groups=self.intra_groups,
+                                 tiled=True)
+            if self.inter > 1:
+                s = lax.psum(s, self.ax,
+                             axis_index_groups=self.inter_groups)
+            out = lax.all_gather(s, self.ax,
+                                 axis_index_groups=self.intra_groups,
+                                 tiled=True)
+        return out[:size] if pad else out
+
+    def reduce_scatter(self, g, ax: str):
+        """Two-stage reduce-scatter of a flat vector whose length
+        divides ``n``; rank ``r`` ends with tile ``r`` — the EXACT
+        layout of one flat ``psum_scatter`` (ZeRO state depends on it).
+
+        Stage order is inter-first: scattering the inter tier first is
+        the only order whose composed tile layout matches the flat one
+        without a data permutation (the intra-first order lands tile
+        ``j*inter + g`` on rank ``g*intra + j``). The inter stage
+        therefore still carries the full vector across the slow tier —
+        the hierarchy here buys schedule granularity, not DCN bytes;
+        the byte win belongs to :meth:`allreduce` (the DP path).
+        """
+        if self.mode != "groups" or self.inter == 1:
+            return lax.psum_scatter(g, ax, tiled=True)
+        s = lax.psum_scatter(g, ax, axis_index_groups=self.inter_groups,
+                             tiled=True)
+        return lax.psum_scatter(s, ax, axis_index_groups=self.intra_groups,
+                                tiled=True)
+
+
+class HierarchicalReducer(GradReducer):
+    """Bucket-fused two-level allreduce (see module docstring).
+
+    Args (beyond the base): ``intra`` — explicit fast-tier width for
+    single-axis communicators (e.g. ``intra=4`` factors the 8-device CPU
+    mesh into 2 inter-groups of 4); defaults to ``comm.intra_size``.
+    """
+
+    name = "hierarchical"
+
+    def __init__(self, comm, op: str = "mean",
+                 bucket_bytes: Optional[int] = None,
+                 intra: Optional[int] = None):
+        super().__init__(comm, op, bucket_bytes)
+        self.topology = HierTopology(comm, intra=intra)
+
+    def reduce(self, grads, state=()):
+        comm = self.comm
+        axes = comm.axis_names
+        cdt = comm._grad_dtype
+        n = comm.size
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        out = [None] * len(leaves)
+        passthrough, groups = group_leaves_for_buckets(
+            leaves, axes, self.bucket_bytes,
+            comm_dtype_of=(lambda l: cdt) if cdt is not None else None)
+        for i in passthrough:  # already global sums under vma tracking
+            out[i] = leaves[i] / n if self.op == "mean" else leaves[i]
+        for (va, comm_dtype), buckets in groups.items():
+            full_tier = tuple(va) == tuple(axes)
+            for bucket in buckets:
+                flat = jnp.concatenate(
+                    [leaves[i].astype(comm_dtype).ravel() for i in bucket])
+                if full_tier:
+                    red = self.topology.allreduce(flat)
+                else:
+                    # leaf varies on a strict subset of the comm axes —
+                    # no two-tier structure to exploit; flat psum over
+                    # the varying subset (correct, and rare)
+                    red = lax.psum(flat, va)
+                off = 0
+                for i in bucket:
+                    l = leaves[i]
+                    piece = red[off:off + l.size].reshape(l.shape).astype(
+                        l.dtype)
+                    off += l.size
+                    out[i] = piece / n if self.op == "mean" else piece
+        return jax.tree_util.tree_unflatten(treedef, out), state
+
+    def reduce_scatter_flat(self, g, ax: str, n: int):
+        return self.topology.reduce_scatter(g, ax) / n
+
+
+register_reducer("hierarchical", HierarchicalReducer)

@@ -1,0 +1,211 @@
+"""Quantized allreduce with error feedback.
+
+Reference: pure_nccl_communicator.py's ``allreduce_grad_dtype`` (fp16
+communication for fp32 parameters) is the lossy-compression end of the
+communicator zoo; EQuARX (arxiv 2506.17615) shows block-scaled
+quantized allreduce inside XLA recovering near-full model quality at
+about half the communication bytes.
+
+Two wire formats:
+
+* ``bf16`` — cast the (error-compensated) gradient to bfloat16 and
+  psum in bf16: half the wire bytes, rounding error ~2^-8;
+* ``int8`` — per-bucket global scale ``pmax(|g|)/127``, symmetric
+  round-to-nearest, accumulate the allreduce in int32 (no overflow up
+  to 2^24 ranks), dequantize with the shared scale: quarter the wire
+  bytes.
+
+**Error feedback** (``ef=True``, the default): the quantization
+residual ``e = g' - dequant(quant(g'))`` is carried as explicit reducer
+state and re-injected next step (``g' = g + e``), so compression error
+accumulates into the *next* update instead of being lost — the
+difference between a convergent and a visibly-degraded run
+(tests/collectives_tests/test_reducers.py measures both). The residual
+is PER-RANK state: globally it is a ``(comm.size, bucket_len)`` array
+sharded over the comm axis, threaded through the train step inside the
+optimizer state (``create_multi_node_optimizer`` wraps it;
+``make_data_parallel_train_step`` shards it), and it rides checkpoints
+like any other optimizer-state leaf.
+
+The bucket plan is a pure function of leaf shapes/dtypes (NOT of
+varying-axis types), so the state structure is stable across traces and
+checkpoint round-trips. Leaves that are already global sums under vma
+tracking are pre-scaled by the over-count factor and psummed with the
+rest of their bucket — algebraically the identity, so one static plan
+serves both vma modes.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.collectives.base import (
+    GradReducer,
+    register_reducer,
+    varying_axes,
+)
+from chainermn_tpu.comm.xla import plan_buckets
+from chainermn_tpu.utils import match_vma
+
+WIRE_ITEMSIZE = {"bf16": 2, "int8": 1}
+
+
+def quantize_allreduce(v, axes, mode: str):
+    """Quantized psum of a flat float vector over ``axes``.
+
+    Returns ``(reduced_sum, local_dequant)`` — the second output is this
+    rank's dequantized contribution, which error feedback subtracts from
+    the pre-quantization value to form the residual.
+    """
+    dt = v.dtype
+    if mode == "bf16":
+        q = v.astype(jnp.bfloat16)
+        return lax.psum(q, axes).astype(dt), q.astype(dt)
+    if mode == "int8":
+        amax = lax.pmax(jnp.max(jnp.abs(v)), axes)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(dt)
+        q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int32)
+        return lax.psum(q, axes).astype(dt) * scale, q.astype(dt) * scale
+    raise ValueError(f"unknown quantization mode {mode!r}")
+
+
+class QuantizedReducer(GradReducer):
+    """Per-bucket scaled quantized allreduce with error feedback.
+
+    Args (beyond the base): ``mode`` — ``'bf16'`` (default) or
+    ``'int8'``; ``ef`` — carry error-feedback residuals (default True;
+    ``ef=False`` is stateless — usable in the ZeRO reduce-scatter paths,
+    and the degraded baseline the convergence tests compare against).
+    """
+
+    name = "quantized"
+
+    def __init__(self, comm, op: str = "mean",
+                 bucket_bytes: Optional[int] = None,
+                 mode: str = "bf16", ef: bool = True):
+        super().__init__(comm, op, bucket_bytes)
+        if mode not in WIRE_ITEMSIZE:
+            raise ValueError(f"unknown quantization mode {mode!r}")
+        self.mode = mode
+        self.ef = ef
+        self.stateful = bool(ef)
+
+    # -- the static bucket plan -----------------------------------------
+    def _plan(self, leaves) -> List[Tuple[jnp.dtype, bool, List[int]]]:
+        """``[(dtype, quantize?, [leaf indices])]`` — groups leaves by
+        dtype in pytree order; non-float leaves take an exact psum (a
+        quantized integer gradient is nonsense) and carry no residual."""
+        by_dt = defaultdict(list)
+        for i, l in enumerate(leaves):
+            by_dt[jnp.dtype(l.dtype)].append(i)
+        plan = []
+        for dt, idxs in by_dt.items():
+            quant = bool(jnp.issubdtype(dt, jnp.floating))
+            for bucket in plan_buckets(
+                    [(i, leaves[i].size * dt.itemsize) for i in idxs],
+                    self.bucket_bytes):
+                plan.append((dt, quant, bucket))
+        return plan
+
+    def _bucket_lens(self, params):
+        leaves = jax.tree_util.tree_leaves(params)
+        return [(dt, sum(leaves[i].size for i in b))
+                for dt, quant, b in self._plan(leaves) if quant]
+
+    def init(self, params):
+        if not self.ef:
+            return ()
+        return tuple(jnp.zeros((ln,), dt)
+                     for dt, ln in self._bucket_lens(params))
+
+    def init_global(self, params):
+        if not self.ef:
+            return ()
+        n = self.comm.size
+        return tuple(jnp.zeros((n, ln), dt)
+                     for dt, ln in self._bucket_lens(params))
+
+    # -- the hot path ---------------------------------------------------
+    def reduce(self, grads, state=()):
+        comm = self.comm
+        axes = comm.axis_names
+        n = comm.size
+        sizes = dict(zip(comm.mesh.axis_names, comm.mesh.devices.shape))
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        plan = self._plan(leaves)
+        if self.ef:
+            n_q = sum(1 for _, q, _ in plan if q)
+            if len(state) != n_q:
+                raise ValueError(
+                    f"quantized reducer state has {len(state)} residual "
+                    f"buckets but the gradient tree plans {n_q}; was the "
+                    "state initialized against a different model?")
+        # full-variance template: pre-scaled invariant leaves are pcast
+        # onto it so the whole bucket psums over every comm axis
+        tmpl = sum(lax.axis_index(a) for a in axes)
+        out = [None] * len(leaves)
+        new_state, si = [], 0
+        for dt, quant, bucket in plan:
+            parts = []
+            for i in bucket:
+                l = leaves[i]
+                va = varying_axes(l, axes)
+                # psum over ALL axes over-counts an invariant axis by its
+                # size — pre-divide so the bucket psum is the global sum
+                m = n // math.prod([sizes[a] for a in va] or [1])
+                v = l.ravel().astype(dt)
+                if m > 1:
+                    v = v / m
+                parts.append(match_vma(v, tmpl))
+            flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            if quant:
+                if self.ef:
+                    flat = flat + state[si]
+                red, local_deq = quantize_allreduce(flat, axes, self.mode)
+                if self.ef:
+                    new_state.append(flat - local_deq)
+                    si += 1
+            else:
+                red = lax.psum(flat, axes)
+            off = 0
+            for i in bucket:
+                l = leaves[i]
+                piece = red[off:off + l.size].reshape(l.shape).astype(
+                    l.dtype)
+                off += l.size
+                out[i] = piece / n if self.op == "mean" else piece
+        return (jax.tree_util.tree_unflatten(treedef, out),
+                tuple(new_state) if self.ef else state)
+
+    def reduce_scatter_flat(self, g, ax: str, n: int):
+        if self.ef:
+            raise RuntimeError(
+                "QuantizedReducer(ef=True) carries per-rank residual "
+                "state, which the ZeRO flat-vector paths cannot thread; "
+                "use ef=False here, or the data-parallel step "
+                "(make_data_parallel_train_step) for error feedback")
+        dt = g.dtype
+        if self.mode == "bf16":
+            s = lax.psum_scatter(g.astype(jnp.bfloat16), ax, tiled=True)
+            return s.astype(dt) / n
+        amax = lax.pmax(jnp.max(jnp.abs(g)), ax)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(dt)
+        q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int32)
+        return lax.psum_scatter(q, ax, tiled=True).astype(dt) * scale / n
+
+    def wire_bytes(self, payload_bytes: int) -> int:
+        # payload is in the leaf dtype (4 B f32 typical); the wire
+        # carries the quantized format (+ nothing for bf16's implicit
+        # scale, + one f32 scale per bucket for int8)
+        ratio = WIRE_ITEMSIZE[self.mode] / 4.0
+        extra = 4 if self.mode == "int8" else 0
+        return int(payload_bytes * ratio) + extra
+
+
+register_reducer("quantized", QuantizedReducer)

@@ -42,11 +42,39 @@ class _DoubleBufferState(NamedTuple):
     is_first: Any    # scalar flag; first step applies zeros
 
 
+class _ReducerWrappedState(NamedTuple):
+    """Optimizer state carrying explicit reducer state (error-feedback
+    residuals) alongside the inner optimizer's. Only STATEFUL reducers
+    introduce this wrapper — the default/stateless paths keep the inner
+    state layout byte-for-byte, so existing checkpoints stay valid.
+
+    Inside the compiled step ``reducer`` holds the per-rank view; at the
+    driver level it holds the per-rank states stacked on a leading
+    ``comm.size`` axis (``make_data_parallel_train_step`` shards and
+    (un)stacks it around the update — the residuals are genuinely
+    per-rank data, unlike the replicated inner state)."""
+
+    inner: Any
+    reducer: Any
+
+
+class MultiNodeOptimizer(NamedTuple):
+    """Duck-types :class:`optax.GradientTransformation` (same
+    ``init``/``update`` fields — optax composes by duck typing) while
+    exposing the bound :class:`~chainermn_tpu.collectives.GradReducer`
+    so step factories can shard its state."""
+
+    init: Any
+    update: Any
+    grad_reducer: Any = None
+
+
 def create_multi_node_optimizer(
     actual_optimizer: optax.GradientTransformation,
     communicator: CommunicatorBase,
     double_buffering: bool = False,
     op: str = "mean",
+    grad_reducer: Any = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with the gradient all-reduce.
 
@@ -60,41 +88,89 @@ def create_multi_node_optimizer(
     the all-reduce compiles into the program. ``allreduce_grad`` is
     varying-axis-aware (see XlaCommunicator.allreduce_grad), so this is safe
     both when autodiff already summed the gradients and when it did not.
+
+    ``grad_reducer`` selects the reduction strategy (the reference's
+    communicator-zoo axis, docs/collectives.md): ``None`` (default) and
+    ``'flat'`` are today's psum — bit-identical; ``'hierarchical'``,
+    ``'quantized'``, ``'auto'``, or a constructed
+    :class:`~chainermn_tpu.collectives.GradReducer` instance select the
+    two-level, error-feedback-quantized, or cost-model strategies. A
+    STATEFUL reducer (quantized with error feedback) changes the state
+    layout to :class:`_ReducerWrappedState` and must be initialized at
+    the driver level (``opt.init(params)`` outside jit) — the residuals
+    are per-rank and ride the optimizer state through the step and
+    through checkpoints.
     """
-    if not double_buffering:
+    from chainermn_tpu.collectives import make_grad_reducer
 
-        def init(params):
-            return actual_optimizer.init(params)
+    reducer = make_grad_reducer(grad_reducer, communicator, op=op)
+    stateful = bool(reducer is not None and reducer.stateful)
 
-        def update(grads, state, params=None, **extra):
-            grads = communicator.allreduce_grad(grads, op)
-            return actual_optimizer.update(grads, state, params, **extra)
-
-        return optax.GradientTransformation(init, update)
+    if reducer is None:
+        def reduce_fn(grads, rstate):
+            return communicator.allreduce_grad(grads, op), rstate
+    else:
+        reduce_fn = reducer.reduce
 
     import jax.numpy as jnp
 
-    def init_db(params):
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-        return _DoubleBufferState(
-            inner=actual_optimizer.init(params),
-            prev_grads=zeros,
-            is_first=jnp.array(True),
+    if not double_buffering:
+
+        def inner_init(params):
+            return actual_optimizer.init(params)
+
+        def inner_update(grads, state, params=None, **extra):
+            # state here is the INNER state; grads are already reduced
+            return actual_optimizer.update(grads, state, params, **extra)
+
+    else:
+
+        def inner_init(params):
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            return _DoubleBufferState(
+                inner=actual_optimizer.init(params),
+                prev_grads=zeros,
+                is_first=jnp.array(True),
+            )
+
+        def inner_update(reduced, state, params=None, **extra):
+            # Reference semantics (_DoubleBufferingOptimizer): apply step
+            # t-1's reduced grads while step t's reduction is in flight;
+            # first step applies nothing. In one compiled program "in
+            # flight" is the XLA scheduler's overlap; the visible
+            # semantic is the one-step lag.
+            apply = jax.tree_util.tree_map(
+                lambda p: jnp.where(state.is_first, jnp.zeros_like(p), p),
+                state.prev_grads,
+            )
+            updates, inner = actual_optimizer.update(
+                apply, state.inner, params, **extra)
+            return updates, _DoubleBufferState(
+                inner=inner, prev_grads=reduced, is_first=jnp.array(False)
+            )
+
+    if not stateful:
+
+        def init(params):
+            return inner_init(params)
+
+        def update(grads, state, params=None, **extra):
+            grads, _ = reduce_fn(grads, ())
+            return inner_update(grads, state, params, **extra)
+
+        if reducer is None:
+            return optax.GradientTransformation(init, update)
+        return MultiNodeOptimizer(init, update, reducer)
+
+    def init_st(params):
+        return _ReducerWrappedState(
+            inner=inner_init(params),
+            reducer=reducer.init_global(params),
         )
 
-    def update_db(grads, state, params=None, **extra):
-        # Reference semantics (_DoubleBufferingOptimizer): apply step t-1's
-        # reduced grads while step t's reduction is in flight; first step
-        # applies nothing. In one compiled program "in flight" is the XLA
-        # scheduler's overlap; the visible semantic is the one-step lag.
-        reduced = communicator.allreduce_grad(grads, op)
-        apply = jax.tree_util.tree_map(
-            lambda p: jnp.where(state.is_first, jnp.zeros_like(p), p),
-            state.prev_grads,
-        )
-        updates, inner = actual_optimizer.update(apply, state.inner, params, **extra)
-        return updates, _DoubleBufferState(
-            inner=inner, prev_grads=reduced, is_first=jnp.array(False)
-        )
+    def update_st(grads, state, params=None, **extra):
+        grads, rstate = reduce_fn(grads, state.reducer)
+        updates, inner = inner_update(grads, state.inner, params, **extra)
+        return updates, _ReducerWrappedState(inner=inner, reducer=rstate)
 
-    return optax.GradientTransformation(init_db, update_db)
+    return MultiNodeOptimizer(init_st, update_st, reducer)

@@ -36,6 +36,33 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import PartitionSpec as P
 
 
+def _resolve_rs(grad_reducer, comm) -> Optional[Callable]:
+    """Resolve a ``grad_reducer=`` argument into the flat-vector
+    mean-reduce-scatter callable the ZeRO steps use, or ``None`` for the
+    legacy inline ``psum_scatter / n`` (bit-identical default).
+
+    Only STATELESS reducers fit here: the ZeRO flat-vector paths cannot
+    thread per-rank residual state (use ``QuantizedReducer(ef=False)``,
+    or the data-parallel step for error feedback). Every strategy must
+    preserve the tile-``r``-to-rank-``r`` scatter layout — the sharded
+    optimizer state depends on it (``GradReducer.reduce_scatter_flat``).
+    """
+    from chainermn_tpu.collectives import make_grad_reducer
+
+    reducer = make_grad_reducer(grad_reducer, comm, op="mean")
+    if reducer is None:
+        return None
+    if reducer.stateful:
+        raise ValueError(
+            f"grad_reducer {reducer.name!r} is stateful (error-feedback "
+            "residuals are per-rank state the ZeRO flat-vector paths "
+            "cannot thread); pass QuantizedReducer(ef=False) here, or "
+            "use make_data_parallel_train_step for error feedback")
+    ax = comm.axis_name
+    n = comm.size
+    return lambda g: reducer.reduce_scatter_flat(g, ax, n)
+
+
 def _require_elementwise(optimizer, params) -> None:
     """Refuse optimizers the flat ZeRO layouts would silently mis-train.
 
@@ -252,6 +279,7 @@ def make_zero1_train_step(
     loss_fn: Optional[Callable] = None,
     donate: bool = True,
     bucket_bytes: Optional[int] = None,
+    grad_reducer=None,
 ) -> Tuple[Callable, Tuple]:
     """Build a jitted ZeRO-1 data-parallel train step and its initial state.
 
@@ -292,6 +320,11 @@ def make_zero1_train_step(
     identical; the STATE LAYOUT is not — pass the same ``bucket_bytes``
     to :func:`zero1_params` and keep it fixed across snapshot
     save/restore.
+
+    ``grad_reducer``: reduction strategy for the gradient reduce-scatter
+    (docs/collectives.md). Default ``None`` is today's flat
+    ``psum_scatter`` — bit-identical. Stateless strategies only (see
+    :func:`_resolve_rs`).
     """
     from chainermn_tpu.training.step import classifier_loss
 
@@ -302,10 +335,13 @@ def make_zero1_train_step(
     n = comm.size
     axes = comm.axis_names
     dspec = P(ax)
+    rs = (_resolve_rs(grad_reducer, comm)
+          # dlint: disable=DL106 — this IS the reducer plumbing
+          or (lambda g: lax.psum_scatter(g, ax, tiled=True) / n))
 
     if bucket_bytes is not None:
         return _make_zero1_bucketed(model, optimizer, comm, params, lf,
-                                    donate, bucket_bytes)
+                                    donate, bucket_bytes, rs)
 
     flat, unravel = ravel_pytree(params)
     total = flat.size
@@ -352,7 +388,7 @@ def make_zero1_train_step(
         g = ravel_pytree(grads)[0]
         if padded != total:
             g = jnp.concatenate([g, jnp.zeros((padded - total,), g.dtype)])
-        g_shard = lax.psum_scatter(g, ax, tiled=True) / n
+        g_shard = rs(g)
         updates, opt_state = optimizer.update(g_shard, opt_state, p_shard)
         p_shard = optax.apply_updates(p_shard, updates)
         metrics = {
@@ -409,7 +445,7 @@ def _bucketed_init(optimizer, comm, params, bucket_bytes):
 
 
 def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
-                         bucket_bytes):
+                         bucket_bytes, rs):
     """Bucketed ZeRO-1 (see ``make_zero1_train_step(bucket_bytes=...)``).
 
     Per step, per bucket: ``psum_scatter`` the bucket's padded gradient
@@ -440,10 +476,7 @@ def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
             return loss, acc
 
         (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(p)
-        g_shards = tuple(
-            lax.psum_scatter(g, ax, tiled=True) / n
-            for g in layout.pack_buckets(grads)
-        )
+        g_shards = tuple(rs(g) for g in layout.pack_buckets(grads))
         updates, opt_state = optimizer.update(g_shards, opt_state,
                                               p_shards)
         p_shards = optax.apply_updates(p_shards, updates)
@@ -473,6 +506,7 @@ def make_zero2_train_step(
     loss_fn: Optional[Callable] = None,
     donate: bool = True,
     bucket_bytes: Optional[int] = None,
+    grad_reducer=None,
 ) -> Tuple[Callable, Tuple]:
     """ZeRO-2: ZeRO-1 plus a SHARDED gradient accumulator.
 
@@ -501,7 +535,7 @@ def make_zero2_train_step(
     if bucket_bytes is not None:
         return _make_zero2_bucketed(model, optimizer, comm, params,
                                     n_microbatches, loss_fn, donate,
-                                    bucket_bytes)
+                                    bucket_bytes, grad_reducer)
     from chainermn_tpu.training.step import classifier_loss
 
     lf = loss_fn or classifier_loss
@@ -511,6 +545,9 @@ def make_zero2_train_step(
     axes = comm.axis_names
     dspec = P(ax)
     m = n_microbatches
+    rs = (_resolve_rs(grad_reducer, comm)
+          # dlint: disable=DL106 — this IS the reducer plumbing
+          or (lambda g: lax.psum_scatter(g, ax, tiled=True) / n))
 
     flat, unravel = ravel_pytree(params)
     total = flat.size
@@ -562,7 +599,7 @@ def make_zero2_train_step(
                 g = jnp.concatenate(
                     [g, jnp.zeros((padded - total,), g.dtype)])
             # the full-size g dies here; only the 1/N shard accumulates
-            acc = acc + lax.psum_scatter(g, ax, tiled=True) / n
+            acc = acc + rs(g)
             return (acc, loss_a + loss, acc_a + a), None
 
         from chainermn_tpu.utils import match_vma
@@ -592,7 +629,7 @@ def make_zero2_train_step(
 
 
 def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
-                         loss_fn, donate, bucket_bytes):
+                         loss_fn, donate, bucket_bytes, grad_reducer=None):
     """Bucketed ZeRO-2 (see ``make_zero2_train_step(bucket_bytes=...)``)."""
     from chainermn_tpu.training.step import classifier_loss
     from chainermn_tpu.utils import match_vma as _mv
@@ -604,6 +641,9 @@ def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
     axes = comm.axis_names
     dspec = P(ax)
     m = n_microbatches
+    rs = (_resolve_rs(grad_reducer, comm)
+          # dlint: disable=DL106 — this IS the reducer plumbing
+          or (lambda g: lax.psum_scatter(g, ax, tiled=True) / n))
 
     layout, shard_specs, opt_specs, state = _bucketed_init(
         optimizer, comm, params, bucket_bytes)
@@ -631,7 +671,7 @@ def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
             # each full-size BUCKET dies right here; only 1/N shards
             # persist across the accumulation window
             accs = tuple(
-                acc + lax.psum_scatter(g, ax, tiled=True) / n
+                acc + rs(g)
                 for acc, g in zip(accs, layout.pack_buckets(grads)))
             return (accs, loss_a + loss, acc_a + a), None
 
@@ -824,6 +864,7 @@ def make_fsdp_train_step(
     donate: bool = True,
     remat=False,
     param_shardings=None,
+    grad_reducer=None,
 ) -> Tuple[Callable, Tuple]:
     """ZeRO-3 (FSDP) data-parallel train step: parameters AND optimizer
     state live sharded over the data axis; every use gathers just-in-time.
@@ -861,6 +902,17 @@ def make_fsdp_train_step(
     dim, which silently defeats :func:`fsdp_scan_apply`'s per-layer
     liveness bound.
 
+    ``grad_reducer``: here the GSPMD partitioner owns the gradient
+    collectives (that is the point of the annotation-driven style), so
+    ``'flat'``/``'hierarchical'``/``'auto'`` are the IDENTITY — the
+    decomposition of the partitioner-inserted reduce-scatter is XLA's
+    choice, not ours. What CAN be expressed in the global view is the
+    wire-format numerics: a stateless ``QuantizedReducer(ef=False)``
+    applies its quantize→dequantize round-trip to each gradient leaf
+    (every rank computes the identical global scale, so the global-view
+    transform equals the per-rank wire compression). Stateful reducers
+    (error feedback) raise — use ``make_data_parallel_train_step``.
+
     Returns ``(step, state)`` with ``state = (params, opt_state)`` sharded;
     use :func:`fsdp_gather_params` to re-assemble for export. Models with
     mutable collections (BN stats) should use
@@ -873,6 +925,18 @@ def make_fsdp_train_step(
     lf = loss_fn or classifier_loss
     mesh = comm.mesh
     ax = comm.axis_name
+
+    from chainermn_tpu.collectives import make_grad_reducer
+
+    reducer = make_grad_reducer(grad_reducer, comm, op="mean")
+    if reducer is not None and reducer.stateful:
+        raise ValueError(
+            f"grad_reducer {reducer.name!r} is stateful (error-feedback "
+            "residuals); the FSDP step has no per-rank state to thread "
+            "them through. Pass QuantizedReducer(ef=False), or use "
+            "make_data_parallel_train_step for error feedback.")
+    quant_mode = getattr(reducer, "mode", None) if (
+        reducer is not None and reducer.name == "quantized") else None
 
     if param_shardings is None:
         stacked_at = _find_stacked_subtree(params, comm.size)
@@ -943,10 +1007,24 @@ def make_fsdp_train_step(
         policy = None if remat is True else remat
         f = jax.checkpoint(f, policy=policy)
 
+    def _wire_roundtrip(g):
+        # global-view stand-in for the quantized wire format: identical
+        # on every rank, so == quantizing each rank's shard on the wire
+        if not jnp.issubdtype(g.dtype, jnp.floating):
+            return g
+        if quant_mode == "bf16":
+            return g.astype(jnp.bfloat16).astype(g.dtype)
+        amax = jnp.max(jnp.abs(g))
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(g.dtype)
+        q = jnp.clip(jnp.round(g / scale), -127, 127)
+        return q.astype(g.dtype) * scale
+
     def local_step(state, x, y):
         p, opt_state = state
         (loss, acc), grads = jax.value_and_grad(
             f, has_aux=True)(p, x, y)
+        if quant_mode is not None:
+            grads = jax.tree_util.tree_map(_wire_roundtrip, grads)
         updates, opt_state = optimizer.update(grads, opt_state, p)
         p = optax.apply_updates(p, updates)
         return (p, opt_state), {"main/loss": loss, "main/accuracy": acc}

@@ -24,6 +24,47 @@ class LogReport:
                 f.write(json.dumps(obs) + "\n")
 
 
+class ReductionReport:
+    """Surfaces the gradient-reduction plan (docs/collectives.md).
+
+    Attach like LogReport. On the first call it prints the reducer's
+    per-bucket plan once — algorithm, payload bytes, wire bytes — and on
+    every call it folds the aggregate totals into
+    ``trainer.observation`` (``comm/bytes``, ``comm/wire_bytes``,
+    ``comm/strategy``) so LogReport/PrintReport pick them up.
+
+    ``reducer`` is a :class:`~chainermn_tpu.collectives.GradReducer`;
+    ``grads_template`` any pytree with the gradient leaves' shapes and
+    dtypes (the params tree works). The plan is host-side metadata — no
+    device computation happens here.
+    """
+
+    def __init__(self, reducer, grads_template, quiet: bool = False):
+        self.reducer = reducer
+        self.rows = [] if reducer is None else reducer.plan(grads_template)
+        self.quiet = quiet
+        self._printed = False
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(r["bytes"] for r in self.rows)
+
+    @property
+    def total_wire_bytes(self) -> int:
+        return sum(r["wire_bytes"] for r in self.rows)
+
+    def __call__(self, trainer):
+        if self.reducer is None:
+            return
+        if not self._printed and not self.quiet:
+            for line in self.reducer.describe_rows(self.rows):
+                print(line, flush=True)
+            self._printed = True
+        trainer.observation["comm/bytes"] = self.total_bytes
+        trainer.observation["comm/wire_bytes"] = self.total_wire_bytes
+        trainer.observation["comm/strategy"] = self.reducer.name
+
+
 class PrintReport:
     def __init__(self, keys: List[str]):
         self.keys = keys

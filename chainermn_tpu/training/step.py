@@ -100,12 +100,29 @@ def make_data_parallel_train_step(
     axes = comm.axis_names
     dspec = P(axes if len(axes) > 1 else axes[0])
 
+    # A stateful GradReducer (quantized with error feedback) threads
+    # per-rank residuals through the optimizer state: their stacked
+    # (comm.size, ...) leaves are sharded over the comm axis and
+    # (un)stacked around the update — everything else about the step is
+    # identical, and the stateless path below compiles the exact same
+    # program as before this knob existed.
+    reducer = getattr(optimizer, "grad_reducer", None)
+    stateful_reducer = bool(getattr(reducer, "stateful", False))
+    if stateful_reducer:
+        from chainermn_tpu.optimizers import _ReducerWrappedState
+
     def local_step(state, x, y, rng=None):
         if mutable:
             params, opt_state, extra = state
         else:
             params, opt_state = state
             extra = None
+        if stateful_reducer:
+            # per-rank residuals arrive stacked-with-leading-1; drop to
+            # the rank-local view the reducer works in
+            opt_state = _ReducerWrappedState(
+                opt_state.inner,
+                jax.tree_util.tree_map(lambda r: r[0], opt_state.reducer))
 
         if rng is not None:
             # decorrelate dropout masks across shards
@@ -169,6 +186,11 @@ def make_data_parallel_train_step(
             (loss, (acc, new_vars)), grads = jax.value_and_grad(
                 f, has_aux=True)(params, x, y, extra, rng)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if stateful_reducer:
+            opt_state = _ReducerWrappedState(
+                opt_state.inner,
+                jax.tree_util.tree_map(lambda r: r[None],
+                                       opt_state.reducer))
         params = optax.apply_updates(params, updates)
         metrics = {
             "main/loss": lax.pmean(loss, axes),
@@ -202,18 +224,61 @@ def make_data_parallel_train_step(
         batch_spec = dspec
 
     n_state = 3 if mutable else 2
-    in_specs = ((P(),) * n_state, batch_spec, batch_spec)
-    if with_rng:
-        in_specs = in_specs + (P(),)  # the PRNGKey, replicated
-    step = jax.jit(
-        shard_map(
-            local_step,
-            mesh=mesh,
-            in_specs=in_specs,
-            out_specs=((P(),) * n_state, P()),
-        ),
-        donate_argnums=(0,) if donate else (),
-    )
+    if not stateful_reducer:
+        in_specs = ((P(),) * n_state, batch_spec, batch_spec)
+        if with_rng:
+            in_specs = in_specs + (P(),)  # the PRNGKey, replicated
+        step = jax.jit(
+            shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=((P(),) * n_state, P()),
+            ),
+            donate_argnums=(0,) if donate else (),
+        )
+        return step
+
+    # Stateful reducer: the opt-state specs depend on the state's
+    # structure (which leaves are residuals), so compile lazily per
+    # treedef — the make_expert_parallel_train_step pattern.
+    lead_spec = P(axes if len(axes) > 1 else axes[0])
+
+    def build(state):
+        opt_state = state[1]
+        if not isinstance(opt_state, _ReducerWrappedState):
+            raise ValueError(
+                "optimizer carries a stateful grad_reducer but the "
+                "opt_state is not reducer-wrapped; initialize with "
+                "optimizer.init(params) (outside jit) so the residual "
+                "state exists")
+        ospecs = _ReducerWrappedState(
+            jax.tree_util.tree_map(lambda _: P(), opt_state.inner),
+            jax.tree_util.tree_map(lambda _: lead_spec,
+                                   opt_state.reducer),
+        )
+        state_specs = ((P(), ospecs, P()) if mutable else (P(), ospecs))
+        in_specs = (state_specs, batch_spec, batch_spec)
+        if with_rng:
+            in_specs = in_specs + (P(),)
+        return jax.jit(
+            shard_map(
+                local_step,
+                mesh=mesh,
+                in_specs=in_specs,
+                out_specs=(state_specs, P()),
+            ),
+            donate_argnums=(0,) if donate else (),
+        )
+
+    compiled = {}
+
+    def step(state, *args):
+        key = jax.tree_util.tree_structure(state)
+        if key not in compiled:
+            compiled[key] = build(state)
+        return compiled[key](state, *args)
+
     return step
 
 

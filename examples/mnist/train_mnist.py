@@ -54,6 +54,11 @@ def main():
                         "IDX dataset under --out and parse THAT — the "
                         "executed input path is always the real-format "
                         "parser (reference: chainer.datasets.get_mnist)")
+    p.add_argument("--grad-reducer", default="flat",
+                   choices=["flat", "hierarchical", "quantized", "auto"],
+                   help="gradient-reduction strategy (collectives/ "
+                        "registry; 'flat' is bit-identical to the "
+                        "legacy psum path)")
     p.add_argument("--out", "-o", default="result")
     args = p.parse_args()
 
@@ -92,8 +97,9 @@ def main():
                         np.zeros((2, 28, 28), np.float32))["params"]
     params = comm.bcast_data(params)
 
+    reducer = chainermn_tpu.make_grad_reducer(args.grad_reducer, comm)
     optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.adam(args.lr), comm
+        optax.adam(args.lr), comm, grad_reducer=reducer
     )
     opt_state = jax.tree_util.tree_map(
         lambda x: x, optimizer.init(params)
@@ -116,6 +122,10 @@ def main():
     trainer.extend(lambda t: evaluator(t), trigger=(1, "epoch"))
 
     if comm.is_master:  # reference convention: reporting on rank 0 only
+        from chainermn_tpu.training.reports import ReductionReport
+
+        trainer.extend(ReductionReport(reducer, params),
+                       trigger=(1, "epoch"))
         trainer.extend(LogReport(os.path.join(args.out, "log.jsonl")),
                        trigger=(1, "epoch"))
         trainer.extend(PrintReport(

@@ -22,7 +22,7 @@ def _only(findings, rule):
 
 
 def test_registry_has_every_documented_rule():
-    assert {"DL101", "DL102", "DL103", "DL104", "DL105",
+    assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
             "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
@@ -513,3 +513,83 @@ def test_dl105_suppression_with_rationale():
             return None
     """
     assert _only(_lint(src), "DL105") == []
+
+
+# ---------------------------------------------------------------------------
+# DL106 — hand-rolled gradient collective in a train step
+# ---------------------------------------------------------------------------
+
+
+def test_dl106_flags_tree_map_psum_on_grads():
+    src = """\
+    def local_step(state, x, y):
+        p, opt_state = state
+        (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(p)
+        grads = jax.tree_util.tree_map(lambda g: lax.psum(g, "r"), grads)
+        return grads
+    """
+    fs = _only(_lint(src), "DL106")
+    assert len(fs) == 1
+    assert fs[0].line == 4
+    assert "psum" in fs[0].message
+    assert "docs/static_analysis.md#dl106" in fs[0].message
+
+
+def test_dl106_flags_psum_scatter_via_comprehension_binder():
+    src = """\
+    def make_zero_step():
+        def local_step(state, x, y):
+            (loss, a), grads = jax.value_and_grad(f, has_aux=True)(p)
+            shards = tuple(lax.psum_scatter(g, "r", tiled=True) / 8
+                           for g in pack(grads))
+            return shards
+        return local_step
+    """
+    fs = _only(_lint(src), "DL106")
+    assert len(fs) == 1
+    assert "psum_scatter" in fs[0].message
+
+
+def test_dl106_flags_plain_grad_result():
+    src = """\
+    def train_step(p, x):
+        grads = jax.grad(loss_fn)(p, x)
+        return lax.psum(grads, "r")
+    """
+    assert len(_only(_lint(src), "DL106")) == 1
+
+
+def test_dl106_clean_metric_psum_and_reducer_path():
+    # only the gradient half of the value_and_grad unpack taints:
+    # metric reductions on the loss/aux half stay quiet, and the
+    # registry path is the fix-it
+    src = """\
+    def local_step(state, x, y):
+        (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(p)
+        reduced, rstate = reducer.reduce(grads, rstate)
+        n_correct = lax.psum(acc, "r")
+        return reduced, n_correct, lax.pmean(loss, "r")
+    """
+    assert _only(_lint(src), "DL106") == []
+
+
+def test_dl106_outside_step_functions_is_not_claimed():
+    # the reducer implementations themselves live in functions without
+    # "step" in the name — they ARE the strategy, not a bypass
+    src = """\
+    def reduce(self, grads, state=()):
+        flat = jnp.concatenate([g.ravel() for g in grads])
+        return lax.psum(flat, "r"), state
+    """
+    assert _only(_lint(src), "DL106") == []
+
+
+def test_dl106_suppression_with_rationale():
+    src = """\
+    def local_step(state, x, y):
+        (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(p)
+        # this IS the flat reference path the reducers are audited against
+        grads = tree_map(lambda g: lax.psum(g, "r"), grads)  # dlint: disable=DL106
+        return grads
+    """
+    assert _only(_lint(src), "DL106") == []

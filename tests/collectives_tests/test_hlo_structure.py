@@ -1,0 +1,106 @@
+"""Compiled-HLO structure of the reduction strategies.
+
+The acceptance bar for ``hierarchical`` is not a loss curve — it is the
+*program*: the compiled step must contain a reduce-scatter over the
+intra tier, an all-reduce over the inter tier carrying ``1/intra`` of
+the payload, and an all-gather back over the intra tier, chained in
+that dataflow order — NOT one flat all-reduce. Verified with the same
+HLO parse machinery the DL2xx passes use
+(``chainermn_tpu.analysis.hlo_passes``).
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.analysis.hlo_passes import parse_computations
+from chainermn_tpu.collectives import HierTopology, QuantizedReducer
+
+NELEM = 4096
+INTRA = 4
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _compiled_text(comm, kernel):
+    ax = comm.axis_names[0]
+    x = np.ones((comm.size, NELEM), np.float32)
+    f = jax.jit(shard_map(lambda v: kernel(v[0])[None], mesh=comm.mesh,
+                          in_specs=P(ax), out_specs=P(ax)))
+    return f.lower(x).compile().as_text()
+
+
+def _collectives(text):
+    """Ordered [(kind, result, operands)] per computation, collectives
+    only."""
+    out = {}
+    for cname, ops in parse_computations(text).items():
+        hits = [(k, res, operands) for k, res, operands in ops
+                if k.split("-start")[0] in
+                ("reduce-scatter", "all-reduce", "all-gather")]
+        if hits:
+            out[cname] = hits
+    return out
+
+
+def test_hierarchical_emits_rs_ar_ag_chain(comm):
+    topo = HierTopology(comm, intra=INTRA)
+    text = _compiled_text(comm, topo.allreduce)
+    colls = _collectives(text)
+    assert len(colls) == 1, colls
+    (ops,) = colls.values()
+    kinds = [k.split("-start")[0] for k, _, _ in ops]
+    assert kinds == ["reduce-scatter", "all-reduce", "all-gather"], kinds
+    # dataflow chain: ar consumes the rs result, ag consumes the ar
+    rs, ar, ag = ops
+    assert rs[1] in ar[2], (rs, ar)
+    assert ar[1] in ag[2], (ar, ag)
+    # the inter all-reduce carries 1/intra of the payload...
+    ar_line = next(l for l in text.splitlines()
+                   if re.search(r"= f32\[\d+\]\S* all-reduce\(", l))
+    assert f"f32[{NELEM // INTRA}]" in ar_line, ar_line
+    # ...across the inter groups (rank d = g*intra + j; inter walks g)
+    inter = "{" + "},{".join(
+        ",".join(str(j + g * INTRA) for g in range(comm.size // INTRA))
+        for j in range(INTRA)) + "}"
+    assert f"replica_groups={{{inter}}}" in ar_line, ar_line
+
+
+def test_flat_emits_single_full_allreduce(comm):
+    ax = comm.axis_names[0]
+    text = _compiled_text(comm, lambda v: lax.psum(v, ax))
+    colls = _collectives(text)
+    assert len(colls) == 1, colls
+    (ops,) = colls.values()
+    kinds = [k.split("-start")[0] for k, _, _ in ops]
+    assert kinds == ["all-reduce"], kinds
+    assert "reduce-scatter" not in text and "all-gather" not in text
+    ar_line = next(l for l in text.splitlines() if " all-reduce(" in l)
+    assert f"f32[{NELEM}]" in ar_line, ar_line  # full payload, one hop
+
+
+def test_quantized_int8_reduces_in_integers(comm):
+    """The int8 wire format must be visible in the program: the gradient
+    all-reduce accumulates s32 words, not f32."""
+    red = QuantizedReducer(comm, mode="int8", ef=False)
+    axes = comm.axis_names
+
+    def kernel(v):
+        from chainermn_tpu.collectives.quantized import quantize_allreduce
+        return quantize_allreduce(v, axes, "int8")[0]
+
+    text = _compiled_text(comm, kernel)
+    int_ars = [l for l in text.splitlines()
+               if re.search(r"= s32\[\d+\]\S* all-reduce\(", l)]
+    assert int_ars, "no s32 all-reduce in the int8 quantized program"
+    assert not re.search(r"= f32\[%d\]\S* all-reduce\(" % NELEM, text), (
+        "quantized program still all-reduces the full f32 payload")

@@ -1,0 +1,388 @@
+"""GradReducer strategy tests.
+
+Oracle: ``flat`` IS the reference (bit-identical to not passing a
+reducer at all); every other strategy is measured against it.
+
+* hierarchical — BITWISE parity with flat on sum-reducible payloads
+  (integer-valued floats: reassociation cannot change the sum), allclose
+  on real training floats.
+* quantized — with error feedback the MNIST MLP converges like flat;
+  without it the quantization floor (amax/254 per int8 bucket) eats the
+  small weight gradients and the tail loss is demonstrably worse. The
+  input scaling below (x * 1e-2) is calibrated so the separation is wide
+  (measured: flat 1.4e-3 / ef 1.8e-3 / no-ef 9.7e-3 at 120 steps).
+* auto — cost-model crossover structure + measured-table override; off
+  TPU the measurement sweep is an honest null.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.collectives import (
+    AutoReducer,
+    CostModel,
+    FlatReducer,
+    GradReducer,
+    HierarchicalReducer,
+    HierTopology,
+    QuantizedReducer,
+    REDUCERS,
+    make_grad_reducer,
+    measure_strategies,
+)
+from chainermn_tpu.models import MLP
+from chainermn_tpu.optimizers import make_zero1_train_step, zero1_params
+from chainermn_tpu.optimizers.zero import make_fsdp_train_step
+from chainermn_tpu.training.step import make_data_parallel_train_step
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _mlp_params(comm, n_units=32):
+    model = MLP(n_units=n_units, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 28, 28), np.float32))["params"]
+    return model, comm.bcast_data(params)
+
+
+def _data(comm, batch_per=4, seed=0, scale=1.0):
+    n = comm.size * batch_per
+    rs = np.random.RandomState(seed)
+    x = (rs.rand(n, 28, 28) * scale).astype(np.float32)
+    y = rs.randint(0, 10, size=(n,)).astype(np.int32)
+    dsh = NamedSharding(comm.mesh, P(comm.axis_names[0]))
+    return jax.device_put(x, dsh), jax.device_put(y, dsh)
+
+
+def _shard_reduce(comm, kernel):
+    """jit a per-shard flat-vector kernel over the (8,) mesh axis."""
+    ax = comm.axis_names[0]
+
+    def f(v):
+        return kernel(v[0])[None]
+
+    return jax.jit(shard_map(
+        f, mesh=comm.mesh, in_specs=P(ax), out_specs=P(ax)))
+
+
+def _train(comm, model, params, grad_reducer, steps, data, lr=1e-2,
+           opt=None):
+    """DP training run; returns (losses, final params)."""
+    o = chainermn_tpu.create_multi_node_optimizer(
+        opt or optax.adam(lr), comm, grad_reducer=grad_reducer)
+    p0 = jax.tree_util.tree_map(jnp.array, params)
+    state = (p0, jax.jit(o.init)(p0))
+    step = make_data_parallel_train_step(model, o, comm, donate=False)
+    xs, ys = data
+    losses = []
+    n = xs.shape[0]
+    bs = comm.size * 4
+    for i in range(steps):
+        lo = (i * bs) % n
+        state, m = step(state, xs[lo:lo + bs], ys[lo:lo + bs])
+        losses.append(float(m["main/loss"]))  # per-iteration sync
+    return losses, state[0]
+
+
+# ---------------------------------------------------------------------------
+# flat: the reference
+# ---------------------------------------------------------------------------
+
+def test_flat_reducer_bit_identical_to_default(comm):
+    """grad_reducer='flat' must be byte-for-byte the legacy psum path."""
+    model, params = _mlp_params(comm)
+    data = _data(comm)
+    _, p_default = _train(comm, model, params, None, 3, data)
+    _, p_flat = _train(comm, model, params, "flat", 3, data)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        p_default, p_flat)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical: two-level parity
+# ---------------------------------------------------------------------------
+
+def test_hierarchical_allreduce_bitwise_on_integer_floats(comm):
+    """rs(intra) -> psum(inter) -> ag(intra) must equal one flat psum
+    BITWISE on integer-valued floats (sums are exactly representable, so
+    any disagreement is a logic bug, not reassociation)."""
+    n = comm.size
+    topo = HierTopology(comm, intra=4)
+    assert topo.intra == 4 and topo.inter == n // 4
+    rs = np.random.RandomState(0)
+    x = rs.randint(-8, 8, size=(n, 4097)).astype(np.float32)  # odd: pads
+    ax = comm.axis_names[0]
+    flat = _shard_reduce(comm, lambda v: lax.psum(v, ax))(x)
+    hier = _shard_reduce(comm, topo.allreduce)(x)
+    np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+    np.testing.assert_array_equal(np.asarray(flat)[0], x.sum(axis=0))
+
+
+def test_hierarchical_reduce_scatter_layout_matches_flat(comm):
+    """Two-stage reduce-scatter must land tile r on rank r — the exact
+    layout of one flat psum_scatter (ZeRO state depends on it)."""
+    n = comm.size
+    topo = HierTopology(comm, intra=4)
+    ax = comm.axis_names[0]
+    L = n * 640
+    rs = np.random.RandomState(1)
+    x = rs.randint(-8, 8, size=(n, L)).astype(np.float32)
+    ref = _shard_reduce(
+        comm, lambda v: lax.psum_scatter(v, ax, tiled=True))(x)
+    got = _shard_reduce(comm, lambda v: topo.reduce_scatter(v, ax))(x)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_hierarchical_dp_step_matches_flat(comm):
+    model, params = _mlp_params(comm)
+    data = _data(comm)
+    red = HierarchicalReducer(comm, intra=4)
+    l_flat, p_flat = _train(comm, model, params, None, 3, data)
+    l_hier, p_hier = _train(comm, model, params, red, 3, data)
+    np.testing.assert_allclose(l_flat, l_hier, rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        p_flat, p_hier)
+
+
+def test_hierarchical_bad_intra_rejected(comm):
+    with pytest.raises(ValueError, match="divide"):
+        HierarchicalReducer(comm, intra=3)
+
+
+# ---------------------------------------------------------------------------
+# quantized: error feedback
+# ---------------------------------------------------------------------------
+
+def test_quantized_ef_convergence_vs_no_ef(comm):
+    """The satellite-3 claim, in one calibrated regime (inputs * 1e-2,
+    Adam): the int8 quantization floor (amax/254, pinned by the O(1)
+    head-bias gradients) rounds the small weight gradients to zero, so
+
+    * WITHOUT error feedback the tail loss is demonstrably worse;
+    * WITH error feedback residuals accumulate past the floor and the
+      run converges like flat.
+    """
+    from chainermn_tpu.datasets.toy import synthetic_mnist
+
+    model, params = _mlp_params(comm)
+    N, bs, steps = 2048, 128, 120
+    train = synthetic_mnist(N, seed=0)
+    xs = np.stack([train[i][0] for i in range(N)]).astype(np.float32) * 1e-2
+    ys = np.array([train[i][1] for i in range(N)], np.int32)
+
+    def run(gr):
+        o = chainermn_tpu.create_multi_node_optimizer(
+            optax.adam(1e-2), comm, grad_reducer=gr)
+        p0 = jax.tree_util.tree_map(jnp.array, params)
+        state = (p0, jax.jit(o.init)(p0))
+        step = make_data_parallel_train_step(model, o, comm, donate=False)
+        losses = []
+        for i in range(steps):
+            lo = (i * bs) % N
+            state, m = step(state, xs[lo:lo + bs], ys[lo:lo + bs])
+            losses.append(float(m["main/loss"]))  # per-iteration sync
+        return losses
+
+    flat = run(None)
+    ef = run(QuantizedReducer(comm, mode="int8", ef=True))
+    noef = run(QuantizedReducer(comm, mode="int8", ef=False))
+
+    def tail(l):
+        return float(np.mean(l[-10:]))
+
+    assert all(np.isfinite(l).all() for l in (flat, ef, noef))
+    # measured: flat 1.4e-3, ef 1.8e-3, noef 9.7e-3 — wide margins
+    assert tail(flat) < 5e-3, tail(flat)
+    assert tail(ef) < 5e-3, tail(ef)              # with-EF ~ flat
+    assert tail(noef) > 3 * tail(ef), (tail(noef), tail(ef))
+
+
+def test_quantized_bf16_stateless_tracks_flat(comm):
+    model, params = _mlp_params(comm)
+    data = _data(comm)
+    l_flat, _ = _train(comm, model, params, None, 5, data)
+    l_q, _ = _train(comm, model, params,
+                    QuantizedReducer(comm, mode="bf16", ef=False), 5, data)
+    np.testing.assert_allclose(l_flat, l_q, rtol=0.05, atol=0.02)
+
+
+def test_quantized_ef_reduce_scatter_refused(comm):
+    red = QuantizedReducer(comm, mode="int8", ef=True)
+    L = comm.size * 16
+    ax = comm.axis_names[0]
+    with pytest.raises(RuntimeError, match="error.feedback|ef"):
+        _shard_reduce(
+            comm,
+            lambda v: red.reduce_scatter_flat(v, ax, comm.size),
+        )(np.ones((comm.size, L), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# auto: cost model + measured override
+# ---------------------------------------------------------------------------
+
+def test_auto_choose_crossover(comm):
+    red = AutoReducer(comm, intra=4)
+    # tiny buckets are launch-latency bound -> flat; huge buckets want
+    # the inter tier to carry 1/intra of the bytes -> hierarchical
+    assert red.choose(1 << 10) == "flat"
+    assert red.choose(32 << 20) == "hierarchical"
+    # the crossover is monotone: once hierarchical wins it keeps winning
+    strategies = [red.choose(1 << p) for p in range(8, 27)]
+    flip = strategies.index("hierarchical")
+    assert all(s == "hierarchical" for s in strategies[flip:])
+
+
+def test_auto_measured_table_overrides_model(comm):
+    measured = {("flat", 1 << 10): 50.0, ("hierarchical", 1 << 10): 1.0}
+    red = AutoReducer(comm, intra=4, measured=measured)
+    assert red.choose(1 << 10) == "hierarchical"
+
+
+def test_auto_lossy_gate(comm):
+    measured = {("flat", 1 << 20): 10.0,
+                ("hierarchical", 1 << 20): 10.0,
+                ("quantized", 1 << 20): 1.0}
+    # quantized is never a candidate unless lossy=True is explicit
+    assert AutoReducer(comm, intra=4,
+                       measured=measured).choose(1 << 20) != "quantized"
+    assert AutoReducer(comm, intra=4, measured=measured,
+                       lossy=True).choose(1 << 20) == "quantized"
+
+
+def test_measure_strategies_off_tpu_is_honest_null(comm):
+    assert jax.devices()[0].platform != "tpu"
+    assert measure_strategies(comm) == {}
+
+
+def test_auto_dp_step_matches_flat(comm):
+    model, params = _mlp_params(comm)
+    data = _data(comm)
+    l_flat, p_flat = _train(comm, model, params, None, 3, data)
+    l_auto, p_auto = _train(comm, model, params, "auto", 3, data)
+    np.testing.assert_allclose(l_flat, l_auto, rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        p_flat, p_auto)
+
+
+# ---------------------------------------------------------------------------
+# registry / plan / wire bytes
+# ---------------------------------------------------------------------------
+
+def test_registry_and_factory(comm):
+    assert set(REDUCERS) >= {"flat", "hierarchical", "quantized", "auto"}
+    assert make_grad_reducer(None, comm) is None
+    inst = FlatReducer(comm)
+    assert make_grad_reducer(inst, comm) is inst
+    assert isinstance(make_grad_reducer("flat", comm), FlatReducer)
+    with pytest.raises(ValueError, match="hierarchical.*quantized"):
+        make_grad_reducer("pure_nccl", comm)
+    with pytest.raises(ValueError, match="op"):
+        FlatReducer(comm, op="max")
+
+
+def test_plan_accounts_every_byte(comm):
+    _, params = _mlp_params(comm)
+    total = sum(l.size * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(params))
+    for name in ("flat", "hierarchical"):
+        rows = make_grad_reducer(name, comm).plan(params)
+        assert sum(r["bytes"] for r in rows) == total
+        assert all(r["wire_bytes"] == r["bytes"] for r in rows)
+        assert all(r["algorithm"] == name for r in rows)
+
+
+def test_quantized_plan_compresses_wire(comm):
+    _, params = _mlp_params(comm)
+    for mode, ratio in (("bf16", 2), ("int8", 4)):
+        red = QuantizedReducer(comm, mode=mode)
+        for r in red.plan(params):
+            assert r["wire_bytes"] < r["bytes"]
+            # per-bucket scale word aside, compression ~= dtype ratio
+            assert r["wire_bytes"] <= r["bytes"] // ratio + 8
+
+
+def test_auto_plan_carries_estimates_and_choice(comm):
+    _, params = _mlp_params(comm)
+    rows = AutoReducer(comm, intra=4).plan(params)
+    assert rows
+    for r in rows:
+        assert r["algorithm"].startswith("auto:")
+        assert r["est_us"] > 0
+
+
+def test_describe_is_one_line_per_bucket(comm):
+    _, params = _mlp_params(comm)
+    red = make_grad_reducer("flat", comm)
+    text = red.describe(params)
+    assert len(text.splitlines()) == len(red.plan(params))
+    assert "flat" in text and "bucket" in text
+
+
+# ---------------------------------------------------------------------------
+# ZeRO / FSDP wiring
+# ---------------------------------------------------------------------------
+
+def test_zero1_hierarchical_matches_default(comm):
+    model, params = _mlp_params(comm)
+    x, y = _data(comm)
+    red = HierarchicalReducer(comm, intra=4)
+    s0, st0 = make_zero1_train_step(model, optax.adam(1e-2), comm, params,
+                                    donate=False)
+    s1, st1 = make_zero1_train_step(model, optax.adam(1e-2), comm, params,
+                                    donate=False, grad_reducer=red)
+    for _ in range(3):
+        st0, m0 = s0(st0, x, y)
+        st1, m1 = s1(st1, x, y)
+        np.testing.assert_allclose(float(m0["main/loss"]),
+                                   float(m1["main/loss"]), rtol=1e-4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        zero1_params(st0, params), zero1_params(st1, params))
+
+
+def test_zero1_stateful_reducer_rejected(comm):
+    model, params = _mlp_params(comm)
+    with pytest.raises(ValueError, match="stateful"):
+        make_zero1_train_step(
+            model, optax.adam(1e-2), comm, params,
+            grad_reducer=QuantizedReducer(comm, mode="int8", ef=True))
+
+
+def test_fsdp_stateful_reducer_rejected(comm):
+    model, params = _mlp_params(comm)
+    with pytest.raises(ValueError, match="ef=False"):
+        make_fsdp_train_step(
+            model, optax.adam(1e-2), comm, params,
+            grad_reducer=QuantizedReducer(comm, mode="int8", ef=True))
+
+
+def test_fsdp_quantized_wire_roundtrip_converges(comm):
+    model, params = _mlp_params(comm)
+    x, y = _data(comm)
+    step, state = make_fsdp_train_step(
+        model, optax.adam(1e-2), comm, params, donate=False,
+        grad_reducer=QuantizedReducer(comm, mode="bf16", ef=False))
+    losses = []
+    for _ in range(4):
+        state, m = step(state, x, y)
+        losses.append(float(m["main/loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

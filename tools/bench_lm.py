@@ -9,6 +9,13 @@ compute, data-parallel step factory. Prints one JSON line per config.
 Usage: python tools/bench_lm.py [d_model n_layers seq_len batch
                                  [loss [d_head [qkv_layout]]]]
                                 [--autotune-blocks]
+                                [--grad-reducer=flat,hierarchical,...]
+  --grad-reducer: comma-separated gradient-reduction strategies
+  (collectives/ registry: flat | hierarchical | quantized | auto); one
+  JSON line per strategy, with the strategy's per-step payload and wire
+  bytes from the reducer's bucket plan. Off TPU the throughput deltas
+  are meaningless (host-platform collectives are memcpys — BASELINE.md
+  records the honest null); the byte accounting is exact everywhere.
   --autotune-blocks: time the flash-attention (block_q, block_k)
   candidates for this shape (ops/autotune.py) and build the model with
   the winner; off-TPU the tuner returns the defaults untimed (recorded
@@ -34,7 +41,7 @@ import numpy as np
 
 def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
             loss_kind="unfused", d_head=64, scan_k=4, n_iters=6,
-            qkv_layout="blhd", autotune_blocks=False):
+            qkv_layout="blhd", autotune_blocks=False, grad_reducer=None):
     """Measure LM training throughput; returns (tokens_per_sec_per_chip,
     config dict). Importable — bench.py reuses this as its LM gate."""
     import jax
@@ -69,8 +76,13 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
         0, 32768, size=(batch * comm.size, seq_len + 1)).astype(np.int32)
     params = comm.bcast_data(
         model.init(jax.random.PRNGKey(0), toks[:1, :-1])["params"])
+    reducer = None
+    if grad_reducer:
+        from chainermn_tpu.collectives import make_grad_reducer
+
+        reducer = make_grad_reducer(grad_reducer, comm)
     opt = chainermn_tpu.create_multi_node_optimizer(
-        optax.adamw(3e-4), comm)
+        optax.adamw(3e-4), comm, grad_reducer=reducer)
     # K steps per dispatch: measures the device, not the tunnel's ~100 ms
     # dispatch round-trip (same methodology as bench.py; the token stack
     # reuses ONE device batch K times to avoid the ~10 MB/s tunnel)
@@ -116,6 +128,12 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
               "params_m": round(n_params / 1e6, 1),
               "loss": loss_kind, "qkv_layout": qkv_layout,
               "attention_blocks": blocks}
+    if reducer is not None:
+        rows = reducer.plan(params)
+        config["grad_reducer"] = reducer.name
+        config["comm_bytes_per_step"] = sum(r["bytes"] for r in rows)
+        config["comm_wire_bytes_per_step"] = sum(
+            r["wire_bytes"] for r in rows)
     return tokens_per_sec / comm.size, config
 
 
@@ -124,6 +142,11 @@ def main():
     autotune = "--autotune-blocks" in argv
     if autotune:
         argv.remove("--autotune-blocks")
+    reducers = [None]
+    for a in list(argv):
+        if a.startswith("--grad-reducer"):
+            reducers = a.split("=", 1)[1].split(",")
+            argv.remove(a)
     d_model = int(argv[0]) if len(argv) > 0 else 768
     n_layers = int(argv[1]) if len(argv) > 1 else 12
     seq_len = int(argv[2]) if len(argv) > 2 else 2048
@@ -131,19 +154,21 @@ def main():
     loss_kind = argv[4] if len(argv) > 4 else "unfused"
     d_head = int(argv[5]) if len(argv) > 5 else 64
     qkv_layout = argv[6] if len(argv) > 6 else "blhd"
-    try:
-        per_chip, config = measure(d_model, n_layers, seq_len, batch,
-                                   loss_kind, d_head,
-                                   qkv_layout=qkv_layout,
-                                   autotune_blocks=autotune)
-    except ValueError as e:
-        raise SystemExit(str(e))
-    print(json.dumps({
-        "metric": "transformer_lm_tokens_per_sec_per_chip",
-        "value": round(per_chip, 1),
-        "unit": "tokens/sec/chip",
-        "config": config,
-    }))
+    for gr in reducers:
+        try:
+            per_chip, config = measure(d_model, n_layers, seq_len, batch,
+                                       loss_kind, d_head,
+                                       qkv_layout=qkv_layout,
+                                       autotune_blocks=autotune,
+                                       grad_reducer=gr)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        print(json.dumps({
+            "metric": "transformer_lm_tokens_per_sec_per_chip",
+            "value": round(per_chip, 1),
+            "unit": "tokens/sec/chip",
+            "config": config,
+        }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,6 +1,14 @@
 #!/usr/bin/env python
 """Perf sweep for the ResNet-50 bench: batch size, scan-amortized dispatch,
-space-to-depth stem. Prints one JSON line per variant."""
+space-to-depth stem, gradient-reduction strategy. Prints one JSON line
+per variant.
+
+Usage: python tools/bench_sweep.py BATCH N_SCAN S2D
+                                   [--grad-reducer=flat,hierarchical,...]
+  --grad-reducer sweeps collectives/ strategies; each line carries the
+  strategy's per-step payload and wire bytes from the reducer's bucket
+  plan. Off TPU the throughput deltas are an honest null (BASELINE.md);
+  the byte accounting is exact everywhere."""
 
 import json
 import os
@@ -12,7 +20,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 import numpy as np
 
 
-def run_variant(batch, n_scan, s2d, n_iters=10):
+def run_variant(batch, n_scan, s2d, n_iters=10, grad_reducer=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -34,8 +42,13 @@ def run_variant(batch, n_scan, s2d, n_iters=10):
     variables = model.init(jax.random.PRNGKey(0), image)
     params = comm.bcast_data(variables["params"])
     extra = {k: comm.bcast_data(variables[k]) for k in mutable}
+    reducer = None
+    if grad_reducer:
+        from chainermn_tpu.collectives import make_grad_reducer
+
+        reducer = make_grad_reducer(grad_reducer, comm)
     opt = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(0.1, momentum=0.9), comm)
+        optax.sgd(0.1, momentum=0.9), comm, grad_reducer=reducer)
     state = (params, opt.init(params), extra)
     step = make_data_parallel_train_step(model, opt, comm, mutable=mutable)
 
@@ -80,14 +93,28 @@ def run_variant(batch, n_scan, s2d, n_iters=10):
         total = n_iters * global_batch
 
     per_chip = total / dt / n_dev
-    print(json.dumps({
+    line = {
         "batch": batch, "scan": n_scan, "s2d": s2d,
         "images_per_sec_per_chip": round(per_chip, 1),
-    }), flush=True)
+    }
+    if reducer is not None:
+        rows = reducer.plan(params)
+        line["grad_reducer"] = reducer.name
+        line["comm_bytes_per_step"] = sum(r["bytes"] for r in rows)
+        line["comm_wire_bytes_per_step"] = sum(
+            r["wire_bytes"] for r in rows)
+    print(json.dumps(line), flush=True)
 
 
 if __name__ == "__main__":
-    batch = int(sys.argv[1])
-    n_scan = int(sys.argv[2])
-    s2d = sys.argv[3] == "1"
-    run_variant(batch, n_scan, s2d)
+    argv = sys.argv[1:]
+    reducers = [None]
+    for a in list(argv):
+        if a.startswith("--grad-reducer"):
+            reducers = a.split("=", 1)[1].split(",")
+            argv.remove(a)
+    batch = int(argv[0])
+    n_scan = int(argv[1])
+    s2d = argv[2] == "1"
+    for gr in reducers:
+        run_variant(batch, n_scan, s2d, grad_reducer=gr)
