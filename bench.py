@@ -263,6 +263,37 @@ def main():
                 ok = False
                 record[f"{prefix}_error"] = f"{type(e).__name__}: {e}"[:300]
         record["lm_gate_ok"] = bool(ok)
+
+    # schedtune tuned-vs-default overlap fraction (docs/tuning.md),
+    # folded into the same JSON line. The fractions come from the canned
+    # scheduled-HLO search over this model's gradient payload — honest
+    # about their source (``tuning_source``); the THROUGHPUT delta of
+    # applying the tuned plan stays an honest null on a CPU-mesh machine
+    # (host-platform collectives are memcpys, BASELINE.md rounds 6-7).
+    try:
+        from chainermn_tpu.tuning import Topology, tune_canned
+
+        g = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0), image))
+        try:
+            g = g["params"]  # grads cover params, not batch_stats
+        except (KeyError, TypeError, IndexError):
+            pass
+        grad_bytes = sum(
+            int(np.prod(l.shape)) * jnp.dtype(l.dtype).itemsize
+            for l in jax.tree_util.tree_leaves(g))
+        tuned = tune_canned(Topology.from_comm(comm), grad_bytes)
+        record["tuning_source"] = "canned"
+        record["tuning_grad_bytes"] = grad_bytes
+        record["tuned_overlap_frac"] = tuned.plan.overlap_fraction
+        record["default_overlap_frac"] = tuned.default[
+            "overlap_fraction"]
+        record["tuned_bucket_bytes"] = tuned.plan.bucket_bytes
+        record["tuned_strategy"] = tuned.plan.strategy
+        record["tuned_throughput_delta"] = (
+            None if jax.default_backend() == "cpu" else "unmeasured")
+    except Exception as e:  # never sink the headline metric
+        record["tuning_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(record))
 
 

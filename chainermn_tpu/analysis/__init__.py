@@ -51,6 +51,7 @@ from chainermn_tpu.analysis.hlo_passes import (  # noqa: F401
     check_dp_overlap,
     check_fsdp_gather_liveness,
     check_pipeline_permute_overlap,
+    dp_overlap_fraction,
     parse_computations,
     scheduled_entry_ops,
 )

@@ -758,3 +758,51 @@ def check_handrolled_grad_collective(tree, src, path) -> List[Finding]:
 
 register(Rule("DL106", "handrolled-grad-collective", f"{_DOC}#dl106",
               check_handrolled_grad_collective))
+
+
+# ---------------------------------------------------------------------------
+# DL107 — stale-schedule-profile
+# ---------------------------------------------------------------------------
+
+#: ProfileDB lookups whose first argument is the topology (fingerprint)
+_PROFILE_LOOKUPS = {"plan_for", "measured_for"}
+
+
+def check_stale_schedule_profile(tree, src, path) -> List[Finding]:
+    """A profile-DB lookup keyed by a HARD-CODED fingerprint string.
+
+    The schedtune profile DB (docs/tuning.md) keys plans by
+    ``Topology.fingerprint()`` — platform, device kind, per-tier sizes.
+    ``db.plan_for("tpu:v5e/ici:4+dcn:2")`` pins the lookup to the
+    machine the string was copied from: on any other mesh it either
+    misses (silently untuned) or, worse, returns a plan tuned for
+    different hardware, and bucket sizes/strategy mis-tune with no
+    error. Derive the key from the live mesh —
+    ``db.plan_for(Topology.from_comm(comm))`` — or let
+    ``create_multi_node_optimizer(tune=...)`` resolve it, which also
+    REFUSES a fingerprint mismatch at runtime. Intra-function only: a
+    literal laundered through a variable is not tracked (documented
+    limit, ``{_DOC}#dl107``).
+    """
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _callee_name(node) in _PROFILE_LOOKUPS):
+            continue
+        arg = _arg_or_kw(node, 0, "topology")
+        val = _literal(arg)
+        if isinstance(val, str):
+            findings.append(Finding(
+                "DL107", path, node.lineno,
+                f"profile lookup '{_callee_name(node)}' keyed by the "
+                f"hard-coded topology fingerprint {val!r}: a profile "
+                "tuned on one machine silently mis-tunes any other "
+                "mesh. Build the key from the live communicator "
+                "(Topology.from_comm(comm)) or use "
+                "create_multi_node_optimizer(tune=...), which verifies "
+                f"the fingerprint at runtime ({_DOC}#dl107)."))
+    return findings
+
+
+register(Rule("DL107", "stale-schedule-profile", f"{_DOC}#dl107",
+              check_stale_schedule_profile))

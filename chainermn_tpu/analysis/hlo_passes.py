@@ -137,6 +137,9 @@ def check_dp_overlap(hlo_text: str) -> dict:
             sum(1 for i in bwd if i > min(ar)) if ar else 0),
         "async_pairs": bool(re.search(r"all-reduce-start", hlo_text)),
     }
+    out["overlap_fraction"] = (
+        out["backward_ops_after_first_allreduce"] / len(bwd)
+        if out["is_scheduled"] and ar and bwd else 0.0)
     out["ok"] = bool(
         out["is_scheduled"] and ar and bwd and min(ar) < max(bwd))
     if not out["ok"]:
@@ -145,6 +148,18 @@ def check_dp_overlap(hlo_text: str) -> dict:
             "and xla_enable_async_all_reduce=true so gradient "
             f"all-reduces hide in the backward window ({_DOC}#dl201)")
     return out
+
+
+def dp_overlap_fraction(hlo_text: str) -> float:
+    """DL201 as a scalar SCORE, not just a verdict: the fraction of
+    backward ops scheduled after the first gradient all-reduce issues —
+    i.e. the share of the backward window available to hide gradient
+    collectives in. 0.0 for an unscheduled module, no all-reduce, or no
+    backward ops; 1.0 when every backward op follows the first issue
+    (the double-buffered/prev-step-grads shape). This is the objective
+    the schedule autotuner (:mod:`chainermn_tpu.tuning`) maximizes and
+    ``tools/check_overlap_schedule.py --assert-min-overlap`` gates."""
+    return check_dp_overlap(hlo_text)["overlap_fraction"]
 
 
 # ---------------------------------------------------------------------------

@@ -20,7 +20,16 @@ real compiled reductions per size and the picker interpolates the
 table; off TPU it returns ``{}`` untimed — on a CPU host-platform mesh
 every "collective" is a memcpy and the numbers would be fiction (the
 ``ops/autotune.py`` honest-null convention; BASELINE.md records the
-null).
+null). Pass ``db=`` to persist a non-empty sweep into the per-topology
+profile DB (:mod:`chainermn_tpu.tuning.profile_db`) so one on-TPU run
+permanently improves off-TPU tuning for that machine shape;
+``AutoReducer(profile=...)`` loads it back.
+
+The intra/inter split itself is no longer hard-coded here: cost
+estimation goes through the explicit multi-tier
+:class:`chainermn_tpu.tuning.topology.Topology` (for two tiers the
+numbers are identical to the original :class:`CostModel` formulas,
+which remain as the parameter bag and the documented reference).
 """
 
 from __future__ import annotations
@@ -46,7 +55,12 @@ from chainermn_tpu.collectives.quantized import (
 
 @dataclasses.dataclass
 class CostModel:
-    """Per-tier alpha-beta parameters, microseconds and GB/s."""
+    """Per-tier alpha-beta parameters, microseconds and GB/s.
+
+    Kept as the two-tier parameter bag (and reference formulas);
+    :meth:`as_topology` lifts it into the general multi-tier
+    :class:`~chainermn_tpu.tuning.topology.Topology` the estimators
+    now run on."""
 
     ici_latency_us: float = 1.0
     ici_bw_gbps: float = 100.0
@@ -81,8 +95,35 @@ class CostModel:
                     + self._xfer_us(ring(wire, n), slow_bw))
         raise ValueError(f"unknown strategy {strategy!r}")
 
+    def as_topology(self, comm, intra: Optional[int] = None):
+        """This parameter set as an explicit multi-tier
+        :class:`~chainermn_tpu.tuning.topology.Topology` over the
+        communicator's mesh (bitwise-same estimates for two tiers)."""
+        from chainermn_tpu.tuning.topology import Topology
+
+        return Topology.from_comm(
+            comm, intra=intra,
+            ici_latency_us=self.ici_latency_us,
+            ici_bw_gbps=self.ici_bw_gbps,
+            dcn_latency_us=self.dcn_latency_us,
+            dcn_bw_gbps=self.dcn_bw_gbps,
+            quant_overhead_us=self.quant_overhead_us)
+
 
 _CACHE: Dict[tuple, Dict[Tuple[str, int], float]] = {}
+
+
+def _persist_measured(db, comm, intra, table) -> None:
+    """Write a non-empty measured sweep into the profile DB under this
+    mesh's topology fingerprint. ``db`` is a ProfileDB, a path, or
+    ``True`` for the default DB location."""
+    from chainermn_tpu.tuning.profile_db import ProfileDB
+    from chainermn_tpu.tuning.topology import Topology
+
+    pdb = db if isinstance(db, ProfileDB) else ProfileDB(
+        db if isinstance(db, str) else None)
+    pdb.put_measured(Topology.from_comm(comm, intra=intra), table)
+    pdb.save()
 
 
 def measure_strategies(
@@ -91,6 +132,7 @@ def measure_strategies(
     strategies: Sequence[str] = ("flat", "hierarchical", "quantized"),
     steps: int = 10,
     intra: Optional[int] = None,
+    db=None,
 ) -> Dict[Tuple[str, int], float]:
     """Measured sweep: {(strategy, payload_bytes): microseconds}.
 
@@ -99,10 +141,19 @@ def measure_strategies(
     UNTIMED — host-platform "collectives" are memcpys and any number
     would mislead the picker (honest-null convention, BASELINE.md).
     Feed the result to ``AutoReducer(measured=...)``.
+
+    ``db`` (a :class:`~chainermn_tpu.tuning.profile_db.ProfileDB`, a
+    path, or ``True`` for the default location) persists a NON-EMPTY
+    sweep under this mesh's topology fingerprint — the results used to
+    be computed and thrown away; now one on-TPU run feeds every later
+    off-TPU ``AutoReducer(profile=...)`` / ``tools/schedtune.py`` run
+    on that machine shape. The off-TPU ``{}`` null is never written.
     """
     key = (tuple(comm.mesh.devices.shape), tuple(comm.axis_names),
            tuple(sizes), tuple(strategies), intra)
     if key in _CACHE:
+        if db is not None and _CACHE[key]:
+            _persist_measured(db, comm, intra, _CACHE[key])
         return _CACHE[key]
     if jax.devices()[0].platform != "tpu":
         _CACHE[key] = {}
@@ -135,6 +186,8 @@ def measure_strategies(
             r.block_until_ready()
             out[(s, nbytes)] = (time.perf_counter() - t0) / steps * 1e6
     _CACHE[key] = out
+    if db is not None and out:
+        _persist_measured(db, comm, intra, out)
     return out
 
 
@@ -146,7 +199,14 @@ class AutoReducer(GradReducer):
     overriding the model where it has data; ``lossy`` — allow the
     quantized (bf16, no error feedback — this strategy is stateless)
     candidate; ``intra`` — fast-tier width, as in
-    :class:`~chainermn_tpu.collectives.hierarchical.HierarchicalReducer`.
+    :class:`~chainermn_tpu.collectives.hierarchical.HierarchicalReducer`;
+    ``topology`` — an explicit
+    :class:`~chainermn_tpu.tuning.topology.Topology` for cost
+    estimation (default: lifted from ``comm``/``cost``/``intra``);
+    ``profile`` — a :class:`~chainermn_tpu.tuning.profile_db.ProfileDB`
+    (or path, or ``True`` for the default location) whose persisted
+    ``measure_strategies`` sweep for this topology fingerprint seeds
+    ``measured`` (an explicit ``measured=`` entry wins per key).
     """
 
     name = "auto"
@@ -156,11 +216,25 @@ class AutoReducer(GradReducer):
                  intra: Optional[int] = None,
                  cost: Optional[CostModel] = None,
                  measured: Optional[Dict[Tuple[str, int], float]] = None,
-                 lossy: bool = False):
-        super().__init__(comm, op, bucket_bytes)
+                 lossy: bool = False,
+                 bucket_order: str = "emission",
+                 topology=None,
+                 profile=None):
+        super().__init__(comm, op, bucket_bytes, bucket_order)
         self.topology = HierTopology(comm, intra=intra)
         self.cost = cost or CostModel()
+        #: multi-tier cost-side description (the collective kernels
+        #: still run on the two-tier HierTopology above)
+        self.topo_desc = (topology if topology is not None
+                          else self.cost.as_topology(comm, intra=intra))
         self.measured = dict(measured or {})
+        if profile is not None:
+            from chainermn_tpu.tuning.profile_db import ProfileDB
+
+            pdb = profile if isinstance(profile, ProfileDB) else ProfileDB(
+                profile if isinstance(profile, str) else None)
+            persisted = pdb.measured_for(self.topo_desc)
+            self.measured = {**persisted, **self.measured}
         self.lossy = lossy
 
     def _estimate(self, strategy: str, nbytes: int) -> float:
@@ -169,7 +243,7 @@ class AutoReducer(GradReducer):
                    in self.measured.items() if s == strategy]
             if pts:  # nearest measured size wins over the model
                 return min(pts)[1]
-        return self.cost.estimate_us(strategy, nbytes, self.topology)
+        return self.topo_desc.estimate_us(strategy, nbytes)
 
     def choose(self, nbytes: int) -> str:
         cands = ["flat", "hierarchical"] + (
@@ -185,7 +259,7 @@ class AutoReducer(GradReducer):
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         out = [None] * len(leaves)
         passthrough, groups = group_leaves_for_buckets(
-            leaves, axes, self.bucket_bytes)
+            leaves, axes, self.bucket_bytes, order=self.bucket_order)
         for i in passthrough:
             out[i] = leaves[i] / n if self.op == "mean" else leaves[i]
         for (va, cdt), buckets in groups.items():

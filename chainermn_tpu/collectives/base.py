@@ -74,14 +74,26 @@ class GradReducer:
     stateful = False
 
     def __init__(self, comm, op: str = "mean",
-                 bucket_bytes: Optional[int] = None):
+                 bucket_bytes: Optional[int] = None,
+                 bucket_order: str = "emission"):
         if op not in ("mean", "sum"):
             raise ValueError(f"unsupported grad-reduction op: {op!r}")
+        if bucket_order not in ("emission", "size"):
+            raise ValueError(
+                f"bucket_order must be 'emission' or 'size', got "
+                f"{bucket_order!r}")
         self.comm = comm
         self.op = op
         self.bucket_bytes = (bucket_bytes if bucket_bytes is not None
                              else (comm._bucket_bytes
                                    or DEFAULT_DCN_BUCKET_BYTES))
+        #: 'emission' packs buckets in pytree order (the reference
+        #: behavior); 'size' packs largest-first — the first bucket
+        #: fills (and its collective issues) earlier in the backward,
+        #: which is one of the schedtune knobs (docs/tuning.md). Pure
+        #: packing: membership changes, every leaf is still reduced
+        #: exactly once, so numerics are unchanged.
+        self.bucket_order = bucket_order
 
     # -- state ----------------------------------------------------------
     def init(self, params):
@@ -122,6 +134,8 @@ class GradReducer:
             dt = jnp.dtype(getattr(leaf, "dtype", jnp.float32))
             nb = int(jnp.size(leaf)) * dt.itemsize
             sized.append((key, nb))
+        if self.bucket_order == "size":
+            sized = sorted(sized, key=lambda kv: -kv[1])  # stable
         out = []
         for i, bucket in enumerate(plan_buckets(sized, self.bucket_bytes)):
             sizes = dict(sized)
@@ -189,11 +203,14 @@ def make_grad_reducer(spec, comm, op: str = "mean", **kwargs) -> Optional[GradRe
 
 
 def group_leaves_for_buckets(leaves, axes, bucket_bytes,
-                             comm_dtype_of=None):
+                             comm_dtype_of=None, order: str = "emission"):
     """Shared bucket grouping: leaves are grouped by (varying axes,
     communication dtype) — only same-typed leaves share a flat buffer —
-    then packed greedily in pytree order (:func:`plan_buckets`, same
-    rule as ``XlaCommunicator._bucketed_allreduce_grad``).
+    then packed greedily (:func:`plan_buckets`, same rule as
+    ``XlaCommunicator._bucketed_allreduce_grad``) in pytree order
+    (``order='emission'``, the reference behavior) or largest-leaf
+    first (``order='size'``, the schedtune knob — the first bucket is
+    ready earlier in the backward; see docs/tuning.md).
 
     Returns ``(passthrough, groups)`` where ``passthrough`` is the list
     of leaf indices with no varying axis (already global sums under vma
@@ -214,6 +231,8 @@ def group_leaves_for_buckets(leaves, axes, bucket_bytes,
     groups = {}
     for key, idxs in by_type.items():
         cdt = key[1]
+        if order == "size":
+            idxs = sorted(idxs, key=lambda i: -leaves[i].size)  # stable
         groups[key] = plan_buckets(
             [(i, leaves[i].size * cdt.itemsize) for i in idxs],
             bucket_bytes)

@@ -1,25 +1,80 @@
 """The flat strategy — today's psum, the numerical reference.
 
 Reference: pure_nccl_communicator.py — pack, ONE ring allreduce, unpack.
-Here it simply delegates to ``XlaCommunicator.allreduce_grad``, so
+By default it simply delegates to ``XlaCommunicator.allreduce_grad``, so
 ``grad_reducer='flat'`` is **bit-identical** to not passing a reducer at
 all (same primitives in the same order; the acceptance bar for every
 other strategy is measured against this one).
+
+A TUNED flat reducer — constructed with explicit ``bucket_bytes`` or a
+non-default ``bucket_order`` (the schedtune knobs, docs/tuning.md) —
+switches to its own bucketed psum path: ``allreduce_grad``'s bucketing
+follows the *communicator's* ``dcn_bucket_bytes``, which the tuner must
+be able to override per plan. The bucketed path changes only the
+packing; every element is still reduced by the same psum over the same
+ranks, so per-element addend order — and therefore numerics — is
+unchanged (bitwise-equal to the delegating path on integer-valued
+floats; last-ulp identical elsewhere, same contract as the
+communicator's own bucketing).
 """
 
 from __future__ import annotations
 
-from chainermn_tpu.collectives.base import GradReducer, register_reducer
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.collectives.base import (
+    GradReducer,
+    group_leaves_for_buckets,
+    register_reducer,
+)
 
 
 class FlatReducer(GradReducer):
     """One flat (bucketed, if the communicator buckets) psum per leaf
-    group — exactly ``comm.allreduce_grad``."""
+    group — exactly ``comm.allreduce_grad`` — unless tuned knobs pin an
+    explicit bucket plan (see module docstring)."""
 
     name = "flat"
 
+    def __init__(self, comm, op: str = "mean",
+                 bucket_bytes: Optional[int] = None,
+                 bucket_order: str = "emission"):
+        super().__init__(comm, op, bucket_bytes, bucket_order)
+        self._explicit = (bucket_bytes is not None
+                          or bucket_order != "emission")
+
     def reduce(self, grads, state=()):
-        return self.comm.allreduce_grad(grads, self.op), state
+        if not self._explicit:
+            return self.comm.allreduce_grad(grads, self.op), state
+        comm = self.comm
+        axes = comm.axis_names
+        cdt = comm._grad_dtype
+        n = comm.size
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        out = [None] * len(leaves)
+        passthrough, groups = group_leaves_for_buckets(
+            leaves, axes, self.bucket_bytes,
+            comm_dtype_of=(lambda l: cdt) if cdt is not None else None,
+            order=self.bucket_order)
+        for i in passthrough:  # already global sums under vma tracking
+            out[i] = leaves[i] / n if self.op == "mean" else leaves[i]
+        for (va, comm_dtype), buckets in groups.items():
+            for bucket in buckets:
+                flat = jnp.concatenate(
+                    [leaves[i].astype(comm_dtype).ravel() for i in bucket])
+                red = lax.psum(flat, va)
+                off = 0
+                for i in bucket:
+                    l = leaves[i]
+                    piece = red[off:off + l.size].reshape(l.shape).astype(
+                        l.dtype)
+                    off += l.size
+                    out[i] = piece / n if self.op == "mean" else piece
+        return jax.tree_util.tree_unflatten(treedef, out), state
 
 
 register_reducer("flat", FlatReducer)

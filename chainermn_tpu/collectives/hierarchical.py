@@ -137,8 +137,9 @@ class HierarchicalReducer(GradReducer):
 
     def __init__(self, comm, op: str = "mean",
                  bucket_bytes: Optional[int] = None,
-                 intra: Optional[int] = None):
-        super().__init__(comm, op, bucket_bytes)
+                 intra: Optional[int] = None,
+                 bucket_order: str = "emission"):
+        super().__init__(comm, op, bucket_bytes, bucket_order)
         self.topology = HierTopology(comm, intra=intra)
 
     def reduce(self, grads, state=()):
@@ -150,7 +151,8 @@ class HierarchicalReducer(GradReducer):
         out = [None] * len(leaves)
         passthrough, groups = group_leaves_for_buckets(
             leaves, axes, self.bucket_bytes,
-            comm_dtype_of=(lambda l: cdt) if cdt is not None else None)
+            comm_dtype_of=(lambda l: cdt) if cdt is not None else None,
+            order=self.bucket_order)
         for i in passthrough:  # already global sums under vma tracking
             out[i] = leaves[i] / n if self.op == "mean" else leaves[i]
         for (va, comm_dtype), buckets in groups.items():

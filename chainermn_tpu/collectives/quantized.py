@@ -88,8 +88,13 @@ class QuantizedReducer(GradReducer):
 
     def __init__(self, comm, op: str = "mean",
                  bucket_bytes: Optional[int] = None,
-                 mode: str = "bf16", ef: bool = True):
-        super().__init__(comm, op, bucket_bytes)
+                 mode: str = "bf16", ef: bool = True,
+                 bucket_order: str = "emission"):
+        # bucket_order intentionally NOT forwarded to _plan: the EF
+        # residual layout is pinned to the dtype-grouped pytree-order
+        # plan (checkpoints depend on it) — accepted for signature
+        # parity, validated by the base
+        super().__init__(comm, op, bucket_bytes, bucket_order)
         if mode not in WIRE_ITEMSIZE:
             raise ValueError(f"unknown quantization mode {mode!r}")
         self.mode = mode

@@ -62,11 +62,15 @@ class MultiNodeOptimizer(NamedTuple):
     """Duck-types :class:`optax.GradientTransformation` (same
     ``init``/``update`` fields — optax composes by duck typing) while
     exposing the bound :class:`~chainermn_tpu.collectives.GradReducer`
-    so step factories can shard its state."""
+    so step factories can shard its state, and the tuned
+    :class:`~chainermn_tpu.tuning.profile_db.SchedulePlan` (when
+    ``tune=`` chose the knobs) so reports/benches can log what the
+    tuner picked."""
 
     init: Any
     update: Any
     grad_reducer: Any = None
+    plan: Any = None
 
 
 def create_multi_node_optimizer(
@@ -75,6 +79,8 @@ def create_multi_node_optimizer(
     double_buffering: bool = False,
     op: str = "mean",
     grad_reducer: Any = None,
+    tune: Any = None,
+    model_key: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with the gradient all-reduce.
 
@@ -100,8 +106,52 @@ def create_multi_node_optimizer(
     the driver level (``opt.init(params)`` outside jit) — the residuals
     are per-rank and ride the optimizer state through the step and
     through checkpoints.
+
+    ``tune`` injects a schedtune profile (docs/tuning.md): a
+    :class:`~chainermn_tpu.tuning.profile_db.SchedulePlan`, a
+    :class:`~chainermn_tpu.tuning.profile_db.ProfileDB`, a DB path, or
+    ``True`` for the default DB location. The stored plan's strategy /
+    ``bucket_bytes`` / ``bucket_order`` build the reducer (unless an
+    explicit ``grad_reducer`` was also passed, which wins) and its
+    ``double_buffering`` flag ORs into ``double_buffering``.
+    ``model_key`` selects among plans stored for several model shapes
+    (see ``tuning.model_key_for``; ``None`` accepts a sole/default
+    plan). A plan whose topology fingerprint does not match this
+    communicator's mesh raises ``ValueError`` — the wrong-machine
+    profile bug dlint DL107 flags statically.
     """
     from chainermn_tpu.collectives import make_grad_reducer
+
+    plan = None
+    if tune is not None:
+        from chainermn_tpu.tuning import ProfileDB, SchedulePlan, Topology
+
+        topo = Topology.from_comm(communicator)
+        if isinstance(tune, SchedulePlan):
+            plan = tune
+        else:
+            db = tune if isinstance(tune, ProfileDB) else ProfileDB(
+                tune if isinstance(tune, str) else None)
+            plan = db.plan_for(topo, model_key)
+            if plan is None:
+                raise ValueError(
+                    f"no tuned schedule for topology "
+                    f"{topo.fingerprint()!r} (model_key={model_key!r}) "
+                    f"in profile DB {db.path!r}; run tools/schedtune.py "
+                    "on this machine first")
+        if plan.fingerprint and plan.fingerprint != topo.fingerprint():
+            raise ValueError(
+                f"stale schedule profile: plan was tuned for "
+                f"{plan.fingerprint!r} but this mesh is "
+                f"{topo.fingerprint()!r} — wrong-machine profiles "
+                "silently mis-tune (dlint DL107); re-run "
+                "tools/schedtune.py here")
+        if grad_reducer is None:
+            grad_reducer = make_grad_reducer(
+                plan.strategy, communicator, op=op,
+                bucket_bytes=plan.bucket_bytes,
+                bucket_order=plan.bucket_order)
+        double_buffering = bool(double_buffering or plan.double_buffering)
 
     reducer = make_grad_reducer(grad_reducer, communicator, op=op)
     stateful = bool(reducer is not None and reducer.stateful)
@@ -160,7 +210,7 @@ def create_multi_node_optimizer(
 
         if reducer is None:
             return optax.GradientTransformation(init, update)
-        return MultiNodeOptimizer(init, update, reducer)
+        return MultiNodeOptimizer(init, update, reducer, plan)
 
     def init_st(params):
         return _ReducerWrappedState(
@@ -173,4 +223,4 @@ def create_multi_node_optimizer(
         updates, inner = inner_update(grads, state.inner, params, **extra)
         return updates, _ReducerWrappedState(inner=inner, reducer=rstate)
 
-    return MultiNodeOptimizer(init_st, update_st, reducer)
+    return MultiNodeOptimizer(init_st, update_st, reducer, plan)

@@ -65,6 +65,43 @@ class ReductionReport:
         trainer.observation["comm/strategy"] = self.reducer.name
 
 
+class TuningReport:
+    """Surfaces the schedtune-chosen collective schedule
+    (docs/tuning.md) beside :class:`ReductionReport`.
+
+    ``plan`` is a :class:`~chainermn_tpu.tuning.profile_db.SchedulePlan`
+    or anything carrying one as ``.plan`` (a tuned
+    ``create_multi_node_optimizer`` result works directly). On the
+    first call it prints the chosen schedule once; on every call it
+    folds ``tuning/overlap_frac``, ``tuning/bucket_bytes``, and
+    ``tuning/strategy`` into ``trainer.observation`` so bench runs log
+    what the tuner picked. No-op when there is no plan (untuned runs
+    stay byte-identical in their logs).
+    """
+
+    def __init__(self, plan, quiet: bool = False):
+        self.plan = getattr(plan, "plan", plan)
+        self.quiet = quiet
+        self._printed = False
+
+    def __call__(self, trainer):
+        plan = self.plan
+        if plan is None:
+            return
+        if not self._printed and not self.quiet:
+            db = " +double_buffering" if plan.double_buffering else ""
+            print(
+                f"schedtune: {plan.strategy} "
+                f"bucket_bytes={plan.bucket_bytes:,} "
+                f"order={plan.bucket_order}{db} "
+                f"overlap_frac={plan.overlap_fraction:.4f} "
+                f"[{plan.source}] ({plan.fingerprint})", flush=True)
+            self._printed = True
+        trainer.observation["tuning/overlap_frac"] = plan.overlap_fraction
+        trainer.observation["tuning/bucket_bytes"] = plan.bucket_bytes
+        trainer.observation["tuning/strategy"] = plan.strategy
+
+
 class PrintReport:
     def __init__(self, keys: List[str]):
         self.keys = keys

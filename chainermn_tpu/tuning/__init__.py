@@ -1,0 +1,41 @@
+"""schedtune — the AOT overlap-driven collective-schedule autotuner.
+
+The feedback loop the ROADMAP asked for: dlint's DL201/DL203 passes
+measure how much of the backward window the compiler's schedule
+actually uses; this package searches the reducer knob space
+(``bucket_bytes``, bucket emission order, ``double_buffering``,
+strategy) against those measurements plus an explicit multi-tier
+:class:`Topology` cost model, and persists the winner in a per-topology
+JSON profile DB that ``create_multi_node_optimizer(tune=...)`` and
+``AutoReducer(profile=...)`` consume. The whole search runs off-TPU
+(AOT-compiled or canned scheduled HLO); on-TPU ``measure_strategies``
+sweeps feed the same DB (``db=``). One CLI: ``tools/schedtune.py``.
+See docs/tuning.md.
+"""
+
+from chainermn_tpu.tuning.canned import (  # noqa: F401
+    canned_compile_fn,
+    canned_schedule_hlo,
+)
+from chainermn_tpu.tuning.profile_db import (  # noqa: F401
+    ProfileDB,
+    SchedulePlan,
+    default_db_path,
+    model_key_for,
+)
+from chainermn_tpu.tuning.topology import (  # noqa: F401
+    Tier,
+    Topology,
+    single_tier,
+    two_tier,
+)
+from chainermn_tpu.tuning.tuner import (  # noqa: F401
+    Candidate,
+    TuningResult,
+    default_candidates,
+    default_flat_candidate,
+    estimate_comm_us,
+    score_candidate,
+    tune,
+    tune_canned,
+)

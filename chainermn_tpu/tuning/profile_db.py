@@ -1,0 +1,179 @@
+"""The persistent per-topology schedule-profile DB.
+
+One JSON file maps a topology fingerprint (:meth:`Topology.fingerprint`)
+to (a) tuned :class:`SchedulePlan` winners keyed by model shape and
+(b) measured ``measure_strategies`` sweeps — so a single on-TPU run
+permanently improves off-TPU tuning for that machine shape. Consumed by
+``AutoReducer(profile=...)`` and
+``create_multi_node_optimizer(tune=...)``; written by
+``tools/schedtune.py`` and ``measure_strategies(db=...)``.
+
+File layout (version 1)::
+
+    {"version": 1,
+     "plans":    {"<fingerprint>": {"<model_key>": {<SchedulePlan>}}},
+     "measured": {"<fingerprint>": {"<strategy>:<bytes>": <us>}}}
+
+Loading a profile written for a DIFFERENT fingerprint is the
+wrong-machine bug dlint DL107 flags statically and
+``create_multi_node_optimizer(tune=...)`` refuses at runtime — a plan
+tuned for one machine silently mis-tunes another.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple, Union
+
+from chainermn_tpu.tuning.topology import Topology
+
+#: env override for the default DB location (CI / multi-user hosts)
+PROFILE_DB_ENV = "CHAINERMN_TPU_PROFILE_DB"
+_DEFAULT_PATH = os.path.join("~", ".cache", "chainermn_tpu",
+                             "schedtune.json")
+
+
+def default_db_path() -> str:
+    return os.path.expanduser(
+        os.environ.get(PROFILE_DB_ENV) or _DEFAULT_PATH)
+
+
+def model_key_for(tree) -> str:
+    """Deterministic model-shape key: leaf count, total payload bytes,
+    and a digest of the (path, shape, dtype) list. Works on concrete or
+    abstract (``jax.eval_shape``) pytrees."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves_kp, _ = jax.tree_util.tree_flatten_with_path(tree)
+    rows, total = [], 0
+    for kp, leaf in leaves_kp:
+        dt = jnp.dtype(getattr(leaf, "dtype", jnp.float32))
+        shape = tuple(getattr(leaf, "shape", ()))
+        total += int(jnp.size(leaf)) * dt.itemsize
+        rows.append(f"{jax.tree_util.keystr(kp)}:{shape}:{dt.name}")
+    digest = hashlib.sha1("\n".join(rows).encode()).hexdigest()[:8]
+    return f"{len(rows)}l-{total}B-{digest}"
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulePlan:
+    """One tuned collective schedule: the reducer knobs plus the
+    evidence that chose them. ``buckets`` is the per-bucket
+    ``(algorithm, payload_bytes)`` assignment (informational — the
+    reducer re-plans from ``bucket_bytes``/``bucket_order`` at run
+    time, which keeps the plan valid across minor model edits)."""
+
+    fingerprint: str
+    model_key: str
+    strategy: str
+    bucket_bytes: int
+    bucket_order: str = "emission"
+    double_buffering: bool = False
+    overlap_fraction: float = 0.0
+    est_exposed_us: float = 0.0
+    #: 'canned' (emulated schedule), 'aot' (real compiled HLO), or
+    #: 'measured' (on-TPU sweep contributed to the cost side)
+    source: str = "canned"
+    buckets: Tuple[Tuple[str, int], ...] = ()
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["buckets"] = [list(b) for b in self.buckets]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SchedulePlan":
+        d = dict(d)
+        d["buckets"] = tuple(
+            (str(a), int(n)) for a, n in d.get("buckets", ()))
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def _fp(topology: Union[Topology, str]) -> str:
+    return (topology if isinstance(topology, str)
+            else topology.fingerprint())
+
+
+class ProfileDB:
+    """JSON-file profile store with atomic writes.
+
+    ``path=None`` resolves ``$CHAINERMN_TPU_PROFILE_DB`` then the
+    default ``~/.cache/chainermn_tpu/schedtune.json``. A missing or
+    unreadable file is an empty DB, never an error — tuning must work
+    on a fresh machine.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = os.path.expanduser(path) if path else default_db_path()
+        self._data: Dict[str, Any] = {
+            "version": 1, "plans": {}, "measured": {}}
+        try:
+            with open(self.path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, dict) and loaded.get("version") == 1:
+                self._data.update(loaded)
+        except (OSError, ValueError):
+            pass
+
+    # -- plans ----------------------------------------------------------
+    def put_plan(self, plan: SchedulePlan) -> None:
+        self._data["plans"].setdefault(
+            plan.fingerprint, {})[plan.model_key] = plan.to_dict()
+
+    def plan_for(self, topology: Union[Topology, str],
+                 model_key: Optional[str] = None
+                 ) -> Optional[SchedulePlan]:
+        """The stored plan for this topology (and model shape).
+
+        ``model_key=None`` accepts a sole stored plan or one stored
+        under the ``'default'`` key; ambiguity returns ``None`` rather
+        than guessing."""
+        entries = self._data["plans"].get(_fp(topology), {})
+        if model_key is not None:
+            d = entries.get(model_key)
+        elif len(entries) == 1:
+            d = next(iter(entries.values()))
+        else:
+            d = entries.get("default")
+        return SchedulePlan.from_dict(d) if d else None
+
+    # -- measured sweeps ------------------------------------------------
+    def put_measured(self, topology: Union[Topology, str],
+                     table: Dict[Tuple[str, int], float]) -> None:
+        dst = self._data["measured"].setdefault(_fp(topology), {})
+        for (strategy, nbytes), us in table.items():
+            dst[f"{strategy}:{int(nbytes)}"] = float(us)
+
+    def measured_for(self, topology: Union[Topology, str]
+                     ) -> Dict[Tuple[str, int], float]:
+        out: Dict[Tuple[str, int], float] = {}
+        for key, us in self._data["measured"].get(_fp(topology),
+                                                  {}).items():
+            strategy, _, nbytes = key.rpartition(":")
+            out[(strategy, int(nbytes))] = float(us)
+        return out
+
+    # -- persistence ----------------------------------------------------
+    def save(self) -> str:
+        """Atomic write (tmp + rename, same publish discipline as the
+        checkpointer); returns the path."""
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".schedtune-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._data, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return self.path

@@ -1,0 +1,197 @@
+"""Explicit multi-tier machine topology for schedule tuning.
+
+Replaces the single intra/inter split hard-coded into
+``collectives/auto.py``: TACCL (arxiv 2111.04867) and HiCCL (arxiv
+2408.05962) both show that a schedule search needs the machine described
+as an explicit hierarchy of tiers — each with its own size, launch
+latency (alpha) and per-rank bandwidth (beta) — rather than a boolean
+"is there a slow tier?". A :class:`Topology` is an ordered tuple of
+:class:`Tier` objects, fastest (innermost — ICI ring/torus dims) first,
+slowest (DCN) last, plus a deterministic :meth:`~Topology.fingerprint`
+that keys the persistent profile DB (:mod:`.profile_db`).
+
+This module is deliberately leaf-level: stdlib only, no jax, no imports
+from the rest of ``chainermn_tpu`` — both ``collectives/`` and
+``tuning/`` import it without cycles.
+
+Cost model: standard alpha-beta with ring-allreduce byte counts
+(``2·b·(k-1)/k`` per rank over a k-ring). The two-tier defaults are the
+same v5e-flavored numbers ``collectives.auto.CostModel`` has always
+used (ICI ~100 GB/s / ~1 µs, DCN ~25 GB/s / ~100 µs —
+docs/scaling_model.md); for two tiers :meth:`Topology.estimate_us` is
+algebraically identical to the old model, so the ``auto`` reducer's
+crossover structure is unchanged. See docs/tuning.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+#: default per-tier parameters (microseconds, GB/s) — order-of-magnitude
+#: v5e numbers; override per tier or via measured sweeps (profile DB)
+ICI_LATENCY_US = 1.0
+ICI_BW_GBPS = 100.0
+DCN_LATENCY_US = 100.0
+DCN_BW_GBPS = 25.0
+#: quantize/dequantize kernel overhead for the bf16-wire strategy
+QUANT_OVERHEAD_US = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class Tier:
+    """One level of the machine hierarchy: ``size`` ranks connected at
+    ``bw_gbps`` per rank with ``latency_us`` launch latency."""
+
+    name: str
+    size: int
+    latency_us: float
+    bw_gbps: float
+
+
+def _ring_bytes(nbytes: float, k: int) -> float:
+    return 2.0 * nbytes * (k - 1) / max(k, 1)
+
+
+def _xfer_us(nbytes: float, bw_gbps: float) -> float:
+    return nbytes / (bw_gbps * 1e3)  # 1 GB/s == 1e3 bytes/us
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Ordered multi-tier topology, innermost/fastest tier first.
+
+    ``platform``/``device_kind`` only feed :meth:`fingerprint` — a
+    profile measured on one device kind must not silently tune another.
+    """
+
+    tiers: Tuple[Tier, ...]
+    platform: str = "cpu"
+    device_kind: str = ""
+    quant_overhead_us: float = QUANT_OVERHEAD_US
+
+    def __post_init__(self):
+        if not self.tiers:
+            raise ValueError("Topology needs at least one tier")
+
+    # -- shape ----------------------------------------------------------
+    @property
+    def n(self) -> int:
+        out = 1
+        for t in self.tiers:
+            out *= t.size
+        return out
+
+    @property
+    def intra(self) -> int:
+        return self.tiers[0].size
+
+    @property
+    def inter(self) -> int:
+        return self.n // self.intra
+
+    def fingerprint(self) -> str:
+        """Deterministic key for the profile DB: platform, device kind,
+        and the per-tier sizes — everything a schedule choice depends
+        on, nothing it doesn't (no hostnames, no PIDs, no timestamps)."""
+        kind = (self.device_kind or "generic").lower().replace(" ", "-")
+        dims = "+".join(f"{t.name}:{t.size}" for t in self.tiers)
+        return f"{self.platform}:{kind}/{dims}"
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_comm(cls, comm, intra: Optional[int] = None,
+                  ici_latency_us: float = ICI_LATENCY_US,
+                  ici_bw_gbps: float = ICI_BW_GBPS,
+                  dcn_latency_us: float = DCN_LATENCY_US,
+                  dcn_bw_gbps: float = DCN_BW_GBPS,
+                  quant_overhead_us: Optional[float] = None) -> "Topology":
+        """Describe a communicator's mesh as tiers.
+
+        Same topology-resolution rules as
+        ``collectives.hierarchical.HierTopology``: a ≥2-axis mesh (the
+        ``('dcn', 'ici')`` factory layout) takes its LAST axis as the
+        fast/ICI tier and every preceding axis as a DCN tier; a
+        single-axis mesh is factored into ``inter × intra`` with
+        ``intra`` defaulting to ``comm.intra_size`` (degenerate: one
+        tier when that doesn't divide the axis). Size-1 outer tiers are
+        dropped so single-host fingerprints stay stable.
+        """
+        if quant_overhead_us is None:
+            quant_overhead_us = QUANT_OVERHEAD_US
+        dev = comm.mesh.devices.flat[0]
+        platform = getattr(dev, "platform", "cpu")
+        kind = getattr(dev, "device_kind", "") or ""
+        axes = comm.axis_names
+        if len(axes) >= 2 and intra is None:
+            sizes = dict(zip(comm.mesh.axis_names, comm.mesh.devices.shape))
+            tiers = [Tier(axes[-1], sizes[axes[-1]],
+                          ici_latency_us, ici_bw_gbps)]
+            for ax in reversed(axes[:-1]):  # innermost-out
+                if sizes[ax] > 1:
+                    tiers.append(Tier(ax, sizes[ax],
+                                      dcn_latency_us, dcn_bw_gbps))
+            return cls(tuple(tiers), platform, kind, quant_overhead_us)
+        n = comm.size
+        if intra is None:
+            intra = comm.intra_size
+            if not (1 <= intra <= n and n % intra == 0):
+                intra = n  # degenerate: one tier
+        if not (1 <= intra <= n and n % intra == 0):
+            raise ValueError(
+                f"intra {intra} must divide communicator size {n}")
+        tiers = [Tier("ici", intra, ici_latency_us, ici_bw_gbps)]
+        if n // intra > 1:
+            tiers.append(Tier("dcn", n // intra,
+                              dcn_latency_us, dcn_bw_gbps))
+        return cls(tuple(tiers), platform, kind, quant_overhead_us)
+
+    # -- the cost model -------------------------------------------------
+    def estimate_us(self, strategy: str, nbytes: int) -> float:
+        """Modeled time for ONE reduction of ``nbytes`` payload.
+
+        ``flat``: one allreduce whose ring crosses the slowest tier.
+        ``hierarchical``: reduce-scatter + all-gather on the innermost
+        tier, then an allreduce per outer tier carrying ``1/intra`` of
+        the bytes. ``quantized``: flat at bf16 wire width plus the
+        (de)quantize kernel overhead. For a two-tier topology these are
+        exactly the ``collectives.auto.CostModel`` formulas.
+        """
+        slow = self.tiers[-1]
+        if strategy == "flat":
+            return slow.latency_us + _xfer_us(
+                _ring_bytes(nbytes, self.n), slow.bw_gbps)
+        if strategy == "hierarchical":
+            t0 = self.tiers[0]
+            t = 2 * t0.latency_us + _xfer_us(
+                _ring_bytes(nbytes, t0.size), t0.bw_gbps)  # rs + ag
+            carried = nbytes / t0.size
+            for tier in self.tiers[1:]:
+                t += tier.latency_us + _xfer_us(
+                    _ring_bytes(carried, tier.size), tier.bw_gbps)
+            return t
+        if strategy == "quantized":
+            wire = nbytes * 2 / 4.0  # bf16 wire over f32 payload
+            return (slow.latency_us + self.quant_overhead_us
+                    + _xfer_us(_ring_bytes(wire, self.n), slow.bw_gbps))
+        raise ValueError(f"unknown strategy {strategy!r}")
+
+    def describe(self) -> str:
+        return " → ".join(
+            f"{t.name}[{t.size}] {t.bw_gbps}GB/s/{t.latency_us}us"
+            for t in self.tiers)
+
+
+def single_tier(n: int, name: str = "ici",
+                latency_us: float = ICI_LATENCY_US,
+                bw_gbps: float = ICI_BW_GBPS) -> Topology:
+    """A one-tier test/CLI convenience topology."""
+    return Topology((Tier(name, n, latency_us, bw_gbps),))
+
+
+def two_tier(intra: int, inter: int) -> Topology:
+    """The classic ICI×DCN shape with default parameters."""
+    tiers = [Tier("ici", intra, ICI_LATENCY_US, ICI_BW_GBPS)]
+    if inter > 1:
+        tiers.append(Tier("dcn", inter, DCN_LATENCY_US, DCN_BW_GBPS))
+    return Topology(tuple(tiers), platform="tpu")

@@ -23,7 +23,7 @@ def _only(findings, rule):
 
 def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
-            "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
+            "DL107", "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
         assert rule.kind in ("ast", "hlo")
@@ -593,3 +593,56 @@ def test_dl106_suppression_with_rationale():
         return grads
     """
     assert _only(_lint(src), "DL106") == []
+
+
+# ---------------------------------------------------------------------------
+# DL107 — stale-schedule-profile
+# ---------------------------------------------------------------------------
+
+
+def test_dl107_flags_hardcoded_fingerprint_lookup():
+    src = """\
+    def load_plan(db):
+        return db.plan_for("tpu:v5e/ici:4+dcn:2")
+    """
+    fs = _only(_lint(src), "DL107")
+    assert len(fs) == 1
+    assert fs[0].line == 2
+    assert "tpu:v5e/ici:4+dcn:2" in fs[0].message
+    assert "docs/static_analysis.md#dl107" in fs[0].message
+
+
+def test_dl107_flags_measured_lookup_and_topology_kwarg():
+    src = """\
+    def load_sweep(db):
+        return db.measured_for(topology="cpu:generic/ici:8")
+    """
+    assert len(_only(_lint(src), "DL107")) == 1
+
+
+def test_dl107_clean_on_live_topology_lookup():
+    src = """\
+    def load_plan(db, comm):
+        topo = Topology.from_comm(comm)
+        return db.plan_for(topo)
+    """
+    assert _only(_lint(src), "DL107") == []
+
+
+def test_dl107_clean_on_variable_key():
+    # documented limit: a literal laundered through a variable is the
+    # reader's responsibility, not a false positive
+    src = """\
+    def load_plan(db, key):
+        return db.plan_for(key)
+    """
+    assert _only(_lint(src), "DL107") == []
+
+
+def test_dl107_suppression_with_rationale():
+    src = """\
+    def load_plan(db):
+        # fixture: this test pins the exact machine it was recorded on
+        return db.plan_for("tpu:v5e/ici:4+dcn:2")  # dlint: disable=DL107
+    """
+    assert _only(_lint(src), "DL107") == []

@@ -13,6 +13,7 @@ from chainermn_tpu.analysis import (
     check_dp_overlap,
     check_fsdp_gather_liveness,
     check_pipeline_permute_overlap,
+    dp_overlap_fraction,
     parse_computations,
     scheduled_entry_ops,
 )
@@ -95,6 +96,24 @@ def test_dl201_unscheduled_module_is_not_ok():
     out = check_dp_overlap(_DP_OVERLAPPED.replace(
         ", is_scheduled=true", ""))
     assert out["ok"] is False
+
+
+def test_dl201_overlap_fraction_counts_hidden_backward_window():
+    # 1 of 2 backward fusions issues after the first all-reduce-start
+    assert check_dp_overlap(_DP_OVERLAPPED)["overlap_fraction"] == 0.5
+    # serialized: the all-reduce issues after ALL backward work
+    assert check_dp_overlap(_DP_SERIALIZED)["overlap_fraction"] == 0.0
+
+
+def test_dl201_overlap_fraction_is_zero_when_unmeasurable():
+    # unscheduled modules can't claim overlap (schedule order unknown)
+    unsched = _DP_OVERLAPPED.replace(", is_scheduled=true", "")
+    assert check_dp_overlap(unsched)["overlap_fraction"] == 0.0
+
+
+def test_dp_overlap_fraction_scalar_wrapper():
+    assert dp_overlap_fraction(_DP_OVERLAPPED) == 0.5
+    assert dp_overlap_fraction(_DP_SERIALIZED) == 0.0
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,120 @@
+"""ProfileDB: JSON round-trips, key resolution, atomic persistence.
+
+Plans and measured sweeps must survive a save/load cycle EXACTLY — the
+DB is the contract between a one-off schedtune/on-TPU run and every
+later training run that consumes it.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.tuning import (
+    ProfileDB,
+    SchedulePlan,
+    default_db_path,
+    model_key_for,
+    single_tier,
+    two_tier,
+)
+from chainermn_tpu.tuning.profile_db import PROFILE_DB_ENV
+
+
+def _plan(fp="tpu:generic/ici:4+dcn:2", model_key="default", **kw):
+    base = dict(fingerprint=fp, model_key=model_key, strategy="flat",
+                bucket_bytes=1 << 20, bucket_order="size",
+                overlap_fraction=0.96875, est_exposed_us=12.5,
+                source="canned", buckets=(("flat", 1 << 20),
+                                          ("flat", 1 << 19)))
+    base.update(kw)
+    return SchedulePlan(**base)
+
+
+def test_plan_round_trips_through_file(tmp_path):
+    p = str(tmp_path / "db.json")
+    plan = _plan()
+    db = ProfileDB(p)
+    db.put_plan(plan)
+    assert db.save() == p
+
+    loaded = ProfileDB(p).plan_for(two_tier(4, 2))
+    assert loaded == plan  # frozen dataclass equality: every field
+
+
+def test_plan_dict_round_trip_filters_unknown_keys():
+    d = _plan().to_dict()
+    d["future_field"] = "ignored"
+    assert SchedulePlan.from_dict(d) == _plan()
+
+
+def test_plan_for_resolves_sole_entry_without_model_key(tmp_path):
+    db = ProfileDB(str(tmp_path / "db.json"))
+    db.put_plan(_plan(model_key="3l-1234B-abcd1234"))
+    assert db.plan_for(two_tier(4, 2)).model_key == "3l-1234B-abcd1234"
+
+
+def test_plan_for_prefers_default_key_when_ambiguous(tmp_path):
+    db = ProfileDB(str(tmp_path / "db.json"))
+    db.put_plan(_plan(model_key="default", bucket_bytes=1 << 20))
+    db.put_plan(_plan(model_key="other", bucket_bytes=4 << 20))
+    assert db.plan_for(two_tier(4, 2)).bucket_bytes == 1 << 20
+    assert db.plan_for(two_tier(4, 2), "other").bucket_bytes == 4 << 20
+
+
+def test_plan_for_misses_other_fingerprints(tmp_path):
+    db = ProfileDB(str(tmp_path / "db.json"))
+    db.put_plan(_plan())
+    assert db.plan_for(single_tier(8)) is None
+
+
+def test_measured_sweep_round_trips_tuple_keys(tmp_path):
+    p = str(tmp_path / "db.json")
+    table = {("flat", 1 << 20): 120.5, ("hierarchical", 1 << 20): 80.25}
+    db = ProfileDB(p)
+    db.put_measured(two_tier(4, 2), table)
+    db.save()
+    assert ProfileDB(p).measured_for(two_tier(4, 2)) == table
+    assert ProfileDB(p).measured_for(single_tier(8)) == {}
+
+
+def test_saved_file_is_plain_versioned_json(tmp_path):
+    p = str(tmp_path / "db.json")
+    db = ProfileDB(p)
+    db.put_plan(_plan())
+    db.save()
+    with open(p) as f:
+        raw = json.load(f)
+    assert raw["version"] == 1
+    assert "tpu:generic/ici:4+dcn:2" in raw["plans"]
+    # no stray tmp files left behind by the atomic write
+    assert [n for n in os.listdir(tmp_path)
+            if n.startswith(".schedtune-")] == []
+
+
+def test_corrupt_or_missing_file_is_an_empty_db(tmp_path):
+    missing = ProfileDB(str(tmp_path / "nope.json"))
+    assert missing.plan_for(two_tier(4, 2)) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert ProfileDB(str(bad)).plan_for(two_tier(4, 2)) is None
+
+
+def test_env_var_overrides_default_path(tmp_path, monkeypatch):
+    monkeypatch.setenv(PROFILE_DB_ENV, str(tmp_path / "env.json"))
+    assert default_db_path() == str(tmp_path / "env.json")
+    assert ProfileDB().path == str(tmp_path / "env.json")
+
+
+def test_model_key_is_shape_deterministic():
+    tree_a = {"w": np.zeros((4, 3), np.float32),
+              "b": np.zeros((3,), np.float32)}
+    tree_b = {"w": np.ones((4, 3), np.float32),  # values don't matter
+              "b": np.ones((3,), np.float32)}
+    tree_c = {"w": np.zeros((4, 4), np.float32),  # shape does
+              "b": np.zeros((3,), np.float32)}
+    key = model_key_for(tree_a)
+    assert key == model_key_for(tree_b)
+    assert key != model_key_for(tree_c)
+    assert key.startswith("2l-60B-")

@@ -1,0 +1,68 @@
+"""tools/schedtune.py smoke tests: the canned search end-to-end as a
+subprocess — argument parsing, the JSON contract, and the DB write
+(the artifact every later --tune run consumes).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from chainermn_tpu.tuning import ProfileDB, two_tier
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CLI = os.path.join(_REPO, "tools", "schedtune.py")
+
+
+def _run(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, _CLI, *args], env=env, capture_output=True,
+        text=True, timeout=120)
+
+
+def _json_line(stdout):
+    return json.loads(stdout.strip().splitlines()[-1])
+
+
+def test_canned_search_improves_overlap_and_writes_db(tmp_path):
+    db = str(tmp_path / "db.json")
+    r = _run("--intra", "4", "--inter", "2", "--db", db)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = _json_line(r.stdout)
+    assert out["ok"] is True
+    assert out["source"] == "canned"
+    assert out["improves_overlap"] is True
+    assert (out["chosen"]["overlap_fraction"]
+            > out["default"]["overlap_fraction"])
+    assert out["db"] == db
+    # the written plan is loadable and matches the printed choice
+    plan = ProfileDB(db).plan_for(two_tier(4, 2))
+    assert plan is not None
+    assert plan.to_dict() == out["chosen"]
+    # the human-readable summary goes to stderr, data to stdout
+    assert "chosen schedule" in r.stderr
+
+
+def test_no_write_leaves_no_db(tmp_path):
+    db = str(tmp_path / "db.json")
+    r = _run("--intra", "8", "--inter", "1", "--db", db, "--no-write")
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = _json_line(r.stdout)
+    assert out["db"] is None
+    assert not os.path.exists(db)
+
+
+def test_unknown_argument_is_a_usage_error(tmp_path):
+    r = _run("--frobnicate")
+    assert r.returncode != 0
+
+
+def test_grad_bytes_changes_the_bucket_count(tmp_path):
+    db = str(tmp_path / "db.json")
+    r = _run("--intra", "8", "--inter", "1", "--db", db,
+             "--grad-bytes", str(2 << 20))
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = _json_line(r.stdout)
+    assert out["grad_bytes"] == 2 << 20

@@ -1,0 +1,134 @@
+"""Multi-tier Topology: fingerprints, from_comm resolution, and the
+cost model's algebraic identity with the two-tier CostModel it replaces.
+
+Pure-host tests (the comm fixture only describes the mesh; no
+collectives run), so they're tier-1 at near-zero cost.
+"""
+
+import types
+
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.collectives import CostModel
+from chainermn_tpu.tuning import Tier, Topology, single_tier, two_tier
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+# ---------------------------------------------------------------------------
+# shape + fingerprint
+# ---------------------------------------------------------------------------
+
+def test_single_tier_shape_and_fingerprint():
+    t = single_tier(8)
+    assert (t.n, t.intra, t.inter) == (8, 8, 1)
+    assert t.fingerprint() == "cpu:generic/ici:8"
+
+
+def test_two_tier_shape_and_fingerprint():
+    t = two_tier(4, 2)
+    assert (t.n, t.intra, t.inter) == (8, 4, 2)
+    assert t.fingerprint() == "tpu:generic/ici:4+dcn:2"
+
+
+def test_fingerprint_has_no_volatile_components():
+    # same description -> same key, always (it keys the profile DB)
+    assert two_tier(4, 2).fingerprint() == two_tier(4, 2).fingerprint()
+    # device kind is normalized (lowercase, no spaces)
+    t = Topology((Tier("ici", 4, 1.0, 100.0),), platform="tpu",
+                 device_kind="TPU v5 lite")
+    assert t.fingerprint() == "tpu:tpu-v5-lite/ici:4"
+
+
+def test_empty_topology_rejected():
+    with pytest.raises(ValueError):
+        Topology(())
+
+
+# ---------------------------------------------------------------------------
+# from_comm: mesh -> tiers
+# ---------------------------------------------------------------------------
+
+def test_from_comm_single_axis_mesh(comm):
+    t = Topology.from_comm(comm)
+    assert t.n == comm.size
+    assert t.platform == "cpu"
+    assert t.fingerprint().startswith("cpu:")
+    # intra_size == size on one host -> a single tier, no size-1 dcn
+    assert len(t.tiers) == 1
+
+
+def test_from_comm_explicit_intra_factors_the_axis(comm):
+    t = Topology.from_comm(comm, intra=4)
+    assert [tier.size for tier in t.tiers] == [4, comm.size // 4]
+    assert t.tiers[0].name == "ici"
+    assert t.tiers[1].name == "dcn"
+
+
+def test_from_comm_bad_intra_rejected(comm):
+    with pytest.raises(ValueError):
+        Topology.from_comm(comm, intra=3)  # does not divide 8
+
+
+def test_from_comm_forwards_tier_parameters(comm):
+    t = Topology.from_comm(comm, intra=4, ici_bw_gbps=55.0,
+                           dcn_latency_us=7.0)
+    assert t.tiers[0].bw_gbps == 55.0
+    assert t.tiers[1].latency_us == 7.0
+
+
+# ---------------------------------------------------------------------------
+# cost model: exact identity with collectives.auto.CostModel (2 tiers)
+# ---------------------------------------------------------------------------
+
+def _hier_shape(n, intra):
+    # CostModel.estimate_us only reads n/intra/inter off the topo arg
+    return types.SimpleNamespace(n=n, intra=intra, inter=n // intra)
+
+
+@pytest.mark.parametrize("strategy", ["flat", "hierarchical", "quantized"])
+@pytest.mark.parametrize("nbytes", [1 << 16, 4 << 20, 51 << 20])
+def test_two_tier_estimates_match_cost_model(strategy, nbytes):
+    old = CostModel()
+    new = two_tier(4, 2)
+    assert new.estimate_us(strategy, nbytes) == pytest.approx(
+        old.estimate_us(strategy, nbytes, _hier_shape(8, 4)), rel=1e-12)
+
+
+@pytest.mark.parametrize("strategy", ["flat", "hierarchical", "quantized"])
+def test_single_tier_estimates_match_cost_model(strategy):
+    old = CostModel()
+    new = single_tier(8)
+    assert new.estimate_us(strategy, 4 << 20) == pytest.approx(
+        old.estimate_us(strategy, 4 << 20, _hier_shape(8, 8)), rel=1e-12)
+
+
+def test_cost_model_as_topology_is_the_same_estimator(comm):
+    cost = CostModel(ici_bw_gbps=42.0, dcn_latency_us=9.0)
+    topo = cost.as_topology(comm, intra=4)
+    from chainermn_tpu.collectives import HierTopology
+
+    hier = HierTopology(comm, intra=4)
+    for strategy in ("flat", "hierarchical", "quantized"):
+        assert topo.estimate_us(strategy, 8 << 20) == pytest.approx(
+            cost.estimate_us(strategy, 8 << 20, hier), rel=1e-12)
+
+
+def test_hierarchical_beats_flat_across_a_slow_tier():
+    t = two_tier(4, 2)
+    b = 4 << 20
+    assert t.estimate_us("hierarchical", b) < t.estimate_us("flat", b)
+
+
+def test_unknown_strategy_rejected():
+    with pytest.raises(ValueError):
+        single_tier(8).estimate_us("psum_scatter", 1 << 20)
+
+
+def test_describe_mentions_every_tier():
+    d = two_tier(4, 2).describe()
+    assert "ici[4]" in d and "dcn[2]" in d

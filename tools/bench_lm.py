@@ -8,8 +8,14 @@ compute, data-parallel step factory. Prints one JSON line per config.
 
 Usage: python tools/bench_lm.py [d_model n_layers seq_len batch
                                  [loss [d_head [qkv_layout]]]]
-                                [--autotune-blocks]
+                                [--autotune-blocks] [--tune[=DB_PATH]]
                                 [--grad-reducer=flat,hierarchical,...]
+  --tune: build the optimizer from the schedtune profile DB
+  (create_multi_node_optimizer(tune=...), docs/tuning.md; default DB
+  path unless =DB_PATH given — run tools/schedtune.py first). The JSON
+  line gains the chosen plan's ``tuning/overlap_frac``,
+  ``tuning/bucket_bytes``, and ``tuning/strategy``; off TPU the
+  throughput delta of the tuned plan is the same honest null as below.
   --grad-reducer: comma-separated gradient-reduction strategies
   (collectives/ registry: flat | hierarchical | quantized | auto); one
   JSON line per strategy, with the strategy's per-step payload and wire
@@ -41,7 +47,8 @@ import numpy as np
 
 def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
             loss_kind="unfused", d_head=64, scan_k=4, n_iters=6,
-            qkv_layout="blhd", autotune_blocks=False, grad_reducer=None):
+            qkv_layout="blhd", autotune_blocks=False, grad_reducer=None,
+            tune=None):
     """Measure LM training throughput; returns (tokens_per_sec_per_chip,
     config dict). Importable — bench.py reuses this as its LM gate."""
     import jax
@@ -82,7 +89,10 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
 
         reducer = make_grad_reducer(grad_reducer, comm)
     opt = chainermn_tpu.create_multi_node_optimizer(
-        optax.adamw(3e-4), comm, grad_reducer=reducer)
+        optax.adamw(3e-4), comm, grad_reducer=reducer, tune=tune)
+    plan = getattr(opt, "plan", None)
+    if plan is not None and reducer is None:
+        reducer = opt.grad_reducer  # the plan-built reducer
     # K steps per dispatch: measures the device, not the tunnel's ~100 ms
     # dispatch round-trip (same methodology as bench.py; the token stack
     # reuses ONE device batch K times to avoid the ~10 MB/s tunnel)
@@ -134,6 +144,11 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
         config["comm_bytes_per_step"] = sum(r["bytes"] for r in rows)
         config["comm_wire_bytes_per_step"] = sum(
             r["wire_bytes"] for r in rows)
+    if plan is not None:
+        config["tuning/overlap_frac"] = plan.overlap_fraction
+        config["tuning/bucket_bytes"] = plan.bucket_bytes
+        config["tuning/strategy"] = plan.strategy
+        config["tuning/source"] = plan.source
     return tokens_per_sec / comm.size, config
 
 
@@ -146,6 +161,11 @@ def main():
     for a in list(argv):
         if a.startswith("--grad-reducer"):
             reducers = a.split("=", 1)[1].split(",")
+            argv.remove(a)
+    tune = None
+    for a in list(argv):
+        if a.startswith("--tune"):
+            tune = a.split("=", 1)[1] if "=" in a else True
             argv.remove(a)
     d_model = int(argv[0]) if len(argv) > 0 else 768
     n_layers = int(argv[1]) if len(argv) > 1 else 12
@@ -160,7 +180,7 @@ def main():
                                        loss_kind, d_head,
                                        qkv_layout=qkv_layout,
                                        autotune_blocks=autotune,
-                                       grad_reducer=gr)
+                                       grad_reducer=gr, tune=tune)
         except ValueError as e:
             raise SystemExit(str(e))
         print(json.dumps({

@@ -5,10 +5,14 @@ per variant.
 
 Usage: python tools/bench_sweep.py BATCH N_SCAN S2D
                                    [--grad-reducer=flat,hierarchical,...]
+                                   [--tune[=DB_PATH]]
   --grad-reducer sweeps collectives/ strategies; each line carries the
   strategy's per-step payload and wire bytes from the reducer's bucket
   plan. Off TPU the throughput deltas are an honest null (BASELINE.md);
-  the byte accounting is exact everywhere."""
+  the byte accounting is exact everywhere.
+  --tune builds the optimizer from the schedtune profile DB
+  (docs/tuning.md; run tools/schedtune.py first) and adds the plan's
+  tuning/overlap_frac + tuning/bucket_bytes keys to the JSON line."""
 
 import json
 import os
@@ -20,7 +24,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 import numpy as np
 
 
-def run_variant(batch, n_scan, s2d, n_iters=10, grad_reducer=None):
+def run_variant(batch, n_scan, s2d, n_iters=10, grad_reducer=None,
+                tune=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -48,7 +53,11 @@ def run_variant(batch, n_scan, s2d, n_iters=10, grad_reducer=None):
 
         reducer = make_grad_reducer(grad_reducer, comm)
     opt = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(0.1, momentum=0.9), comm, grad_reducer=reducer)
+        optax.sgd(0.1, momentum=0.9), comm, grad_reducer=reducer,
+        tune=tune)
+    plan = getattr(opt, "plan", None)
+    if plan is not None and reducer is None:
+        reducer = opt.grad_reducer  # the plan-built reducer
     state = (params, opt.init(params), extra)
     step = make_data_parallel_train_step(model, opt, comm, mutable=mutable)
 
@@ -103,6 +112,10 @@ def run_variant(batch, n_scan, s2d, n_iters=10, grad_reducer=None):
         line["comm_bytes_per_step"] = sum(r["bytes"] for r in rows)
         line["comm_wire_bytes_per_step"] = sum(
             r["wire_bytes"] for r in rows)
+    if plan is not None:
+        line["tuning/overlap_frac"] = plan.overlap_fraction
+        line["tuning/bucket_bytes"] = plan.bucket_bytes
+        line["tuning/strategy"] = plan.strategy
     print(json.dumps(line), flush=True)
 
 
@@ -113,8 +126,13 @@ if __name__ == "__main__":
         if a.startswith("--grad-reducer"):
             reducers = a.split("=", 1)[1].split(",")
             argv.remove(a)
+    tune = None
+    for a in list(argv):
+        if a.startswith("--tune"):
+            tune = a.split("=", 1)[1] if "=" in a else True
+            argv.remove(a)
     batch = int(argv[0])
     n_scan = int(argv[1])
     s2d = argv[2] == "1"
     for gr in reducers:
-        run_variant(batch, n_scan, s2d, grad_reducer=gr)
+        run_variant(batch, n_scan, s2d, grad_reducer=gr, tune=tune)

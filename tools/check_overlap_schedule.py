@@ -23,6 +23,15 @@ behind compute — docs/scaling_model.md §6), reported under the
 Run on any machine with the TPU compiler plugin (the topology is
 described, not attached): ``python tools/check_overlap_schedule.py``.
 The test suite asserts ok=true via tests/comm_tests/test_overlap_schedule.py.
+
+``--assert-min-overlap FRAC`` additionally gates the DL201 overlap
+FRACTION (the schedtune objective — docs/tuning.md): exit 1 when any
+compiled DP configuration's fraction of backward ops scheduled after
+the first gradient all-reduce falls below FRAC. This is the regression
+gate for the bench harness: a schedule that still technically overlaps
+(ok=true) but has drifted from, say, 0.9 to 0.3 of the backward window
+now fails loudly. The plugin-missing skip stays exit 0 — no machine
+should fail CI for lacking a compiler.
 """
 
 import json
@@ -44,7 +53,19 @@ def analyze(compiled):
     return check_dp_overlap(compiled.as_text())
 
 
+def _parse_min_overlap(argv):
+    for i, a in enumerate(argv):
+        if a.startswith("--assert-min-overlap"):
+            if "=" in a:
+                return float(a.split("=", 1)[1])
+            if i + 1 >= len(argv):
+                raise SystemExit("--assert-min-overlap needs a fraction")
+            return float(argv[i + 1])
+    return None
+
+
 def main():
+    min_overlap = _parse_min_overlap(sys.argv[1:])
     # AOT-only tool: the topology is described, never attached, so the
     # TPU plugin's GCP-metadata discovery is pure startup cost (~6 min
     # of retrying a 403ing metadata server off-TPU). Opt out unless the
@@ -154,7 +175,18 @@ def main():
     out["pipeline_1f1b"] = check_pipeline_permute_overlap(
         _compile_pipeline_1f1b(mesh).as_text())
     out["ok"] = bool(out["ok"] and out["pipeline_1f1b"]["ok"])
+    if min_overlap is not None:
+        # gate on the WORST DP configuration's DL201 overlap fraction
+        fracs = [out.get("overlap_fraction", 0.0),
+                 out["bucketed_allreduce_grad"].get(
+                     "overlap_fraction", 0.0)]
+        out["min_overlap_fraction"] = min(fracs)
+        out["assert_min_overlap"] = min_overlap
+        out["overlap_gate_ok"] = out["min_overlap_fraction"] >= min_overlap
+        out["ok"] = bool(out["ok"] and out["overlap_gate_ok"])
     print(json.dumps(out))
+    if min_overlap is not None and not out["overlap_gate_ok"]:
+        sys.exit(1)
 
 
 def _compile_pipeline_1f1b(mesh):
