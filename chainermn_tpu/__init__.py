@@ -37,6 +37,7 @@ from chainermn_tpu.iterators import (
 from chainermn_tpu.links import MultiNodeBatchNormalization, MultiNodeChainList
 from chainermn_tpu.optimizers import create_multi_node_optimizer
 from chainermn_tpu import resilience
+from chainermn_tpu import serving
 
 __version__ = "0.1.0"
 
@@ -59,5 +60,6 @@ __all__ = [
     "MultiNodeBatchNormalization",
     "MultiNodeChainList",
     "resilience",
+    "serving",
     "__version__",
 ]

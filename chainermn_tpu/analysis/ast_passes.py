@@ -806,3 +806,151 @@ def check_stale_schedule_profile(tree, src, path) -> List[Finding]:
 
 register(Rule("DL107", "stale-schedule-profile", f"{_DOC}#dl107",
               check_stale_schedule_profile))
+
+
+# ---------------------------------------------------------------------------
+# DL108 — decode-step-recompile
+# ---------------------------------------------------------------------------
+
+#: wrappers that compile their argument into a fresh executable
+_JIT_WRAPPERS = {"jit", "pmap", "pjit"}
+
+
+def _loop_induction_names(loop: ast.AST) -> Set[str]:
+    """Names that take a new value every iteration: the ``for`` target,
+    plus anything aug-assigned in the body (the ``while`` counter)."""
+    names: Set[str] = set()
+    if isinstance(loop, ast.For):
+        for n in ast.walk(loop.target):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+    for n in _walk_excluding_defs(loop.body):
+        if isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Name):
+            names.add(n.target.id)
+    return names
+
+
+def _slice_bounded_by(node: ast.expr, names: Set[str]) -> bool:
+    """True when ``node`` contains a Subscript whose *slice extent* (a
+    ``lower``/``upper`` bound) reads one of ``names`` — the shape of the
+    sliced value then changes every iteration. Plain indexing
+    (``buf[i]``) keeps a fixed shape and is NOT flagged."""
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Subscript):
+            continue
+        parts = [n.slice]
+        if isinstance(n.slice, ast.Tuple):
+            parts = list(n.slice.elts)
+        for part in parts:
+            if not isinstance(part, ast.Slice):
+                continue
+            for bound in (part.lower, part.upper):
+                if bound is None:
+                    continue
+                for leaf in ast.walk(bound):
+                    if isinstance(leaf, ast.Name) and leaf.id in names:
+                        return True
+    return False
+
+
+def _loop_bound_names(loop: ast.AST) -> Set[str]:
+    """Names (re)bound inside the loop body: assignment targets and
+    nested ``def``s. A jitted program that *reads* one of these is a
+    different program each iteration — compiling it per iteration is
+    the point (autotune candidates, per-strategy kernels), not a bug."""
+    names = _loop_induction_names(loop)
+    for n in _walk_excluding_defs(loop.body):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        names.add(leaf.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(n.name)
+    return names
+
+
+def _jit_bound_names(tree: ast.AST) -> Set[str]:
+    """Names assigned from a ``jit``/``pmap``/``pjit`` wrapper anywhere
+    in the file — the compiled steps DL108's shape check applies to."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if any(isinstance(n, ast.Call)
+               and _callee_name(n) in _JIT_WRAPPERS
+               for n in ast.walk(node.value)):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def check_decode_step_recompile(tree, src, path) -> List[Finding]:
+    """A token loop that recompiles its step every iteration.
+
+    The serving invariant (docs/serving.md#dl108): after warmup, a
+    decode loop executes ONE compiled program per step — XLA executable
+    reuse is where continuous batching's throughput comes from. Two
+    source shapes silently break it:
+
+    * building the executable inside the loop — ``f = jax.jit(step)``
+      per iteration constructs a fresh wrapper whose trace cache starts
+      empty, so every step retraces and recompiles. Exempt when the
+      wrapped program reads a name bound in the loop (a *different*
+      program per iteration — autotune candidates, per-strategy
+      kernels — where per-iteration compiles are the point);
+    * feeding a jit-bound step (``step = jax.jit(...)``) an argument
+      whose *slice extent* is the loop counter — ``step(toks[:, :t])``
+      changes shape every iteration, and shape-polymorphic dispatch
+      means one compile per sequence length (the full-recompute decode
+      that ``tools/bench_serve.py`` exists to measure against).
+
+    Fix: hoist the ``jit`` out of the loop and decode from a
+    fixed-capacity cache (``serving/kv_cache.py``) so every step sees
+    the same shapes. Intra-file, like every pass here: a wrapper built
+    in a helper module, or bound via anything but a plain assignment,
+    is not tracked.
+    """
+    findings: List[Finding] = []
+    jitted = _jit_bound_names(tree)
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        induction = _loop_induction_names(loop)
+        rebound = _loop_bound_names(loop)
+        for n in _walk_excluding_defs(loop.body):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _callee_name(n)
+            if name in _JIT_WRAPPERS:
+                reads = {leaf.id for a in n.args + [k.value
+                                                    for k in n.keywords]
+                         for leaf in ast.walk(a)
+                         if isinstance(leaf, ast.Name)}
+                if reads & rebound:
+                    continue        # fresh program per iteration
+                findings.append(Finding(
+                    "DL108", path, n.lineno,
+                    f"'{name}' called inside a loop builds a fresh "
+                    "compiled wrapper every iteration — its trace cache "
+                    "starts empty, so each step retraces and recompiles. "
+                    "Hoist the wrapper above the loop and call the same "
+                    f"object every iteration ({_DOC}#dl108)."))
+            elif (name in jitted and induction
+                  and any(_slice_bounded_by(arg, induction)
+                          for arg in list(n.args)
+                          + [kw.value for kw in n.keywords])):
+                findings.append(Finding(
+                    "DL108", path, n.lineno,
+                    f"compiled step '{name}' is fed a slice bounded by "
+                    "the loop counter: the argument shape grows every "
+                    "iteration, so the step compiles once PER SEQUENCE "
+                    "LENGTH instead of once. Decode from a "
+                    "fixed-capacity KV cache (serving/kv_cache.py) so "
+                    f"every step sees the same shapes ({_DOC}#dl108)."))
+    return findings
+
+
+register(Rule("DL108", "decode-step-recompile", f"{_DOC}#dl108",
+              check_decode_step_recompile))

@@ -155,46 +155,93 @@ class TransformerBlock(nn.Module):
                                (b, self.max_len, hkv, dh), self.dtype)
             idx = self.variable("cache", "idx",
                                 lambda: jnp.zeros((), jnp.int32))
+            # capacity comes from the SUPPLIED cache, not max_len: the
+            # serving layer passes smaller ring-buffered pages
+            # (serving/kv_cache.py) and writes wrap at `cap`
+            cap = ck.value.shape[1]
             pos = idx.value
+            # scalar cursor: generate()'s one-stream-per-row contract.
+            # vector cursor [b]: serving slots — every row advances its
+            # own position independently (continuous batching)
+            per_slot = jnp.ndim(pos) == 1
+            rows = (pos[:, None] if per_slot else pos) + jnp.arange(l)
             if self.pos_emb == "rope":
-                slab = pos + jnp.arange(l)
-                q = apply_rope(q, slab, self.rope_theta)
-                k = apply_rope(k, slab, self.rope_theta)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(self.dtype), (0, pos, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(self.dtype), (0, pos, 0, 0))
+                q = apply_rope(q, rows, self.rope_theta)
+                k = apply_rope(k, rows, self.rope_theta)
+            start = pos % cap
+            if per_slot:
+                ck.value = jax.vmap(
+                    lambda c, u, s0: jax.lax.dynamic_update_slice(
+                        c, u, (s0, 0, 0)))(
+                    ck.value, k.astype(self.dtype), start)
+                cv.value = jax.vmap(
+                    lambda c, u, s0: jax.lax.dynamic_update_slice(
+                        c, u, (s0, 0, 0)))(
+                    cv.value, v.astype(self.dtype), start)
+            else:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(self.dtype), (0, start, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(self.dtype), (0, start, 0, 0))
             idx.value = pos + l
             if l > 1:
                 # PREFILL slab: nothing precedes it (the cache starts
                 # empty), so attention is causal self-attention over the
-                # slab itself — the flash kernel, with no dense
-                # [l, max_len] scores and no full-cache read; a 32k-token
-                # prompt prefills at the training path's memory cost
-                bq, bk = self.attention_blocks or DEFAULT_BLOCKS
-                att = flash_attention(q, k, v, causal=True, block_q=bq,
-                                      block_k=bk,
-                                      window=self.attention_window)
+                # slab itself. Flash path: no dense [l, max_len] scores
+                # and no full-cache read — a 32k-token prompt prefills at
+                # the training path's memory cost. Reference models keep
+                # the reference kernel so prefill logits are THE SAME
+                # PROGRAM as the full forward (bitwise — the serving
+                # parity tests depend on it).
+                if self.attention == "reference":
+                    kr, vr = k, v
+                    if hkv != self.n_heads:
+                        kr = jnp.repeat(kr, self.n_heads // hkv, axis=2)
+                        vr = jnp.repeat(vr, self.n_heads // hkv, axis=2)
+                    att = local_attention_reference(q, kr, vr, causal=True)
+                else:
+                    bq, bk = self.attention_blocks or DEFAULT_BLOCKS
+                    att = flash_attention(q, k, v, causal=True, block_q=bq,
+                                          block_k=bk,
+                                          window=self.attention_window)
             else:
                 kc = ck.value.astype(jnp.float32)
                 vc = cv.value.astype(jnp.float32)
                 if hkv != self.n_heads:
                     kc = jnp.repeat(kc, self.n_heads // hkv, axis=2)
                     vc = jnp.repeat(vc, self.n_heads // hkv, axis=2)
-                s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                # squeezed-q contractions: on XLA these are bitwise-equal
+                # to the corresponding row of the full-forward [L, L]
+                # attention; the q=1 "bqhd,bkhd->bhqk"/"bhqk,bkhd->bqhd"
+                # pair is NOT (different reduction order). The serving
+                # bitwise-parity guarantee lives or dies here —
+                # docs/serving.md §numerics.
+                s = jnp.einsum("bhd,bkhd->bhk",
+                               q[:, 0].astype(jnp.float32),
                                kc) * dh ** -0.5
-                keys = jnp.arange(self.max_len)[None, :]
-                rows = pos + jnp.arange(l)[:, None]
-                visible = keys <= rows
+                row = rows[..., -1]              # [b] per-slot, else ()
+                keys = jnp.arange(cap)
+                # ring inversion: slot j holds token position
+                # row - ((row - j) mod cap) — the newest position ≡ j
+                # (mod cap) not exceeding row. Unwritten slots land
+                # negative; wrapped-over history is unreachable by
+                # construction. With cap == max_len and no wrap this
+                # reduces exactly to the old `keys <= row` mask.
+                kpos = row[..., None] - (row[..., None] - keys) % cap
+                visible = kpos >= 0
                 if self.attention_window is not None:
-                    visible &= keys > rows - self.attention_window
-                s = jnp.where(visible[None, None], s, -jnp.inf)
-                att = jnp.einsum("bhqk,bkhd->bqhd",
-                                 jax.nn.softmax(s, -1), vc)
+                    visible &= kpos > row[..., None] - self.attention_window
+                vis = visible[:, None] if per_slot else visible[None, None]
+                s = jnp.where(vis, s, -jnp.inf)
+                att = jnp.einsum("bhk,bkhd->bhd",
+                                 jax.nn.softmax(s, -1), vc)[:, None]
             # falls through to the SHARED projection/FFN tail below — the
             # decode path must never duplicate training-path math
         elif self.pos_emb == "rope":
-            pos = pos_offset + jnp.arange(l)
+            po = jnp.asarray(pos_offset)
+            # scalar offset (sequence parallelism) or per-row [b] offset
+            # (serving full-forward audit) — both yield global positions
+            pos = (po[:, None] if po.ndim else po) + jnp.arange(l)
             q = apply_rope(q, pos, self.rope_theta)
             k = apply_rope(k, pos, self.rope_theta)
         if self.decode:
@@ -261,7 +308,8 @@ class TransformerBlock(nn.Module):
                              wkv.astype(self.dtype))
             k, v = ykv[0], ykv[1]
         if self.pos_emb == "rope":
-            pos = pos_offset + jnp.arange(l)
+            po = jnp.asarray(pos_offset)
+            pos = (po[:, None] if po.ndim else po) + jnp.arange(l)
             q = apply_rope_bhld(q, pos, self.rope_theta)
             k = apply_rope_bhld(k, pos, self.rope_theta)
         bq, bk = self.attention_blocks or DEFAULT_BLOCKS
@@ -370,8 +418,14 @@ class TransformerLM(nn.Module):
             pos = self.param(
                 "pos_emb", nn.initializers.normal(0.02),
                 (self.max_len, self.d_model))
-            idx = pos_offset + jnp.arange(l)
-            x = emb + jnp.take(pos, idx, axis=0).astype(self.dtype)[None]
+            po = jnp.asarray(pos_offset)
+            # scalar offset → one shared position row (broadcast over b);
+            # vector [b] offset → per-row positions (serving slots sit at
+            # independent depths). take() clips out-of-range indices,
+            # which only retired/idle slots ever produce.
+            idx = (po[:, None] if po.ndim else po) + jnp.arange(l)
+            pe = jnp.take(pos, idx, axis=0).astype(self.dtype)
+            x = emb + (pe if po.ndim else pe[None])
         else:  # 'rope': positions enter inside each block's attention
             x = emb
         block_cls = (nn.remat(TransformerBlock)
@@ -547,12 +601,15 @@ def bhld_to_blhd_params(model, params):
 
 def generate(model, params, prompt, max_new_tokens: int,
              rng=None, temperature: float = 1.0, top_k: Optional[int] = None,
-             eos_id: Optional[int] = None, pad_id: int = 0):
-    """Autoregressive sampling with a per-layer KV cache.
+             eos_id: Optional[int] = None, pad_id: int = 0,
+             use_cache: bool = True):
+    """Autoregressive sampling over the serving KV cache.
 
-    The prompt prefills ONCE from an empty cache (the only legal l > 1
-    apply — see :class:`TransformerBlock`'s decode precondition), then
-    decoding proceeds one token at a time against the full cache.
+    The prompt prefills ONCE (the only legal l > 1 apply — see
+    :class:`TransformerBlock`'s decode precondition) into a
+    ``serving/kv_cache.py`` page sized exactly to the stream, then
+    decoding proceeds one token at a time against the cache — O(1)
+    compiled programs regardless of length.
 
     model: the TRAINING TransformerLM (decode twin derived internally);
     prompt: int32 [B, Lp]; returns int32 [B, Lp + max_new_tokens].
@@ -563,12 +620,12 @@ def generate(model, params, prompt, max_new_tokens: int,
     sequences idle through the remaining scan steps, the SPMD-friendly
     form of early exit).
 
-    PREFILL + decode: the whole prompt runs through ONE forward pass that
-    fills every layer's KV cache (l-token slab writes, causal inside the
-    slab), then one compiled lax.scan step per sampled token. Prefill is
-    compute-bound (big matmuls); per-token decode is memory-bound, so the
-    cache path uses plain XLA attention over the cached keys rather than
-    the flash kernel.
+    ``use_cache=False`` is the FULL-RECOMPUTE reference path: every step
+    re-runs the complete forward over the growing prefix (one XLA
+    program per prefix length — the cost the cache exists to delete).
+    Both paths thread the SAME rng-split sequence, so at fixed rng the
+    sampled tokens pin identical between them (tested); keep the slow
+    path for auditing cache numerics, never for throughput.
     """
     if model.moe_experts_per_device > 0:
         raise ValueError("generate() does not support MoE models: the "
@@ -583,7 +640,6 @@ def generate(model, params, prompt, max_new_tokens: int,
         # kernels back into Dense form (exact, see bhld_to_blhd_params)
         params = bhld_to_blhd_params(model, params)
         model = model.clone(qkv_layout="blhd")
-    dm = model.clone(decode=True)
     b, lp = prompt.shape
     total = lp + max_new_tokens
     if total > model.max_len:
@@ -591,13 +647,6 @@ def generate(model, params, prompt, max_new_tokens: int,
             f"prompt + max_new_tokens ({total}) exceeds max_len "
             f"({model.max_len})")
     prompt = jnp.asarray(prompt, jnp.int32)
-    # abstract init: cache shapes without materializing throwaway params
-    # (init also RUNS a forward, which would leave one garbage token in a
-    # concrete cache)
-    cache_shapes = jax.eval_shape(
-        lambda t: dm.init(jax.random.PRNGKey(0), t), prompt[:, :1])["cache"]
-    cache0 = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
     greedy = rng is None
     rng = jax.random.PRNGKey(0) if greedy else rng
 
@@ -610,35 +659,63 @@ def generate(model, params, prompt, max_new_tokens: int,
             scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
         return jax.random.categorical(rng, scaled).astype(jnp.int32)
 
+    def mask_eos(nxt, done):
+        if eos_id is None:
+            return nxt, done
+        nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+        return nxt, done | (nxt == eos_id)
+
     if max_new_tokens == 0:
         return prompt
 
-    # prefill: ONE forward over the whole prompt fills every layer's cache
+    if not use_cache:
+        # reference path: recompute the whole prefix each step (identical
+        # rng threading to the cached path below — token-pinning contract)
+        toks = prompt
+        logits = model.apply({"params": params}, toks)[:, -1]
+        rng, sub = jax.random.split(rng)
+        tok = sample(logits, sub)
+        done = (jnp.zeros((b,), bool) if eos_id is None
+                else tok == eos_id)
+        toks = jnp.concatenate([toks, tok[:, None]], axis=1)
+        for _ in range(max_new_tokens - 1):
+            logits = model.apply({"params": params}, toks)[:, -1]
+            rng, sub = jax.random.split(rng)
+            nxt, done = mask_eos(sample(logits, sub), done)
+            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+            # 1-CORE SYNC: eager dispatch queues ahead; bound it per step
+            nxt.block_until_ready()
+        return toks
+
+    from chainermn_tpu.serving.kv_cache import (decode_apply, init_cache,
+                                                prefill_apply)
+
+    dm = model.clone(decode=True)
+    # page sized exactly to the stream: no ring wrap, and (with reference
+    # attention) bitwise full-forward parity — tests/serving_tests
+    cache0 = init_cache(model, b, total)
+
+    # prefill: ONE forward over the whole prompt fills every layer's page
     # (lp sequential steps collapse into one compute-bound pass); the last
     # prompt position's logits seed the first sampled token
-    logits_p, upd = dm.apply(
-        {"params": params, "cache": cache0}, prompt, pos_offset=0,
-        mutable=["cache"])
+    logits_p, cache = prefill_apply(
+        dm, params, cache0, prompt, jnp.full((b,), lp, jnp.int32),
+        jnp.arange(b, dtype=jnp.int32))
     rng, sub = jax.random.split(rng)
-    tok0 = sample(logits_p[:, -1], sub)
+    tok0 = sample(logits_p, sub)
     done0 = (jnp.zeros((b,), bool) if eos_id is None
              else tok0 == eos_id)
 
-    def step(carry, t):
+    def step(carry, _):
         cache, tok, rng, done = carry
-        logits, upd = dm.apply(
-            {"params": params, "cache": cache}, tok[:, None],
-            pos_offset=t, mutable=["cache"])
+        logits, cache = decode_apply(dm, params, cache, tok)
         rng, sub = jax.random.split(rng)
-        nxt = sample(logits[:, 0], sub)
-        if eos_id is not None:
-            nxt = jnp.where(done, jnp.int32(pad_id), nxt)
-            done = done | (nxt == eos_id)
-        return (upd["cache"], nxt, rng, done), nxt
+        nxt, done = mask_eos(sample(logits, sub), done)
+        return (cache, nxt, rng, done), nxt
 
     # an empty scan (max_new_tokens == 1) returns the carry and 0 tokens
     (_, _, _, _), toks = jax.lax.scan(
-        step, (upd["cache"], tok0, rng, done0), jnp.arange(lp, total - 1))
+        step, (cache, tok0, rng, done0), None, length=max_new_tokens - 1)
     return jnp.concatenate([prompt, tok0[:, None], toks.T], axis=1)
 
 

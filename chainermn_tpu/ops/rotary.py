@@ -38,12 +38,16 @@ def apply_rope(x, positions, theta: float = 10000.0):
 
 
 def apply_rope_bhld(x, positions, theta: float = 10000.0):
-    """Head-major variant: x [B, H, L, D]; positions [L]. Same rotation
-    as :func:`apply_rope` with the L axis at position 2 (the pivot-free
-    attention layout — ops/flash_attention.py ``layout='bhld'``)."""
+    """Head-major variant: x [B, H, L, D]; positions [L] or [B, L]. Same
+    rotation as :func:`apply_rope` with the L axis at position 2 (the
+    pivot-free attention layout — ops/flash_attention.py
+    ``layout='bhld'``)."""
     d = x.shape[-1]
     cos, sin = rope_angles(jnp.asarray(positions), d, theta)
-    cos, sin = cos[None, None], sin[None, None]   # [1, 1, L, D/2]
+    if cos.ndim == 3:                             # [B, L, D/2] → head axis
+        cos, sin = cos[:, None], sin[:, None]
+    else:
+        cos, sin = cos[None, None], sin[None, None]  # [1, 1, L, D/2]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
     return out.astype(x.dtype)

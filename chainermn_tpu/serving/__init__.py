@@ -1,0 +1,32 @@
+"""chainermn_tpu.serving — continuous-batching inference over the
+training mesh, resilience, and reporting layers.
+
+Layered exactly like the training side: ``kv_cache`` is the compiled
+numerics core (paged ring cache + jit prefill/decode with donation),
+``engine`` is the single-threaded scheduler (slots, admission,
+retirement), ``frontend`` is the thread-safe client face (futures,
+RpcPolicy deadlines, watchdog-bounded aborts), ``reports`` is the
+telemetry sibling of ``training/reports.py``, and ``weights`` is the
+warm-restart snapshot plane. See docs/serving.md.
+"""
+
+from chainermn_tpu.serving.engine import (Engine, EngineConfig, Request,
+                                          default_buckets)
+from chainermn_tpu.serving.frontend import DeadlineExceeded, Frontend
+from chainermn_tpu.serving.kv_cache import (ServingStep, cache_bytes,
+                                            cache_spec, decode_apply,
+                                            init_cache, prefill_apply)
+from chainermn_tpu.serving.reports import ServingReport
+from chainermn_tpu.serving.weights import (WeightsError, load_weights,
+                                           publish_weights, pull_weights,
+                                           weight_candidates)
+
+__all__ = [
+    "Engine", "EngineConfig", "Request", "default_buckets",
+    "Frontend", "DeadlineExceeded",
+    "ServingStep", "cache_bytes", "cache_spec", "decode_apply",
+    "init_cache", "prefill_apply",
+    "ServingReport",
+    "WeightsError", "load_weights", "publish_weights", "pull_weights",
+    "weight_candidates",
+]

@@ -1,0 +1,260 @@
+"""Continuous-batching engine: iteration-level scheduling over the
+paged KV cache.
+
+The engine owns a fixed grid of ``n_slots`` decode slots. Every
+``step()`` is one scheduler iteration:
+
+1. **Admission** — if slots are free and requests are queued, one
+   prefill cohort runs: up to ``prefill_cohort`` same-bucket prompts,
+   right-padded to the bucket length, scattered into free slots
+   (sentinel rows fill the cohort — fixed shapes, so the compile count
+   is bounded by the bucket table, never by traffic).
+2. **Decode** — ONE ``[n_slots]`` decode step advances every live slot
+   together. Free slots ride along as garbage rows; row independence
+   keeps them from touching live logits (tested bitwise).
+3. **Retirement** — slots whose request sampled ``eos_id`` or reached
+   its token budget are freed for the next admission.
+
+Prefill and decode therefore co-exist without recompilation — the
+DL108 invariant: after warmup, serving any traffic mix executes exactly
+one compiled decode program plus one compiled prefill program per
+bucket. ``resilience/chaos.py::on_step`` fires at the top of every
+iteration, so ``$CHAINERMN_TPU_CHAOS='kill@step=N'`` kills a replica
+mid-decode — the supervisor drill in tests/serving_tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from chainermn_tpu.resilience import chaos
+from chainermn_tpu.serving.kv_cache import ServingStep
+from chainermn_tpu.serving.reports import ServingReport
+
+__all__ = ["Engine", "EngineConfig", "Request", "default_buckets"]
+
+
+def default_buckets(capacity: int, lo: int = 8) -> Tuple[int, ...]:
+    """Power-of-two bucket table up to the page capacity: every prompt
+    compiles against one of O(log capacity) prefill shapes."""
+    out = []
+    b = lo
+    while b < capacity:
+        out.append(b)
+        b *= 2
+    out.append(capacity)
+    return tuple(out)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    n_slots: int = 4
+    capacity: int = 256
+    max_new_tokens: int = 64          # default per-request budget
+    prefill_cohort: int = 2           # S — cohort width (fixed shape)
+    buckets: Optional[Sequence[int]] = None  # None → default_buckets()
+    cache_dtype: object = None
+
+    def bucket_table(self) -> Tuple[int, ...]:
+        return (tuple(sorted(self.buckets)) if self.buckets
+                else default_buckets(self.capacity))
+
+
+@dataclasses.dataclass(eq=False)   # identity semantics (prompt is an array)
+class Request:
+    """One generation stream. ``tokens`` grows as the engine emits;
+    terminal states are 'done' (eos or budget) and 'aborted'."""
+    request_id: int
+    prompt: np.ndarray                # int32 [L]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    temperature: Optional[float] = None   # None → greedy argmax
+    seed: int = 0
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    state: str = "queued"             # queued|running|done|aborted
+    slot: Optional[int] = None
+    _rng: Optional[np.random.Generator] = None
+
+    def sample(self, logits: np.ndarray) -> int:
+        if self.temperature is None:
+            # first-index ties, same rule as jnp.argmax — greedy engine
+            # streams match serial generate() token for token
+            return int(np.argmax(logits))
+        if self._rng is None:
+            self._rng = np.random.default_rng(self.seed)
+        z = logits.astype(np.float64) / max(self.temperature, 1e-6)
+        z -= z.max()
+        p = np.exp(z)
+        return int(self._rng.choice(logits.shape[0], p=p / p.sum()))
+
+    @property
+    def finished(self) -> bool:
+        return self.state in ("done", "aborted")
+
+
+class Engine:
+    """Single-threaded scheduler core (the thread-safe face is
+    ``frontend.Frontend``). ``submit()`` queues, ``step()`` advances one
+    iteration, ``run_until_drained()`` loops until idle."""
+
+    def __init__(self, model, params, config: EngineConfig = EngineConfig(),
+                 *, mesh=None, axis=None, report: Optional[ServingReport] = None,
+                 time_fn=None):
+        self.config = config
+        self.steps = ServingStep(
+            model, params, config.n_slots, config.capacity,
+            cache_dtype=config.cache_dtype, mesh=mesh, axis=axis)
+        self.report = report or (ServingReport(time_fn) if time_fn
+                                 else ServingReport())
+        self.queue: deque[Request] = deque()
+        self.active: Dict[int, Request] = {}          # slot → request
+        self.free_slots: List[int] = list(range(config.n_slots))
+        self.cur_tokens = np.zeros(config.n_slots, np.int32)
+        self.last_logits: Optional[np.ndarray] = None  # debug/parity hook
+        self.iteration = 0
+        self._ids = itertools.count()
+        self._buckets = config.bucket_table()
+        if self._buckets[-1] < config.capacity:
+            raise ValueError("largest bucket must reach capacity")
+
+    # ----------------------------------------------------------------
+    # request lifecycle
+    # ----------------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_id: Optional[int] = None,
+               temperature: Optional[float] = None,
+               seed: int = 0) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size > self._buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest prefill "
+                f"bucket ({self._buckets[-1]})")
+        req = Request(request_id=next(self._ids), prompt=prompt,
+                      max_new_tokens=(max_new_tokens
+                                      if max_new_tokens is not None
+                                      else self.config.max_new_tokens),
+                      eos_id=eos_id, temperature=temperature, seed=seed)
+        self.queue.append(req)
+        self.report.record_submit(req.request_id)
+        return req
+
+    def _bucket_for(self, length: int) -> int:
+        for b in self._buckets:
+            if b >= length:
+                return b
+        raise ValueError(f"no bucket covers prompt length {length}")
+
+    def _emit(self, req: Request, token: int) -> None:
+        req.tokens.append(int(token))
+        self.report.record_token(req.request_id)
+        hit_eos = req.eos_id is not None and token == req.eos_id
+        if hit_eos or len(req.tokens) >= req.max_new_tokens:
+            self._retire(req)
+        elif req.slot is not None:
+            self.cur_tokens[req.slot] = token
+
+    def _retire(self, req: Request, aborted: bool = False) -> None:
+        req.state = "aborted" if aborted else "done"
+        if req.slot is not None:
+            self.free_slots.append(req.slot)
+            self.active.pop(req.slot, None)
+            req.slot = None
+        self.report.record_retire(req.request_id, aborted=aborted)
+
+    def abort_all(self, requeue: bool = False) -> List[Request]:
+        """Watchdog-bounded teardown: every in-flight request aborts (or
+        requeues for a warm restart) and every queued request drains back
+        to the caller. Returns the affected requests."""
+        hit = []
+        for req in list(self.active.values()):
+            if requeue:
+                req.state = "queued"
+                req.tokens = []
+                if req.slot is not None:
+                    self.free_slots.append(req.slot)
+                    self.active.pop(req.slot, None)
+                    req.slot = None
+                self.queue.appendleft(req)
+            else:
+                self._retire(req, aborted=True)
+            hit.append(req)
+        if not requeue:
+            while self.queue:
+                req = self.queue.popleft()
+                req.state = "aborted"
+                self.report.record_retire(req.request_id, aborted=True)
+                hit.append(req)
+        return hit
+
+    # ----------------------------------------------------------------
+    # scheduler iterations
+    # ----------------------------------------------------------------
+
+    def _admit(self) -> int:
+        """One prefill cohort: same-bucket FIFO prompts into free slots."""
+        if not self.queue or not self.free_slots:
+            return 0
+        s = self.config.prefill_cohort
+        bucket = self._bucket_for(self.queue[0].prompt.size)
+        cohort: List[Request] = []
+        while (self.queue and self.free_slots and len(cohort) < s
+               and self._bucket_for(self.queue[0].prompt.size) == bucket):
+            req = self.queue.popleft()
+            req.slot = self.free_slots.pop(0)
+            req.state = "running"
+            self.active[req.slot] = req
+            cohort.append(req)
+        tokens = np.zeros((s, bucket), np.int32)
+        lengths = np.ones(s, np.int32)          # sentinel rows: length 1
+        slot_ids = np.full(s, self.steps.n_slots, np.int32)  # sentinel
+        for i, req in enumerate(cohort):
+            tokens[i, :req.prompt.size] = req.prompt
+            lengths[i] = req.prompt.size
+            slot_ids[i] = req.slot
+        logits = np.asarray(self.steps.prefill(tokens, lengths, slot_ids))
+        for i, req in enumerate(cohort):
+            self._emit(req, req.sample(logits[i]))
+        return len(cohort)
+
+    def step(self) -> dict:
+        """One scheduler iteration: chaos hook → admission → decode →
+        retirement. Returns counters for the caller's loop policy."""
+        chaos.on_step(self.iteration)
+        self.iteration += 1
+        admitted = self._admit()
+        emitted = 0
+        if self.active:
+            logits = np.asarray(self.steps.decode(self.cur_tokens))
+            self.last_logits = logits
+            for slot, req in list(self.active.items()):
+                self._emit(req, req.sample(logits[slot]))
+                emitted += 1
+        self.report.record_step(
+            len(self.queue),
+            len(self.active) / self.config.n_slots)
+        return {"admitted": admitted, "emitted": emitted,
+                "active": len(self.active), "queued": len(self.queue)}
+
+    def idle(self) -> bool:
+        return not self.queue and not self.active
+
+    def run_until_drained(self, max_steps: int = 100_000) -> int:
+        """Step until no queued or active work remains; returns the
+        number of iterations taken."""
+        n = 0
+        while not self.idle():
+            if n >= max_steps:
+                raise RuntimeError(
+                    f"engine failed to drain within {max_steps} steps")
+            # step() syncs internally: np.asarray pulls every logit row
+            self.step()  # dlint: disable=DL104
+            n += 1
+        return n

@@ -1,0 +1,190 @@
+"""Thread-safe serving front end: ``submit(prompt) -> Future``.
+
+One background thread owns the :class:`~chainermn_tpu.serving.engine.
+Engine` (the engine itself is single-threaded by design); callers from
+any thread enqueue work through a mailbox and block on standard
+``concurrent.futures.Future`` objects. Two resilience hooks, both
+reused from the training fleet:
+
+* **Deadline-bounded waits** — ``result()`` slices its wait into
+  ``RpcPolicy.probe_ms`` probes (the same fail-fast shape as the
+  host-plane RPCs in ``comm/object_plane.py``), so a wedged replica is
+  noticed in O(probe), not O(timeout). The total budget defaults to
+  ``RpcPolicy.timeout_ms``.
+* **Watchdog-bounded abortion** — every scheduler iteration polls the
+  process watchdog (``resilience/watchdog.py``); on a declared-dead
+  peer the engine aborts all in-flight requests and their futures fail
+  with ``JobAbortedError`` within one iteration + one probe slice,
+  instead of hanging until the client gives up.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Optional
+
+from chainermn_tpu.resilience.policy import RpcPolicy, policy
+from chainermn_tpu.resilience.watchdog import current_watchdog
+
+__all__ = ["Frontend", "DeadlineExceeded"]
+
+
+class DeadlineExceeded(TimeoutError):
+    """The deadline-bounded wait ran out of budget (the replica may
+    still be alive — the request is NOT cancelled)."""
+
+
+class Frontend:
+    """Wraps an Engine in a mailbox + scheduler thread.
+
+    Use as a context manager; ``close()`` drains the mailbox, stops the
+    thread, and aborts whatever is still in flight.
+    """
+
+    _IDLE_WAIT_S = 0.005     # mailbox poll while the engine is idle
+
+    def __init__(self, engine, *, rpc_policy: Optional[RpcPolicy] = None,
+                 watchdog=None):
+        self.engine = engine
+        self._policy = rpc_policy
+        self._watchdog = watchdog
+        self._mail: _queue.Queue = _queue.Queue()
+        self._futures = {}           # request_id → Future
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serving-frontend",
+                                        daemon=True)
+        self._thread.start()
+
+    # ----------------------------------------------------------------
+    # client face (any thread)
+    # ----------------------------------------------------------------
+
+    def submit(self, prompt, **kw) -> Future:
+        """Queue one generation request; the Future resolves to the
+        engine's Request (``.tokens`` holds the emitted ids)."""
+        if self._stop.is_set():
+            raise RuntimeError("frontend is closed")
+        fut: Future = Future()
+        self._mail.put((prompt, kw, fut))
+        return fut
+
+    def result(self, future: Future, timeout_ms: Optional[int] = None):
+        """Deadline-bounded wait, sliced at ``probe_ms`` for fail-fast:
+        a dead scheduler thread or tripped watchdog surfaces on the next
+        probe instead of after the full budget."""
+        pol = self._policy or policy()
+        budget_ms = timeout_ms if timeout_ms is not None else pol.timeout_ms
+        deadline = time.monotonic() + budget_ms / 1e3
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise DeadlineExceeded(
+                    f"no result within {budget_ms} ms "
+                    f"(probe={pol.probe_ms} ms)")
+            try:
+                return future.result(
+                    timeout=min(pol.probe_ms / 1e3, left))
+            except FutureTimeout:
+                if not self._thread.is_alive() and not future.done():
+                    raise RuntimeError(
+                        "serving scheduler thread died with the request "
+                        "in flight")
+
+    def drain(self, timeout_ms: Optional[int] = None) -> None:
+        """Block until the engine has no queued or active work."""
+        pol = self._policy or policy()
+        budget_ms = timeout_ms if timeout_ms is not None else pol.timeout_ms
+        deadline = time.monotonic() + budget_ms / 1e3
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._mail.empty() and self.engine.idle():
+                    return
+            time.sleep(self._IDLE_WAIT_S)
+        raise DeadlineExceeded(f"engine not drained within {budget_ms} ms")
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ----------------------------------------------------------------
+    # scheduler thread
+    # ----------------------------------------------------------------
+
+    def _poll_watchdog(self):
+        from chainermn_tpu.comm.object_plane import JobAbortedError
+
+        wd = self._watchdog or current_watchdog()
+        if wd is None:
+            return
+        try:
+            wd.check()
+        except JobAbortedError as e:
+            # bounded abortion: fail EVERYTHING in flight now — clients
+            # see the peer loss within one probe slice, never a hang
+            with self._lock:
+                hit = {r.request_id for r in self.engine.abort_all()}
+                for rid in list(self._futures):
+                    if rid in hit:
+                        fut, _req = self._futures.pop(rid)
+                        if not fut.done():
+                            fut.set_exception(JobAbortedError(str(e)))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._poll_watchdog()
+            worked = False
+            try:
+                while True:
+                    prompt, kw, fut = self._mail.get_nowait()
+                    with self._lock:
+                        try:
+                            req = self.engine.submit(prompt, **kw)
+                            self._futures[req.request_id] = (fut, req)
+                        except Exception as e:  # bad request, not fatal
+                            fut.set_exception(e)
+                    worked = True
+            except _queue.Empty:
+                pass
+            with self._lock:
+                if not self.engine.idle():
+                    # Engine.step() syncs internally (np.asarray pulls
+                    # every logit row before sampling)
+                    self.engine.step()  # dlint: disable=DL104
+                    worked = True
+                    for rid, (fut, req) in list(self._futures.items()):
+                        if req.finished:
+                            self._futures.pop(rid)
+                            if not fut.done():
+                                fut.set_result(req)
+            if not worked:
+                time.sleep(self._IDLE_WAIT_S)
+        # teardown: nothing new is accepted; in-flight work aborts and
+        # never-admitted mailbox entries fail too (close() may beat the
+        # drain loop to a freshly submitted request)
+        with self._lock:
+            self.engine.abort_all()
+            for rid, (fut, req) in list(self._futures.items()):
+                self._futures.pop(rid)
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError("frontend closed mid-request"))
+            try:
+                while True:
+                    _prompt, _kw, fut = self._mail.get_nowait()
+                    if not fut.done():
+                        fut.set_exception(
+                            RuntimeError("frontend closed mid-request"))
+            except _queue.Empty:
+                pass

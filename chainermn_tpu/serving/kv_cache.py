@@ -1,0 +1,275 @@
+"""Paged, ring-buffered KV cache + the compiled serving step pair.
+
+The serving cache is the training model's own flax ``cache`` collection,
+re-shaped for continuous batching: one PAGE per transformer block, each
+page ``k``/``v`` of shape ``[n_slots, capacity, n_kv_heads, d_head]``
+plus a per-slot ``idx`` cursor vector ``[n_slots]`` (the decode branch in
+``models/transformer.py`` accepts either the scalar cursor ``generate()``
+uses or this vector — every row then advances independently).
+
+Ring semantics: the write position for token ``p`` of slot ``s`` is
+``p % capacity``; once a slot's stream outgrows its page the oldest
+tokens are overwritten and attention degrades to a ``capacity``-token
+sliding window (the mask inverts the ring — see the ``kpos`` comment in
+the decode branch). Prefer ``pos_emb='rope'`` for streams expected to
+wrap (learned positions clip at ``max_len``).
+
+Two compiled entry points, following the SNIPPETS Partitioner shape
+(jit with explicit in/out shardings, donated cache buffers):
+
+* ``prefill`` — a fixed-shape cohort ``[S, L_bucket]`` runs the one
+  legal multi-token decode apply on a FRESH slab cache, then scatters
+  the slab into the page at the cohort's slot ids (a sentinel id of
+  ``n_slots`` drops padding rows — ``mode='drop'``). Returns each
+  prompt's last-position logits (the first sampled token — TTFT).
+* ``decode_step`` — one token for ALL ``n_slots`` slots at once, a
+  single ``[n_slots, 1]`` apply against the paged cache. Constant
+  shapes by construction: traced once, reused forever (the DL108
+  trap this module exists to avoid).
+
+Numerics contract (tested bitwise): with ``capacity`` ≥ the full stream
+length and ``attention='reference'``, cached decode logits equal the
+corresponding full-forward column BITWISE — the decode branch uses
+squeezed-q contractions and the same-program prefill kernel to make the
+cached path a re-association-free restatement of the training forward.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models.transformer import bhld_to_blhd_params
+
+__all__ = ["init_cache", "cache_bytes", "cache_spec", "decode_apply",
+           "prefill_apply", "ServingStep"]
+
+
+def _check_servable(model):
+    if model.moe_experts_per_device > 0:
+        raise ValueError("serving does not support MoE models: the "
+                         "decode path has no expert dispatch")
+    if model.tp_axis is not None or getattr(model, "lm_head_tp", False):
+        raise ValueError(
+            "serving runs the jit decode path; tp_axis/lm_head_tp models "
+            "serve without shard_map TP (clone with tp_axis=None, "
+            "lm_head_tp=False and gather the weights — head-axis mesh "
+            "sharding of the cache covers the TP layout instead)")
+
+
+def cache_spec(model) -> Dict[str, int]:
+    """The numbers the sizing math and page shapes derive from."""
+    return dict(
+        n_layers=model.n_layers,
+        n_kv_heads=model.n_kv_heads or model.n_heads,
+        d_head=model.d_model // model.n_heads,
+    )
+
+
+def cache_bytes(model, n_slots: int, capacity: int,
+                dtype: Any = None) -> int:
+    """Preallocated cache footprint: ``n_layers · n_slots · capacity ·
+    2 (K and V) · n_kv_heads · d_head · itemsize`` — the budget line in
+    docs/serving.md's sizing table."""
+    spec = cache_spec(model)
+    itemsize = jnp.dtype(dtype or model.dtype).itemsize
+    return (spec["n_layers"] * n_slots * capacity * 2
+            * spec["n_kv_heads"] * spec["d_head"] * itemsize)
+
+
+def init_cache(model, n_slots: int, capacity: int, dtype: Any = None):
+    """Fresh zeroed pages: ``{"block_i": {"k", "v", "idx"}}`` with
+    per-slot cursor vectors. The tree is exactly the flax ``cache``
+    collection ``model.clone(decode=True)`` declares — supplied values
+    override the declared ``max_len`` shapes, which is how ``capacity``
+    decouples from ``model.max_len``."""
+    spec = cache_spec(model)
+    dt = dtype or model.dtype
+    page = lambda: {
+        "k": jnp.zeros((n_slots, capacity, spec["n_kv_heads"],
+                        spec["d_head"]), dt),
+        "v": jnp.zeros((n_slots, capacity, spec["n_kv_heads"],
+                        spec["d_head"]), dt),
+        "idx": jnp.zeros((n_slots,), jnp.int32),
+    }
+    return {f"block_{i}": page() for i in range(spec["n_layers"])}
+
+
+def decode_apply(model, params, cache, tokens):
+    """PURE one-token step for every slot: tokens int32 ``[n_slots]`` →
+    (logits ``[n_slots, vocab]``, advanced cache). The per-slot cursor
+    vector doubles as ``pos_offset`` so learned positional embeddings
+    index each slot's own depth."""
+    dm = model if model.decode else model.clone(decode=True)
+    cursors = cache["block_0"]["idx"]
+    logits, upd = dm.apply(
+        {"params": params, "cache": cache}, tokens[:, None],
+        pos_offset=cursors, mutable=["cache"])
+    return logits[:, 0], upd["cache"]
+
+
+def prefill_apply(model, params, cache, tokens, lengths, slot_ids):
+    """PURE cohort prefill: tokens int32 ``[S, L]`` (right-padded),
+    lengths ``[S]``, slot_ids ``[S]`` (sentinel ``n_slots`` = padding
+    row, dropped by the scatter). Runs the slab forward on a fresh
+    ``[S, L]`` cache, scatters K/V into the pages, sets the cursors to
+    ``lengths``, and returns (last-real-position logits ``[S, vocab]``,
+    new cache)."""
+    dm = model if model.decode else model.clone(decode=True)
+    s, l = tokens.shape
+    capacity = cache["block_0"]["k"].shape[1]
+    if l > capacity:
+        raise ValueError(
+            f"prefill bucket length {l} exceeds page capacity {capacity}")
+    spec = cache_spec(model)
+    slab0 = {
+        f"block_{i}": {
+            "k": jnp.zeros((s, l, spec["n_kv_heads"], spec["d_head"]),
+                           cache["block_0"]["k"].dtype),
+            "v": jnp.zeros((s, l, spec["n_kv_heads"], spec["d_head"]),
+                           cache["block_0"]["v"].dtype),
+            "idx": jnp.zeros((), jnp.int32),
+        } for i in range(spec["n_layers"])
+    }
+    logits, upd = dm.apply(
+        {"params": params, "cache": slab0}, tokens, pos_offset=0,
+        mutable=["cache"])
+    last = jnp.take_along_axis(
+        logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+    sid = jnp.asarray(slot_ids, jnp.int32)
+    new_cache = {}
+    for name, page in cache.items():
+        slab = upd["cache"][name]
+        new_cache[name] = {
+            # mode='drop': the sentinel slot id (== n_slots) is
+            # out of bounds, so padding rows vanish instead of clobbering
+            # a live slot
+            "k": page["k"].at[sid, :l].set(slab["k"], mode="drop"),
+            "v": page["v"].at[sid, :l].set(slab["v"], mode="drop"),
+            "idx": page["idx"].at[sid].set(
+                jnp.asarray(lengths, jnp.int32), mode="drop"),
+        }
+    return last, new_cache
+
+
+class ServingStep:
+    """The compiled prefill/decode pair, owning the paged cache.
+
+    ``decode()`` is jitted ONCE with the cache buffers donated (the page
+    updates alias in place — no copy of the multi-GiB cache per token)
+    and, when a ``mesh`` is given, explicit NamedShardings: K/V pages
+    sharded on the head axis over ``axis`` (the TP layout the training
+    mesh uses) whenever ``n_kv_heads`` divides, everything else
+    replicated. ``prefill()`` compiles one program per (cohort, bucket)
+    shape — bucket lengths are the engine's admission policy; the
+    per-shape jit cache plus the trace counters below make recompiles
+    observable (``tools/bench_serve.py`` asserts decode traces == 1).
+    """
+
+    def __init__(self, model, params, n_slots: int, capacity: int, *,
+                 cache_dtype: Any = None, mesh=None, axis: Optional[str] = None,
+                 donate: bool = True):
+        _check_servable(model)
+        if model.qkv_layout == "bhld":
+            params = bhld_to_blhd_params(model, params)
+            model = model.clone(qkv_layout="blhd")
+        self.model = model
+        self.dm = model.clone(decode=True)
+        self.params = params
+        self.n_slots = int(n_slots)
+        self.capacity = int(capacity)
+        self.cache = init_cache(model, n_slots, capacity, cache_dtype)
+        self.decode_traces = 0
+        self.prefill_traces: Dict[tuple, int] = {}
+        self._prefill_jits: Dict[tuple, Any] = {}
+        self._mesh = mesh
+        self._axis = axis
+        donate_args = (1,) if donate else ()
+
+        def _decode(params, cache, tokens):
+            self.decode_traces += 1      # trace-time only: counts compiles
+            return decode_apply(self.dm, params, cache, tokens)
+
+        kw = {}
+        if mesh is not None:
+            repl, cache_sh = self._shardings(mesh, axis)
+            kw = dict(in_shardings=(repl, cache_sh, repl),
+                      out_shardings=(repl, cache_sh))
+        self._decode_jit = jax.jit(_decode, donate_argnums=donate_args,
+                                   **kw)
+        self._donate = donate_args
+
+    def _shardings(self, mesh, axis):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = axis or mesh.axis_names[0]
+        nax = mesh.shape[axis]
+        hkv = cache_spec(self.model)["n_kv_heads"]
+        kv_spec = P(None, None, axis, None) if hkv % nax == 0 else P()
+        repl = NamedSharding(mesh, P())
+        page = {"k": NamedSharding(mesh, kv_spec),
+                "v": NamedSharding(mesh, kv_spec),
+                "idx": repl}
+        cache_sh = {name: dict(page) for name in self.cache}
+        return repl, cache_sh
+
+    def cache_bytes(self) -> int:
+        return cache_bytes(self.model, self.n_slots, self.capacity,
+                           self.cache["block_0"]["k"].dtype)
+
+    def cursors(self):
+        """Device→host pull of the per-slot fill levels (debug/report)."""
+        return jax.device_get(self.cache["block_0"]["idx"])
+
+    def decode(self, tokens):
+        """One token for every slot: tokens int ``[n_slots]`` → logits
+        ``[n_slots, vocab]`` (f32, on device). Retired/free slots carry
+        any token id; their rows are garbage and MUST be ignored — row
+        independence keeps them from perturbing live slots (tested
+        bitwise)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        logits, self.cache = self._decode_jit(
+            self.params, self.cache, tokens)
+        return logits
+
+    def prefill(self, tokens, lengths, slot_ids):
+        """Cohort prefill (see :func:`prefill_apply`); compiled per
+        (S, L) shape with the cache donated, counted in
+        ``prefill_traces``."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        key = tokens.shape
+        if key not in self._prefill_jits:
+            def _prefill(params, cache, tokens, lengths, slot_ids,
+                         _key=key):
+                self.prefill_traces[_key] = (
+                    self.prefill_traces.get(_key, 0) + 1)
+                return prefill_apply(self.dm, params, cache, tokens,
+                                     lengths, slot_ids)
+
+            kw = {}
+            if self._mesh is not None:
+                repl, cache_sh = self._shardings(self._mesh, self._axis)
+                kw = dict(
+                    in_shardings=(repl, cache_sh, repl, repl, repl),
+                    out_shardings=(repl, cache_sh))
+            self._prefill_jits[key] = jax.jit(
+                _prefill, donate_argnums=self._donate, **kw)
+        logits, self.cache = self._prefill_jits[key](
+            self.params, self.cache, tokens,
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(slot_ids, jnp.int32))
+        return logits
+
+    def load_params(self, params):
+        """Swap weights in place (warm restart — serving/weights.py)."""
+        if self.model.qkv_layout == "bhld":
+            params = bhld_to_blhd_params(self.model, params)
+        self.params = params
+
+    def reset(self):
+        """Zero every page and cursor (all slots freed)."""
+        self.cache = init_cache(
+            self.model, self.n_slots, self.capacity,
+            self.cache["block_0"]["k"].dtype)

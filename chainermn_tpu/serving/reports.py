@@ -1,0 +1,133 @@
+"""ServingReport — the inference-side sibling of ``training/reports.py``.
+
+The training reports observe a step loop; this one observes a request
+lifecycle: admission → first token (TTFT) → per-token cadence →
+retirement, plus the scheduler-level signals (queue depth, slot
+occupancy) that tell an operator whether the fleet is sized right.
+
+Everything is recorded as plain floats against an injectable clock
+(``time_fn``) so tests drive it deterministically; ``summary()`` folds
+the raw samples into the JSON block ``tools/bench_serve.py`` and the
+``bench.py`` serving section emit. Field reference: docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ServingReport", "percentile"]
+
+
+def percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile (no numpy dependency at import time; the
+    sample counts here never justify interpolation)."""
+    if not samples:
+        return float("nan")
+    xs = sorted(samples)
+    k = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+    return float(xs[k])
+
+
+class ServingReport:
+    """Aggregates one serving process's request/scheduler telemetry.
+
+    Engine calls the ``record_*`` hooks; ``summary()`` is cheap enough
+    to call per scrape. All latencies are reported in milliseconds,
+    throughput in tokens/s over the observed wall span.
+    """
+
+    PERCENTILES = (50, 90, 95, 99)
+
+    def __init__(self, time_fn=time.monotonic):
+        self._time = time_fn
+        self._t0: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self.submitted = 0
+        self.completed = 0
+        self.aborted = 0
+        self.tokens_emitted = 0
+        self.ttft_s: List[float] = []
+        self.token_gap_s: List[float] = []
+        self.queue_depth_samples: List[int] = []
+        self.occupancy_samples: List[float] = []
+        self._last_token_t: Dict[int, float] = {}
+        self._submit_t: Dict[int, float] = {}
+
+    # ----------------------------------------------------------------
+    # engine hooks
+    # ----------------------------------------------------------------
+
+    def record_submit(self, request_id: int) -> None:
+        now = self._time()
+        if self._t0 is None:
+            self._t0 = now
+        self._t_last = now
+        self.submitted += 1
+        self._submit_t[request_id] = now
+
+    def record_token(self, request_id: int) -> None:
+        now = self._time()
+        self._t_last = now
+        self.tokens_emitted += 1
+        prev = self._last_token_t.get(request_id)
+        if prev is None:
+            sub = self._submit_t.get(request_id)
+            if sub is not None:
+                self.ttft_s.append(now - sub)
+        else:
+            self.token_gap_s.append(now - prev)
+        self._last_token_t[request_id] = now
+
+    def record_retire(self, request_id: int, aborted: bool = False) -> None:
+        self._t_last = self._time()
+        if aborted:
+            self.aborted += 1
+        else:
+            self.completed += 1
+        self._last_token_t.pop(request_id, None)
+        self._submit_t.pop(request_id, None)
+
+    def record_step(self, queue_depth: int, occupancy: float) -> None:
+        self.queue_depth_samples.append(int(queue_depth))
+        self.occupancy_samples.append(float(occupancy))
+
+    # ----------------------------------------------------------------
+    # output
+    # ----------------------------------------------------------------
+
+    def _dist_ms(self, samples: List[float]) -> Dict[str, float]:
+        out = {f"p{q}": percentile(samples, q) * 1e3
+               for q in self.PERCENTILES}
+        out["mean"] = (sum(samples) / len(samples) * 1e3 if samples
+                       else float("nan"))
+        out["n"] = len(samples)
+        return out
+
+    def summary(self) -> dict:
+        span = ((self._t_last - self._t0)
+                if self._t0 is not None and self._t_last is not None
+                else 0.0)
+        occ = self.occupancy_samples
+        qd = self.queue_depth_samples
+        return {
+            "requests": {"submitted": self.submitted,
+                         "completed": self.completed,
+                         "aborted": self.aborted},
+            "tokens_emitted": self.tokens_emitted,
+            "tokens_per_s": (self.tokens_emitted / span if span > 0
+                             else float("nan")),
+            "ttft_ms": self._dist_ms(self.ttft_s),
+            "token_latency_ms": self._dist_ms(self.token_gap_s),
+            "queue_depth": {"mean": (sum(qd) / len(qd) if qd
+                                     else float("nan")),
+                            "max": max(qd) if qd else 0},
+            "slot_occupancy": {"mean": (sum(occ) / len(occ) if occ
+                                        else float("nan")),
+                               "max": max(occ) if occ else 0.0},
+            "wall_s": span,
+        }
+
+    def json(self) -> str:
+        return json.dumps(self.summary(), sort_keys=True)

@@ -1,0 +1,155 @@
+"""Warm-weight plane for serving replicas.
+
+A restarted serving replica must come back HOT: re-initializing (or
+re-downloading) weights inside the restart window is what turns one
+preemption into a visible outage. This module is the
+``PeerReplicator``-style snapshot path applied to inference weights:
+
+* ``publish_weights`` — atomic publish (tmp + fsync + rename, exactly
+  the checkpoint plane's discipline) of a params pytree plus a
+  ``{"format": 1, "sha256", "bytes"}`` manifest sidecar, the same
+  manifest grammar ``extensions/checkpoint.py`` emits, so fleet tooling
+  verifies both planes with one code path.
+* ``load_weights`` — manifest-verified load; a corrupt or torn file is
+  REFUSED (never half-loaded into a serving process), and candidates
+  are tried newest-first across the primary path and any replica
+  directories (``<dir>/replicas/*`` — where PeerReplicator drops peer
+  snapshots), so losing the local disk still warm-starts from a peer.
+* ``pull_weights`` — the in-process fast path: fetch the params from a
+  live peer over the communicator object plane (``bcast_obj``), for
+  replicas joining while the fleet is up.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import io
+import json
+import os
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["publish_weights", "load_weights", "pull_weights",
+           "weight_candidates", "WeightsError"]
+
+_MANIFEST_FORMAT = 1
+
+
+class WeightsError(RuntimeError):
+    """No verifiable weight snapshot could be loaded."""
+
+
+def _flatten(params) -> dict:
+    import jax
+
+    flat = {}
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in leaves_with_path:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def publish_weights(params, path: str) -> dict:
+    """Atomically write ``params`` (any pytree of arrays) to ``path``
+    (.npz) with a SHA-256 manifest sidecar ``path + '.json'``. Returns
+    the manifest. The rename is the commit point: readers only ever see
+    a complete, verified file."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **_flatten(params))
+    data = buf.getvalue()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    sha = hashlib.sha256(data).hexdigest()
+    manifest = {"format": _MANIFEST_FORMAT, "sha256": sha,
+                "bytes": len(data)}
+    mtmp = path + ".json.tmp"
+    with open(mtmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    os.replace(mtmp, path + ".json")
+    return manifest
+
+
+def _verify(path: str) -> bool:
+    mf = path + ".json"
+    if not (os.path.exists(path) and os.path.exists(mf)):
+        return False
+    try:
+        with open(mf) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != _MANIFEST_FORMAT:
+            return False
+        with open(path, "rb") as f:
+            data = f.read()
+        return (len(data) == manifest.get("bytes")
+                and hashlib.sha256(data).hexdigest()
+                == manifest.get("sha256"))
+    except (OSError, ValueError):
+        return False
+
+
+def weight_candidates(path: str) -> List[str]:
+    """The primary snapshot plus any peer replicas
+    (``<dir>/replicas/*/<name>``), newest mtime first."""
+    cands = [path]
+    d, name = os.path.split(os.path.abspath(path))
+    cands += glob.glob(os.path.join(d, "replicas", "*", name))
+    cands = [c for c in cands if os.path.exists(c)]
+    return sorted(cands, key=lambda c: os.path.getmtime(c), reverse=True)
+
+
+def load_weights(path: str,
+                 like: Any = None) -> Tuple[dict, str]:
+    """Load the newest VERIFIED snapshot reachable from ``path``.
+    Returns ``(params, source_path)``. With ``like`` (a template
+    pytree), the flat npz keys are folded back into the template's
+    structure; otherwise a flat ``{path: array}`` dict is returned.
+    Corrupt candidates are skipped (torn writes, bad sha); raises
+    :class:`WeightsError` when nothing verifies."""
+    for cand in weight_candidates(path):
+        if not _verify(cand):
+            continue
+        with np.load(cand) as z:
+            flat = {k: z[k] for k in z.files}
+        if like is None:
+            return flat, cand
+        return _unflatten_like(like, flat), cand
+    raise WeightsError(
+        f"no verified weight snapshot at {path!r} or its replicas")
+
+
+def _unflatten_like(like, flat: dict):
+    import jax
+    import jax.numpy as jnp
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for path, leaf in leaves_with_path:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        if key not in flat:
+            raise WeightsError(f"snapshot is missing parameter {key!r}")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise WeightsError(
+                f"snapshot shape mismatch for {key!r}: "
+                f"{arr.shape} vs {np.shape(leaf)}")
+        leaves.append(jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def pull_weights(comm, params: Optional[Any], root: int = 0):
+    """Fetch warm weights from a live peer: rank ``root`` contributes
+    its params, everyone receives them (object-plane broadcast — the
+    joining replica never touches disk)."""
+    return comm.bcast_obj(params, root=root)

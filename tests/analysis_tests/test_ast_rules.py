@@ -23,7 +23,8 @@ def _only(findings, rule):
 
 def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
-            "DL107", "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
+            "DL107", "DL108", "DL201", "DL202", "DL203",
+            "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
         assert rule.kind in ("ast", "hlo")
@@ -646,3 +647,103 @@ def test_dl107_suppression_with_rationale():
         return db.plan_for("tpu:v5e/ici:4+dcn:2")  # dlint: disable=DL107
     """
     assert _only(_lint(src), "DL107") == []
+
+
+# ---------------------------------------------------------------------------
+# DL108 — decode-step-recompile
+# ---------------------------------------------------------------------------
+
+
+def test_dl108_flags_jit_built_inside_loop():
+    src = """\
+    import jax
+
+    def decode(step, toks):
+        for _ in range(64):
+            f = jax.jit(step)
+            toks = f(toks)
+    """
+    fs = _only(_lint(src), "DL108")
+    assert len(fs) == 1
+    assert fs[0].line == 5
+    assert "fresh" in fs[0].message
+    assert "docs/static_analysis.md#dl108" in fs[0].message
+
+
+def test_dl108_flags_loop_counter_slice_into_jitted_step():
+    src = """\
+    import jax
+
+    def decode(model, toks, n):
+        step = jax.jit(model.apply)
+        for t in range(4, n):
+            logits = step(toks[:, :t])
+    """
+    fs = _only(_lint(src), "DL108")
+    assert len(fs) == 1
+    assert fs[0].line == 6
+    assert "PER SEQUENCE LENGTH" in fs[0].message
+
+
+def test_dl108_flags_while_counter_slice():
+    src = """\
+    import jax
+
+    def decode(step2, toks):
+        step = jax.jit(step2)
+        t = 4
+        while t < 64:
+            logits = step(toks[:t])
+            t += 1
+    """
+    assert len(_only(_lint(src), "DL108")) == 1
+
+
+def test_dl108_clean_on_hoisted_jit_with_fixed_shapes():
+    src = """\
+    import jax
+
+    def decode(step2, cache, toks, n):
+        step = jax.jit(step2)
+        for t in range(n):
+            logits, cache = step(cache, toks)
+            toks = logits.argmax(-1)
+    """
+    assert _only(_lint(src), "DL108") == []
+
+
+def test_dl108_clean_on_per_candidate_compiles():
+    # autotune shape: the jitted program READS the loop variable, so
+    # each iteration compiles a genuinely different candidate
+    src = """\
+    import jax
+
+    def tune(kernels, x):
+        for name in kernels:
+            f = jax.jit(lambda v: kernels[name](v))
+            f(x)
+    """
+    assert _only(_lint(src), "DL108") == []
+
+
+def test_dl108_clean_on_plain_index_and_uncompiled_calls():
+    src = """\
+    def collect(rows, sink, n):
+        for i in range(n):
+            sink.append(rows[i])        # fixed shape per item
+            check(rows[:i + 1].sum())   # not a jit-bound callee
+    """
+    assert _only(_lint(src), "DL108") == []
+
+
+def test_dl108_suppression_with_rationale():
+    src = """\
+    import jax
+
+    def profile(step2, toks):
+        step = jax.jit(step2)
+        for t in range(8, 64, 8):
+            # fixture: measuring compile cost per length is the point
+            step(toks[:, :t])  # dlint: disable=DL108
+    """
+    assert _only(_lint(src), "DL108") == []

@@ -1,0 +1,127 @@
+"""pos_offset audit: every attention variant applies positional offsets
+consistently — scalar vs per-row vector, decode step vs full-forward
+column (the latent-bug sweep the serving layer depends on)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.ops.rotary import apply_rope, apply_rope_bhld
+
+
+def _model(**kw):
+    # 1 layer: offset handling is per-layer-identical, and the 2-layer
+    # serving path is pinned by tests/serving_tests/test_kv_cache.py
+    base = dict(vocab=43, d_model=32, n_heads=4, n_layers=1, d_ff=48,
+                max_len=64, attention="reference")
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+def test_apply_rope_bhld_vector_positions():
+    """[B, L] positions == stacking the per-row [L] application."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(3, 2, 4, 16), jnp.float32)  # [B, H, L, D]
+    pos = jnp.asarray([[0, 1, 2, 3], [5, 6, 7, 8], [9, 10, 11, 12]])
+    out = apply_rope_bhld(x, pos)
+    for i in range(3):
+        ref = apply_rope_bhld(x[i:i + 1], pos[i])
+        np.testing.assert_array_equal(np.asarray(out[i]),
+                                      np.asarray(ref[0]))
+
+
+def test_apply_rope_layouts_agree():
+    """blhd and bhld rotations are the same math (transposed bitwise)."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 5, 3, 8), jnp.float32)   # [B, L, H, D]
+    pos = jnp.arange(5) + 7
+    a = apply_rope(x, pos)
+    b = apply_rope_bhld(x.transpose(0, 2, 1, 3), pos)
+    np.testing.assert_array_equal(np.asarray(a),
+                                  np.asarray(b.transpose(0, 2, 1, 3)))
+
+
+@pytest.mark.parametrize("kw", [
+    {"pos_emb": "learned"},
+    {"pos_emb": "rope"},
+    {"pos_emb": "rope", "attention": "flash"},
+], ids=["learned", "rope", "rope+flash"])
+def test_vector_pos_offset_matches_per_row_scalar(kw):
+    """A [B] pos_offset vector == applying each row with its scalar
+    offset (bitwise): the form serving's decode step hands the model."""
+    model = _model(**kw)
+    rng = np.random.RandomState(2)
+    tokens = jnp.asarray(rng.randint(0, 43, (3, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    offsets = jnp.asarray([0, 4, 11], jnp.int32)
+    out = model.apply({"params": params}, tokens, pos_offset=offsets)
+    for i in range(3):
+        ref = model.apply({"params": params}, tokens[i:i + 1],
+                          pos_offset=int(offsets[i]))
+        np.testing.assert_array_equal(np.asarray(out[i]),
+                                      np.asarray(ref[0]))
+
+
+def test_bhld_vector_pos_offset():
+    """The head-major layout honors per-row offsets too."""
+    model = _model(attention="flash", qkv_layout="bhld", pos_emb="rope")
+    rng = np.random.RandomState(3)
+    tokens = jnp.asarray(rng.randint(0, 43, (2, 6)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    offsets = jnp.asarray([2, 9], jnp.int32)
+    out = model.apply({"params": params}, tokens, pos_offset=offsets)
+    for i in range(2):
+        ref = model.apply({"params": params}, tokens[i:i + 1],
+                          pos_offset=int(offsets[i]))
+        np.testing.assert_array_equal(np.asarray(out[i]),
+                                      np.asarray(ref[0]))
+
+
+@pytest.mark.parametrize("kw", [
+    {"pos_emb": "learned"},
+    {"pos_emb": "rope"},
+    {"pos_emb": "rope", "n_kv_heads": 2},
+    {"pos_emb": "rope", "attention": "flash"},
+    {"pos_emb": "rope", "attention": "flash", "attention_window": 8},
+], ids=["learned", "rope", "gqa", "flash", "flash+window"])
+def test_decode_step_logits_match_full_forward_column(kw):
+    """Single-token decode at position t reproduces the full forward's
+    column t for every variant — bitwise on the reference path (the
+    serving contract), allclose on flash (different prefill kernel)."""
+    model = _model(**kw)
+    rng = np.random.RandomState(4)
+    b, lp, n_new = 2, 7, 4
+    prompt = jnp.asarray(rng.randint(0, 43, (b, lp)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    dm = model.clone(decode=True)
+
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda t: dm.init(jax.random.PRNGKey(0), t),
+                       prompt[:, :1])["cache"])
+    logits, upd = dm.apply({"params": params, "cache": cache}, prompt,
+                           pos_offset=0, mutable=["cache"])
+    cache = upd["cache"]
+    toks = prompt
+    bitwise = model.attention == "reference"
+    rows = []
+    for t in range(lp, lp + n_new):
+        nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        logits, upd = dm.apply({"params": params, "cache": cache},
+                               nxt[:, None], pos_offset=t,
+                               mutable=["cache"])
+        cache = upd["cache"]
+        rows.append(np.asarray(logits[:, -1]))
+    # one full forward at the final length oracles every step: causal
+    # masking makes column t independent of everything after t
+    full = np.asarray(model.apply({"params": params}, toks))
+    for i, row in enumerate(rows):
+        if bitwise:
+            np.testing.assert_array_equal(row, full[:, lp + i])
+        else:
+            np.testing.assert_allclose(row, full[:, lp + i],
+                                       rtol=2e-5, atol=2e-5)

@@ -1,0 +1,130 @@
+"""Frontend: thread-safe futures, RpcPolicy deadlines, watchdog aborts."""
+
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.comm.object_plane import JobAbortedError
+from chainermn_tpu.models.transformer import TransformerLM, generate
+from chainermn_tpu.resilience.policy import RpcPolicy
+from chainermn_tpu.serving.engine import Engine, EngineConfig
+from chainermn_tpu.serving.frontend import DeadlineExceeded, Frontend
+
+
+def _engine(**cfg_kw):
+    model = TransformerLM(vocab=43, d_model=32, n_heads=4, n_layers=1,
+                          d_ff=48, max_len=64, attention="reference",
+                          pos_emb="rope")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    base = dict(n_slots=2, capacity=16, max_new_tokens=4,
+                prefill_cohort=1, buckets=[4, 16])
+    base.update(cfg_kw)
+    return model, params, Engine(model, params, EngineConfig(**base))
+
+
+_POL = RpcPolicy(timeout_ms=60_000, probe_ms=50)
+
+
+def test_submit_returns_matching_futures():
+    model, params, eng = _engine()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 43, (4,)).astype(np.int32)
+               for _ in range(3)]
+    with Frontend(eng, rpc_policy=_POL) as fe:
+        futs = [fe.submit(p) for p in prompts]
+        reqs = [fe.result(f, timeout_ms=60_000) for f in futs]
+    for p, req in zip(prompts, reqs):
+        ref = generate(model, params, p[None], 4)
+        np.testing.assert_array_equal(np.asarray(req.tokens),
+                                      np.asarray(ref)[0, 4:])
+        assert req.state == "done"
+
+
+def test_concurrent_submitters():
+    model, params, eng = _engine(max_new_tokens=3)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 43, (4,)).astype(np.int32)
+               for _ in range(6)]
+    results = {}
+    with Frontend(eng, rpc_policy=_POL) as fe:
+        def worker(i):
+            fut = fe.submit(prompts[i])
+            results[i] = fe.result(fut, timeout_ms=60_000)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+    assert sorted(results) == list(range(6))
+    for i, p in enumerate(prompts):
+        ref = generate(model, params, p[None], 3)
+        np.testing.assert_array_equal(np.asarray(results[i].tokens),
+                                      np.asarray(ref)[0, 4:])
+
+
+def test_deadline_bounded_wait_raises():
+    _, _, eng = _engine()
+    with Frontend(eng, rpc_policy=_POL) as fe:
+        never = Future()                        # nothing will resolve it
+        with pytest.raises(DeadlineExceeded, match="probe"):
+            fe.result(never, timeout_ms=120)
+
+
+def test_bad_request_fails_future_not_thread():
+    _, _, eng = _engine()
+    with Frontend(eng, rpc_policy=_POL) as fe:
+        bad = fe.submit(np.zeros((0,), np.int32))
+        with pytest.raises(ValueError, match="empty"):
+            fe.result(bad, timeout_ms=5_000)
+        ok = fe.submit(np.ones((4,), np.int32))
+        req = fe.result(ok, timeout_ms=60_000)
+        assert req.state == "done"
+
+
+class _TrippableWatchdog:
+    def __init__(self):
+        self.tripped = threading.Event()
+
+    def check(self):
+        if self.tripped.is_set():
+            raise JobAbortedError("peer 3 declared dead")
+
+
+def test_watchdog_bounded_abort_of_in_flight_requests():
+    """Peer loss aborts in-flight requests within one iteration: their
+    futures fail with JobAbortedError instead of hanging."""
+    _, _, eng = _engine(max_new_tokens=500, capacity=512,
+                        buckets=[4, 512])
+    wd = _TrippableWatchdog()
+    with Frontend(eng, rpc_policy=_POL, watchdog=wd) as fe:
+        fut = fe.submit(np.ones((4,), np.int32))
+        # let it get in flight, then declare the peer dead
+        deadline = 200
+        while not eng.active and deadline:
+            deadline -= 1
+            threading.Event().wait(0.005)
+        assert eng.active, "request never entered a slot"
+        wd.tripped.set()
+        with pytest.raises(JobAbortedError, match="declared dead"):
+            fe.result(fut, timeout_ms=30_000)
+    assert eng.report.aborted == 1
+
+
+def test_close_fails_inflight_futures():
+    _, _, eng = _engine(max_new_tokens=2000, capacity=4096,
+                        buckets=[4, 4096])
+    fe = Frontend(eng, rpc_policy=_POL)
+    fut = fe.submit(np.ones((4,), np.int32))
+    fe.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        fut.result(timeout=10)
+    with pytest.raises(RuntimeError, match="closed"):
+        fe.submit(np.ones((4,), np.int32))

@@ -1,0 +1,198 @@
+"""Paged KV cache: bitwise decode parity, scatter semantics, sizing."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.serving.kv_cache import (ServingStep, cache_bytes,
+                                            init_cache, prefill_apply)
+
+
+def _model(**kw):
+    base = dict(vocab=43, d_model=32, n_heads=4, n_layers=2, d_ff=48,
+                max_len=64, attention="reference")
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+def _setup(model, b=2, lp=6, seed=0):
+    rng = np.random.RandomState(seed)
+    prompt = rng.randint(0, model.vocab, (b, lp)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(3),
+                        jnp.asarray(prompt))["params"]
+    return prompt, params
+
+
+@pytest.mark.parametrize("kw", [
+    {},                                        # learned pos, 2-layer
+    {"pos_emb": "rope", "n_layers": 1},
+    {"n_kv_heads": 2, "pos_emb": "rope", "n_layers": 1},  # GQA repeat
+], ids=["learned", "rope", "gqa"])
+def test_decode_bitwise_matches_full_forward(kw):
+    """THE serving numerics contract: with capacity covering the whole
+    stream and reference attention, every cached-decode logit row is
+    BITWISE-equal to the corresponding column of a full forward over the
+    prefix — not allclose, equal. Both sides run under jit (whole-graph
+    XLA fuses differently from eager dispatch; like must compare against
+    like — docs/serving.md §numerics).
+
+    ONE full forward at the final length oracles every step: under the
+    causal mask column t attends only to its prefix, and the masked
+    softmax lanes are exactly zero, so column t of the final forward is
+    bitwise the last column of a length-(t+1) forward."""
+    model = _model(**kw)
+    b, lp, n_new = 2, 6, 5
+    prompt, params = _setup(model, b, lp)
+    step = ServingStep(model, params, n_slots=b, capacity=lp + n_new)
+    full_jit = jax.jit(lambda p, t: model.apply({"params": p}, t))
+
+    rows = [step.prefill(prompt, [lp] * b, list(range(b)))]
+    toks = jnp.asarray(prompt, jnp.int32)
+    for _ in range(n_new):
+        nxt = jnp.argmax(rows[-1], -1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        rows.append(step.decode(nxt))
+    full = np.asarray(full_jit(params, toks))   # one compile, final length
+    for t, row in enumerate(rows):
+        np.testing.assert_array_equal(np.asarray(row),
+                                      full[:, lp - 1 + t])
+
+
+def test_per_slot_cursors_advance_independently():
+    """Slots prefilled at different depths decode against their own
+    positions: each slot's logits bitwise-match a single-slot run."""
+    model = _model(pos_emb="rope", n_layers=1)
+    rng = np.random.RandomState(1)
+    lens = [3, 7]
+    prompts = [rng.randint(0, 43, (1, l)).astype(np.int32) for l in lens]
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(prompts[1]))["params"]
+
+    # two slots, admitted via per-length (exact) prefill cohorts
+    step = ServingStep(model, params, n_slots=2, capacity=16)
+    for sid, (p, l) in enumerate(zip(prompts, lens)):
+        step.prefill(p, [l], [sid])
+    assert list(step.cursors()) == lens
+
+    # singleton oracles, one per stream
+    solo = [ServingStep(model, params, n_slots=1, capacity=16)
+            for _ in lens]
+    ref = [s.prefill(p, [l], [0])
+           for s, p, l in zip(solo, prompts, lens)]
+
+    tok = jnp.asarray([int(np.argmax(np.asarray(r)[0])) for r in ref],
+                      jnp.int32)
+    for _ in range(3):
+        logits = step.decode(tok)
+        refs = [s.decode(tok[i:i + 1]) for i, s in enumerate(solo)]
+        for i, r in enumerate(refs):
+            np.testing.assert_array_equal(np.asarray(logits[i]),
+                                          np.asarray(r[0]))
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+
+
+def test_prefill_sentinel_row_is_dropped():
+    """A cohort padding row (slot id == n_slots) must not touch any page
+    or cursor."""
+    model = _model(n_layers=1)
+    prompt, params = _setup(model, b=1, lp=4)
+    step = ServingStep(model, params, n_slots=2, capacity=8)
+    step.prefill(prompt, [4], [0])
+    before = jax.device_get(step.cache)
+
+    # same prompt again, but routed to the sentinel: a no-op admission
+    step.prefill(prompt, [4], [step.n_slots])
+    after = jax.device_get(step.cache)
+    for name in before:
+        for leaf in ("k", "v", "idx"):
+            np.testing.assert_array_equal(before[name][leaf],
+                                          after[name][leaf])
+    assert list(step.cursors()) == [4, 0]
+
+
+def test_ring_wrap_is_a_sliding_window():
+    """Past capacity the page wraps: the final step's logits equal a
+    fresh forward over just the last `capacity` tokens at their true
+    rope positions (single layer — streaming k/v equal recomputed k/v
+    there)."""
+    model = _model(pos_emb="rope", n_layers=1)
+    cap, total = 8, 14
+    prompt, params = _setup(model, b=1, lp=4)
+    step = ServingStep(model, params, n_slots=1, capacity=cap)
+    logits = step.prefill(prompt, [4], [0])
+    toks = [int(t) for t in prompt[0]]
+    for _ in range(total - 4):
+        nxt = int(np.argmax(np.asarray(logits)[0]))
+        toks.append(nxt)
+        logits = step.decode([nxt])
+    # suffix recompute: the last cap tokens, rope offset to their global
+    # positions (the decode branch's ring mask shows exactly this window)
+    suffix = jnp.asarray([toks[-cap:]], jnp.int32)
+    ref = model.apply({"params": params}, suffix,
+                      pos_offset=len(toks) - cap)
+    np.testing.assert_allclose(np.asarray(logits)[0],
+                               np.asarray(ref)[0, -1], rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_decode_traced_once():
+    """The continuous-batching invariant DL108 polices: N decode steps,
+    ONE trace."""
+    model = _model(pos_emb="rope", n_layers=1)
+    prompt, params = _setup(model, b=2, lp=4)
+    step = ServingStep(model, params, n_slots=2, capacity=32)
+    step.prefill(prompt, [4, 4], [0, 1])
+    tok = np.array([1, 2], np.int32)
+    for _ in range(4):
+        logits = step.decode(tok)
+        tok = np.asarray(jnp.argmax(logits, -1), np.int32)
+    assert step.decode_traces == 1
+    assert step.prefill_traces == {(2, 4): 1}
+
+
+def test_explicit_mesh_shardings(comm):
+    """Head-sharded cache under jit: same bitwise logits as unsharded."""
+    model = _model(pos_emb="rope", n_kv_heads=8, n_heads=8, n_layers=1)
+    prompt, params = _setup(model, b=2, lp=5)
+    plain = ServingStep(model, params, n_slots=2, capacity=16)
+    sharded = ServingStep(model, params, n_slots=2, capacity=16,
+                          mesh=comm.mesh, axis=comm.mesh.axis_names[0])
+    a = plain.prefill(prompt, [5, 5], [0, 1])
+    b_ = sharded.prefill(prompt, [5, 5], [0, 1])
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    tok = jnp.argmax(a, -1).astype(jnp.int32)
+    for _ in range(2):
+        da = plain.decode(tok)
+        db = sharded.decode(tok)
+        np.testing.assert_array_equal(np.asarray(da), np.asarray(db))
+        tok = jnp.argmax(da, -1).astype(jnp.int32)
+
+
+def test_cache_bytes_math():
+    model = _model(n_kv_heads=2)
+    # 2 layers · 3 slots · 16 cap · 2 (K,V) · 2 kv-heads · 8 d_head · 4 B
+    assert cache_bytes(model, 3, 16) == 2 * 3 * 16 * 2 * 2 * 8 * 4
+    step = ServingStep(model, model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"],
+        n_slots=3, capacity=16)
+    assert step.cache_bytes() == cache_bytes(model, 3, 16)
+
+
+def test_prefill_bucket_exceeding_capacity_raises():
+    model = _model()
+    prompt, params = _setup(model, b=1, lp=6)
+    cache = init_cache(model, 1, 4)
+    with pytest.raises(ValueError, match="capacity"):
+        prefill_apply(model.clone(decode=True), params, cache,
+                      jnp.asarray(prompt), jnp.asarray([6]),
+                      jnp.asarray([0]))
+
+
+def test_serving_rejects_moe_and_tp():
+    with pytest.raises(ValueError, match="MoE"):
+        ServingStep(_model(moe_experts_per_device=1), {}, 1, 8)
+    with pytest.raises(ValueError, match="tp_axis"):
+        ServingStep(_model(tp_axis="model"), {}, 1, 8)

@@ -1,0 +1,70 @@
+"""ServingReport: deterministic telemetry against a fake clock."""
+
+import json
+import math
+
+from chainermn_tpu.serving.reports import ServingReport, percentile
+
+
+class Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_percentile_nearest_rank():
+    xs = [0.1, 0.2, 0.3, 0.4]
+    assert percentile(xs, 50) == 0.3          # round(0.5*3)=2
+    assert percentile(xs, 99) == 0.4
+    assert percentile(xs, 0) == 0.1
+    assert math.isnan(percentile([], 50))
+
+
+def test_ttft_and_token_cadence():
+    clk = Clock()
+    rep = ServingReport(time_fn=clk)
+    rep.record_submit(0)
+    clk.t += 0.050                     # 50 ms to first token
+    rep.record_token(0)
+    for _ in range(3):
+        clk.t += 0.010                 # 10 ms cadence
+        rep.record_token(0)
+    rep.record_retire(0)
+    s = rep.summary()
+    assert s["requests"] == {"submitted": 1, "completed": 1, "aborted": 0}
+    assert s["tokens_emitted"] == 4
+    assert abs(s["ttft_ms"]["p50"] - 50.0) < 1e-6
+    assert s["ttft_ms"]["n"] == 1
+    assert abs(s["token_latency_ms"]["p99"] - 10.0) < 1e-6
+    assert s["token_latency_ms"]["n"] == 3
+    assert abs(s["wall_s"] - 0.080) < 1e-9
+    assert abs(s["tokens_per_s"] - 4 / 0.080) < 1e-6
+
+
+def test_abort_and_scheduler_samples():
+    clk = Clock()
+    rep = ServingReport(time_fn=clk)
+    rep.record_submit(0)
+    rep.record_submit(1)
+    clk.t += 0.02
+    rep.record_token(0)
+    rep.record_step(queue_depth=1, occupancy=0.5)
+    rep.record_step(queue_depth=0, occupancy=1.0)
+    rep.record_retire(0)
+    rep.record_retire(1, aborted=True)
+    s = rep.summary()
+    assert s["requests"]["aborted"] == 1
+    assert s["queue_depth"]["max"] == 1
+    assert abs(s["slot_occupancy"]["mean"] - 0.75) < 1e-9
+    # the JSON face round-trips (bench_serve consumes it)
+    assert json.loads(rep.json())["requests"]["submitted"] == 2
+
+
+def test_empty_report_is_well_formed():
+    s = ServingReport(time_fn=Clock()).summary()
+    assert s["tokens_emitted"] == 0
+    assert math.isnan(s["tokens_per_s"])
+    assert math.isnan(s["ttft_ms"]["p50"])
+    assert s["queue_depth"]["max"] == 0

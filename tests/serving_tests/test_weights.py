@@ -1,0 +1,95 @@
+"""Warm-weight plane: atomic publish, manifest-verified load, replica
+fallback, peer pull."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.serving.weights import (WeightsError, load_weights,
+                                           publish_weights, pull_weights,
+                                           weight_candidates)
+
+
+def _params(seed=0):
+    model = TransformerLM(vocab=17, d_model=16, n_heads=2, n_layers=1,
+                          d_ff=24, max_len=16, attention="reference")
+    return model, model.init(jax.random.PRNGKey(seed),
+                             jnp.zeros((1, 4), jnp.int32))["params"]
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_publish_load_roundtrip(tmp_path):
+    _, params = _params()
+    path = str(tmp_path / "w.npz")
+    manifest = publish_weights(params, path)
+    assert manifest["format"] == 1
+    with open(path + ".json") as f:
+        assert json.load(f) == manifest
+    loaded, src = load_weights(path, like=params)
+    assert src == path
+    _tree_equal(params, loaded)
+
+
+def test_corrupt_snapshot_is_refused(tmp_path):
+    _, params = _params()
+    path = str(tmp_path / "w.npz")
+    publish_weights(params, path)
+    with open(path, "r+b") as f:        # flip one byte mid-file
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(WeightsError, match="no verified"):
+        load_weights(path)
+
+
+def test_replica_fallback(tmp_path):
+    """Primary torn → the newest verified peer replica loads instead."""
+    _, params = _params()
+    path = str(tmp_path / "w.npz")
+    rep_dir = tmp_path / "replicas" / "peer1"
+    rep_dir.mkdir(parents=True)
+    rep = str(rep_dir / "w.npz")
+    publish_weights(params, rep)
+    # primary exists but has no manifest (torn publish)
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    assert weight_candidates(path)[0] in (path, rep)
+    loaded, src = load_weights(path, like=params)
+    assert src == rep
+    _tree_equal(params, loaded)
+
+
+def test_missing_everything_raises(tmp_path):
+    with pytest.raises(WeightsError):
+        load_weights(str(tmp_path / "nope.npz"))
+
+
+def test_shape_mismatch_refused(tmp_path):
+    _, params = _params()
+    path = str(tmp_path / "w.npz")
+    publish_weights(params, path)
+    _, other = _params(seed=1)
+    bigger = jax.tree_util.tree_map(
+        lambda l: jnp.zeros((3,) + l.shape, l.dtype), other)
+    with pytest.raises(WeightsError, match="shape mismatch"):
+        load_weights(path, like=bigger)
+
+
+def test_pull_weights_broadcasts(comm):
+    _, params = _params()
+    got = pull_weights(comm, params, root=0)
+    _tree_equal(params, got)

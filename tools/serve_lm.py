@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""serve_lm — one supervised serving replica over the continuous-
+batching engine.
+
+Builds a seeded TransformerLM, warm-loads weights from a published
+snapshot when one exists (publishing on first boot so restarts never
+re-initialise), queues a deterministic batch of prompts, and drains the
+engine while exposed to ``$CHAINERMN_TPU_CHAOS``. Completed streams are
+appended to a JSONL file *idempotently*: a restarted incarnation skips
+request ids already on disk, so a chaos kill mid-decode heals to the
+same final output the unkilled run would have produced.
+
+Wrap it in the per-host restart loop for the fleet drill::
+
+    CHAINERMN_TPU_CHAOS='kill@step=6,run=0' \\
+        python tools/supervise.py --max-restarts 2 -- \\
+        python tools/serve_lm.py --out /tmp/streams.jsonl
+
+Exit status follows the supervisor contract (resilience/supervisor.py):
+0 clean, 75 on a watchdog abort, anything else is a crash.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _log(msg):
+    print(f"serve_lm: {msg}", file=sys.stderr, flush=True)
+
+
+def _done_ids(path):
+    """Request ids already drained to the JSONL (prior incarnations)."""
+    done = set()
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    done.add(json.loads(line)["request_id"])
+    return done
+
+
+def serve(args):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import (Engine, EngineConfig, ServingReport,
+                                       load_weights, publish_weights)
+    from chainermn_tpu.serving.weights import WeightsError
+
+    model = TransformerLM(vocab=args.vocab, d_model=args.d_model,
+                          n_heads=args.n_heads, n_layers=args.n_layers,
+                          d_ff=2 * args.d_model, max_len=args.capacity,
+                          attention="reference", pos_emb="rope")
+    init = model.init(jax.random.PRNGKey(args.seed),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+    if args.weights:
+        try:
+            params, src = load_weights(args.weights, like=init)
+            _log(f"warm weights loaded from {src}")
+        except WeightsError:
+            params = init
+            publish_weights(params, args.weights)
+            _log(f"cold boot: published weights to {args.weights}")
+    else:
+        params = init
+
+    eng = Engine(model, params,
+                 EngineConfig(n_slots=args.slots, capacity=args.capacity,
+                              max_new_tokens=args.max_new_tokens,
+                              prefill_cohort=1,
+                              buckets=[args.prompt_len, args.capacity]),
+                 report=ServingReport())
+
+    done = _done_ids(args.out)
+    rng = np.random.RandomState(args.seed)
+    reqs = {}
+    for i in range(args.requests):
+        prompt = rng.randint(0, args.vocab,
+                             (args.prompt_len,)).astype(np.int32)
+        if i in done:
+            continue                   # drained by a prior incarnation
+        reqs[i] = (eng.submit(prompt), prompt)
+    _log(f"queued {len(reqs)} of {args.requests} requests "
+         f"({len(done)} already drained)")
+
+    emitted = {}
+    with open(args.out, "a") as out:
+        while not eng.idle():
+            eng.step()                 # chaos.on_step fires in here
+            for i, (req, prompt) in reqs.items():
+                if req.state == "done" and i not in emitted:
+                    emitted[i] = True
+                    out.write(json.dumps(
+                        {"request_id": i,
+                         "prompt": prompt.tolist(),
+                         "tokens": req.tokens}) + "\n")
+                    out.flush()
+                    os.fsync(out.fileno())
+    _log(f"drained; report: {eng.report.json()}")
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(eng.report.json())
+    return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="serve_lm", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", required=True,
+                    help="JSONL of completed streams (append, idempotent)")
+    ap.add_argument("--weights", default=None,
+                    help="published-weights path: warm-load when present, "
+                         "publish on cold boot")
+    ap.add_argument("--report", default=None,
+                    help="write the ServingReport JSON here on drain")
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--prompt-len", type=int, default=4)
+    ap.add_argument("--max-new-tokens", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--capacity", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=43)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from chainermn_tpu.resilience.supervisor import main_exit_code
+
+    return main_exit_code(lambda: serve(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
