@@ -264,6 +264,29 @@ def main():
                 record[f"{prefix}_error"] = f"{type(e).__name__}: {e}"[:300]
         record["lm_gate_ok"] = bool(ok)
 
+    # quantized-wire byte gate (docs/collectives.md#quantized-wire-formats),
+    # folded into the same JSON line. The accounting is host-side and
+    # byte-exact (tools/bench_lm.py wire_report runs the LM bench config's
+    # abstract params through the reducer's bucket plan — zero FLOPs), so
+    # unlike the throughput gates this one is NOT TPU-gated: int8-block
+    # must cut the wire to <= 0.27x of flat f32 and int4-block to
+    # <= 0.14x, scale sidecars included.
+    try:
+        from tools.bench_lm import wire_report
+
+        flat_wire = wire_report("f32")["wire_bytes"]
+        wire_ok = bool(flat_wire)
+        for wfmt, ceil in (("int8-block", 0.27), ("int4-block", 0.14)):
+            rep = wire_report(wfmt)
+            ratio = rep["wire_bytes"] / flat_wire if flat_wire else 1.0
+            record[f"wire_{wfmt}_bytes"] = rep["wire_bytes"]
+            record[f"wire_{wfmt}_vs_flat"] = round(ratio, 6)
+            wire_ok = wire_ok and ratio <= ceil
+        record["wire_flat_bytes"] = flat_wire
+        record["wire_gate_ok"] = wire_ok
+    except Exception as e:  # never sink the headline metric
+        record["wire_gate_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # schedtune tuned-vs-default overlap fraction (docs/tuning.md),
     # folded into the same JSON line. The fractions come from the canned
     # scheduled-HLO search over this model's gradient payload — honest

@@ -28,6 +28,7 @@ Two pass families, one CLI (``tools/dlint.py``):
   - ``DL202`` per-step collective-count budget
   - ``DL203`` 1F1B wire permutes must be async with compute inside
   - ``DL204`` degenerate FSDP all-gather prefetch (gathered layers co-live)
+  - ``DL205`` quantized wire: dominant collective must carry a narrow dtype
 
 Every rule has a stable ID, a fix-it message citing the docs
 (docs/static_analysis.md catalogues each with a minimal failing
@@ -51,6 +52,7 @@ from chainermn_tpu.analysis.hlo_passes import (  # noqa: F401
     check_dp_overlap,
     check_fsdp_gather_liveness,
     check_pipeline_permute_overlap,
+    check_quantized_wire_dtype,
     dp_overlap_fraction,
     parse_computations,
     scheduled_entry_ops,

@@ -8,7 +8,10 @@ Public surface::
                                       grad_reducer=reducer)   # or the name
 
 Strategies: ``flat`` (the numerical reference), ``hierarchical``,
-``quantized`` (error feedback), ``auto`` (cost model).
+``quantized`` (error feedback), ``auto`` (cost model). The
+``wire_format=`` knob (``'f32' | 'bf16' | 'int8' | 'int8-block' |
+'int4-block'``) selects what the compressing strategies put on the
+wire — see docs/collectives.md#quantized-wire-formats.
 """
 
 from chainermn_tpu.collectives.auto import (  # noqa: F401
@@ -18,6 +21,7 @@ from chainermn_tpu.collectives.auto import (  # noqa: F401
 )
 from chainermn_tpu.collectives.base import (  # noqa: F401
     REDUCERS,
+    WIRE_FORMATS,
     GradReducer,
     make_grad_reducer,
     register_reducer,
@@ -28,8 +32,15 @@ from chainermn_tpu.collectives.hierarchical import (  # noqa: F401
     HierTopology,
 )
 from chainermn_tpu.collectives.quantized import (  # noqa: F401
+    QUANT_BLOCK,
     QuantizedReducer,
+    block_dequantize,
+    block_quantize,
+    pack_int4,
     quantize_allreduce,
+    quantized_wire_bytes,
+    unpack_int4,
+    wire_ratio,
 )
 
 __all__ = [
@@ -37,11 +48,19 @@ __all__ = [
     "make_grad_reducer",
     "register_reducer",
     "REDUCERS",
+    "WIRE_FORMATS",
     "FlatReducer",
     "HierarchicalReducer",
     "HierTopology",
     "QuantizedReducer",
     "quantize_allreduce",
+    "QUANT_BLOCK",
+    "block_quantize",
+    "block_dequantize",
+    "pack_int4",
+    "unpack_int4",
+    "wire_ratio",
+    "quantized_wire_bytes",
     "AutoReducer",
     "CostModel",
     "measure_strategies",

@@ -48,8 +48,9 @@ from chainermn_tpu.collectives.base import (
 )
 from chainermn_tpu.collectives.hierarchical import HierTopology
 from chainermn_tpu.collectives.quantized import (
-    WIRE_ITEMSIZE,
     quantize_allreduce,
+    quantized_wire_bytes,
+    wire_ratio,
 )
 
 
@@ -73,7 +74,8 @@ class CostModel:
         return nbytes / (bw_gbps * 1e3)  # 1 GB/s == 1e3 bytes/us
 
     def estimate_us(self, strategy: str, nbytes: int,
-                    topo: HierTopology) -> float:
+                    topo: HierTopology,
+                    wire_format: str = "bf16") -> float:
         """Modeled time for ONE reduction of ``nbytes`` payload."""
         n, intra, inter = topo.n, topo.intra, topo.inter
         ring = lambda b, k: 2.0 * b * (k - 1) / max(k, 1)
@@ -90,7 +92,10 @@ class CostModel:
                     ring(nbytes / intra, inter), self.dcn_bw_gbps)
             return t
         if strategy == "quantized":
-            wire = nbytes * WIRE_ITEMSIZE["bf16"] / 4.0
+            # beta scales with the ACTUAL wire width (values + block
+            # scales) — pricing every format at bf16 meant 'auto' could
+            # never rationally pick the int8/int4 wires
+            wire = nbytes * wire_ratio(wire_format)
             return (slow_lat + self.quant_overhead_us
                     + self._xfer_us(ring(wire, n), slow_bw))
         raise ValueError(f"unknown strategy {strategy!r}")
@@ -206,10 +211,18 @@ class AutoReducer(GradReducer):
     ``profile`` — a :class:`~chainermn_tpu.tuning.profile_db.ProfileDB`
     (or path, or ``True`` for the default location) whose persisted
     ``measure_strategies`` sweep for this topology fingerprint seeds
-    ``measured`` (an explicit ``measured=`` entry wins per key).
+    ``measured`` (an explicit ``measured=`` entry wins per key);
+    ``wire_format`` — the wire the quantized candidate uses AND is
+    priced at (default ``'bf16'``, the historical behavior; the block
+    formats make the quantized candidate ~4–8x cheaper on beta, so the
+    cost model can actually choose it). ``wire_format='f32'`` disables
+    the lossy candidate outright (an uncompressed "quantized" wire is
+    the flat strategy). Implies nothing unless ``lossy=True`` — a
+    strategy named "auto" must not silently change numerics.
     """
 
     name = "auto"
+    wire_formats = ("f32", "bf16", "int8", "int8-block", "int4-block")
 
     def __init__(self, comm, op: str = "mean",
                  bucket_bytes: Optional[int] = None,
@@ -219,8 +232,17 @@ class AutoReducer(GradReducer):
                  lossy: bool = False,
                  bucket_order: str = "emission",
                  topology=None,
-                 profile=None):
+                 profile=None,
+                 wire_format: Optional[str] = None):
         super().__init__(comm, op, bucket_bytes, bucket_order)
+        if wire_format is not None and wire_format not in self.wire_formats:
+            raise ValueError(
+                f"unknown wire_format {wire_format!r}; expected one of "
+                f"{self.wire_formats}")
+        if wire_format == "f32":
+            lossy = False
+        self.wire_format = (wire_format if wire_format not in (None, "f32")
+                            else "bf16")
         self.topology = HierTopology(comm, intra=intra)
         self.cost = cost or CostModel()
         #: multi-tier cost-side description (the collective kernels
@@ -243,7 +265,8 @@ class AutoReducer(GradReducer):
                    in self.measured.items() if s == strategy]
             if pts:  # nearest measured size wins over the model
                 return min(pts)[1]
-        return self.topo_desc.estimate_us(strategy, nbytes)
+        return self.topo_desc.estimate_us(strategy, nbytes,
+                                          wire_format=self.wire_format)
 
     def choose(self, nbytes: int) -> str:
         cands = ["flat", "hierarchical"] + (
@@ -273,7 +296,7 @@ class AutoReducer(GradReducer):
                 if algo == "hierarchical" and full_tier:
                     red = self.topology.allreduce(flat)
                 elif algo == "quantized" and lossy_ok:
-                    red = quantize_allreduce(flat, va, "bf16")[0]
+                    red = quantize_allreduce(flat, va, self.wire_format)[0]
                 else:
                     red = lax.psum(flat, va)
                 off = 0
@@ -297,7 +320,7 @@ class AutoReducer(GradReducer):
             algo = self.choose(b["bytes"])
             b["algorithm"] = f"auto:{algo}"
             b["wire_bytes"] = (
-                int(b["bytes"] * WIRE_ITEMSIZE["bf16"] / 4)
+                quantized_wire_bytes(b["bytes"], self.wire_format)
                 if algo == "quantized" else b["bytes"])
             b["est_us"] = round(self._estimate(algo, b["bytes"]), 2)
         return rows

@@ -37,6 +37,11 @@ from jax import lax
 
 from chainermn_tpu.comm.xla import DEFAULT_DCN_BUCKET_BYTES, plan_buckets
 
+#: every wire format the registry understands ("f32" is the
+#: uncompressed reference wire); per-format bytes/element live in
+#: collectives/quantized.py's WIRE_ITEMSIZE (same keys)
+WIRE_FORMATS = ("f32", "bf16", "int8", "int8-block", "int4-block")
+
 
 def varying_axes(leaf, axes: Sequence[str]) -> Tuple[str, ...]:
     """The subset of ``axes`` the leaf still varies on.
@@ -72,6 +77,9 @@ class GradReducer:
     name = "base"
     #: True when :meth:`reduce` threads state (error-feedback residuals).
     stateful = False
+    #: wire formats this strategy can put on the wire; non-compressing
+    #: strategies carry the uncompressed payload dtype only
+    wire_formats = ("f32",)
 
     def __init__(self, comm, op: str = "mean",
                  bucket_bytes: Optional[int] = None,
@@ -188,6 +196,14 @@ def make_grad_reducer(spec, comm, op: str = "mean", **kwargs) -> Optional[GradRe
     or a registered strategy name (``'flat' | 'hierarchical' |
     'quantized' | 'auto'``) with ``kwargs`` forwarded to the
     constructor.
+
+    ``wire_format`` (in ``kwargs``) is the first-class compression knob
+    (:data:`WIRE_FORMATS`): ``'f32'``/``None`` keep the uncompressed
+    wire on any strategy; the narrow formats are forwarded to
+    strategies that can carry them (``quantized``, ``auto``) and
+    REFUSED on strategies whose wire is structurally f32 — a silently
+    dropped compression request would misreport every downstream byte
+    count.
     """
     if spec is None:
         return None
@@ -199,6 +215,19 @@ def make_grad_reducer(spec, comm, op: str = "mean", **kwargs) -> Optional[GradRe
         raise ValueError(
             f"unknown grad_reducer {spec!r}; registered strategies: "
             f"{sorted(REDUCERS)}") from None
+    wf = kwargs.pop("wire_format", None)
+    if wf is not None:
+        if wf not in WIRE_FORMATS:
+            raise ValueError(
+                f"unknown wire_format {wf!r}; expected one of "
+                f"{WIRE_FORMATS}")
+        if cls.wire_formats != ("f32",):
+            kwargs["wire_format"] = wf  # strategy prices/encodes it
+        elif wf != "f32":
+            raise ValueError(
+                f"strategy {spec!r} carries an uncompressed f32 wire; "
+                f"wire_format={wf!r} needs 'quantized' (fixed format) "
+                "or 'auto' (cost model may pick it)")
     return cls(comm, op=op, **kwargs)
 
 

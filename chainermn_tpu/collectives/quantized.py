@@ -1,4 +1,4 @@
-"""Quantized allreduce with error feedback.
+"""Quantized allreduce / reduce-scatter with error feedback.
 
 Reference: pure_nccl_communicator.py's ``allreduce_grad_dtype`` (fp16
 communication for fp32 parameters) is the lossy-compression end of the
@@ -6,14 +6,33 @@ communicator zoo; EQuARX (arxiv 2506.17615) shows block-scaled
 quantized allreduce inside XLA recovering near-full model quality at
 about half the communication bytes.
 
-Two wire formats:
+Wire formats (``WIRE_ITEMSIZE`` maps each to its bytes/element):
 
 * ``bf16`` — cast the (error-compensated) gradient to bfloat16 and
   psum in bf16: half the wire bytes, rounding error ~2^-8;
-* ``int8`` — per-bucket global scale ``pmax(|g|)/127``, symmetric
+* ``int8`` — per-bucket GLOBAL scale ``pmax(|g|)/127``, symmetric
   round-to-nearest, accumulate the allreduce in int32 (no overflow up
   to 2^24 ranks), dequantize with the shared scale: quarter the wire
-  bytes.
+  bytes;
+* ``int8-block`` — per-BLOCK scales (``QUANT_BLOCK`` = 256 elements,
+  ``pmax`` shared across ranks per block), int32 accumulation, fused
+  dequant: quarter the wire bytes plus one f32 scale per block
+  (~0.254x), but the scale tracks each block's own dynamic range —
+  one outlier no longer crushes the whole bucket's resolution;
+* ``int4-block`` — per-block scales with 4-bit symmetric values in
+  [-7, 7]; on storage wires (serving weight publish,
+  :func:`pack_int4`) two values pack per byte for ~0.129x; the
+  in-program collective accumulates the 4-bit codes in int32 (a sum of
+  packed nibbles is not the packed sum), so the compiled HLO carries
+  the same narrow-integer collective as int8-block with 16x coarser
+  values.
+
+The dequantize is FUSED into the reduction epilogue: the collective
+itself runs on the narrow/int tensor and the ``* scale`` lands on the
+collective's output (for reduce-scatter, on the 1/N tile with that
+tile's slice of the scales) — the compiled HLO carries a narrow-dtype
+collective, never quantize -> wide allreduce -> dequantize (pinned by
+analysis pass DL205 and tests/collectives_tests/test_hlo_structure.py).
 
 **Error feedback** (``ef=True``, the default): the quantization
 residual ``e = g' - dequant(quant(g'))`` is carried as explicit reducer
@@ -25,7 +44,12 @@ is PER-RANK state: globally it is a ``(comm.size, bucket_len)`` array
 sharded over the comm axis, threaded through the train step inside the
 optimizer state (``create_multi_node_optimizer`` wraps it;
 ``make_data_parallel_train_step`` shards it), and it rides checkpoints
-like any other optimizer-state leaf.
+like any other optimizer-state leaf. The ZeRO-1/2 flat paths thread
+the same state through :meth:`QuantizedReducer.reduce_scatter_flat_ef`
+— the residual lives in the FLAT-BUCKET frame (full padded vector per
+rank, layout identical to the gradient the rank quantizes), so it is
+indifferent to which tile the scatter hands each rank and survives the
+ZeRO tile layout and checkpoint resharding.
 
 The bucket plan is a pure function of leaf shapes/dtypes (NOT of
 varying-axis types), so the state structure is stable across traces and
@@ -53,7 +77,119 @@ from chainermn_tpu.collectives.base import (
 from chainermn_tpu.comm.xla import plan_buckets
 from chainermn_tpu.utils import match_vma
 
-WIRE_ITEMSIZE = {"bf16": 2, "int8": 1}
+#: wire bytes per element, by format ("f32" is the uncompressed
+#: reference — kept here so cost models price every format off one
+#: table). int4-block is 0.5 on a packed storage wire (pack_int4).
+WIRE_ITEMSIZE = {"f32": 4.0, "bf16": 2.0, "int8": 1.0,
+                 "int8-block": 1.0, "int4-block": 0.5}
+
+#: formats QuantizedReducer actually compresses to (f32 is 'use flat')
+QUANT_MODES = ("bf16", "int8", "int8-block", "int4-block")
+
+#: elements per scale block for the blockwise formats
+QUANT_BLOCK = 256
+
+_QMAX = {"int8": 127.0, "int8-block": 127.0, "int4-block": 7.0}
+
+
+def wire_ratio(fmt: str) -> float:
+    """Wire bytes per f32 payload byte for ``fmt``, INCLUDING the f32
+    scale sidecar of the blockwise formats (one scale per
+    ``QUANT_BLOCK`` elements = 1/256 extra). Pure arithmetic — the cost
+    models (collectives/auto.py, tuning/topology.py) price candidates
+    off this ratio."""
+    r = WIRE_ITEMSIZE[fmt] / 4.0
+    if fmt.endswith("-block"):
+        r += 1.0 / QUANT_BLOCK
+    return r
+
+
+def quantized_wire_bytes(payload_bytes: int, fmt: str) -> int:
+    """Exact wire bytes for one reduction of ``payload_bytes`` of f32
+    payload in format ``fmt`` (values + scales)."""
+    if fmt == "f32":
+        return int(payload_bytes)
+    elems = payload_bytes / 4.0
+    val = int(math.ceil(elems * WIRE_ITEMSIZE[fmt]))
+    if fmt.endswith("-block"):
+        return val + 4 * int(math.ceil(elems / QUANT_BLOCK))
+    if fmt == "int8":
+        return val + 4  # one global f32 scale
+    return val  # bf16: the scale is implicit in the exponent
+
+
+# -- int4 packing (storage wire) ----------------------------------------
+
+def pack_int4(q):
+    """Pack int values in [-8, 7] two per byte (low nibble first; odd
+    lengths pad a zero nibble). Exact round-trip with
+    :func:`unpack_int4` on every representable value — the serving
+    weight plane and any host-side wire use this as the 0.5 B/elem
+    storage format."""
+    q = jnp.asarray(q).astype(jnp.int32).reshape(-1)
+    if q.size % 2:
+        q = jnp.concatenate([q, jnp.zeros((1,), q.dtype)])
+    lo = q[0::2] & 0xF
+    hi = q[1::2] & 0xF
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_int4(packed, n: int):
+    """Inverse of :func:`pack_int4`: ``n`` sign-extended int32 values
+    from the packed bytes."""
+    p = jnp.asarray(packed).astype(jnp.int32).reshape(-1)
+    lo = p & 0xF
+    hi = (p >> 4) & 0xF
+    both = jnp.stack([lo, hi], axis=-1).reshape(-1)
+    both = jnp.where(both >= 8, both - 16, both)
+    return both[:n]
+
+
+# -- blockwise codec ----------------------------------------------------
+
+def _block_scale(b, qmax: float, axes=None):
+    """Per-block symmetric scale for a ``(nblocks, block)`` array; with
+    ``axes``, the scale is pmax-shared across ranks so every rank
+    quantizes onto the same grid (the precondition for integer
+    accumulation)."""
+    amax = jnp.max(jnp.abs(b), axis=1)
+    if axes is not None:
+        amax = lax.pmax(amax, axes)
+    return jnp.where(amax > 0, amax / qmax, 1.0).astype(b.dtype)
+
+
+def block_quantize(v, mode: str = "int8-block", block: int = QUANT_BLOCK):
+    """Blockwise-quantize a flat float vector. Returns ``(q, scale)``:
+    ``q`` is int8 codes (``int8-block``) or packed uint8 two-per-byte
+    (``int4-block``); ``scale`` is one f32-ish scale per block (the
+    input's dtype). Host- and device-safe; the serving weight plane
+    reuses exactly this codec (manifest-recorded scales)."""
+    if mode not in ("int8-block", "int4-block"):
+        raise ValueError(f"block_quantize: unknown mode {mode!r}")
+    qmax = _QMAX[mode]
+    v = jnp.asarray(v).reshape(-1)
+    pad = (-v.size) % block
+    vp = jnp.concatenate([v, jnp.zeros((pad,), v.dtype)]) if pad else v
+    b = vp.reshape(-1, block)
+    scale = _block_scale(b, qmax)
+    q = jnp.clip(jnp.round(b / scale[:, None]), -qmax, qmax)
+    q = q.reshape(-1).astype(jnp.int8)
+    if mode == "int4-block":
+        return pack_int4(q), scale
+    return q, scale
+
+
+def block_dequantize(q, scale, size: int, mode: str = "int8-block",
+                     dtype=jnp.float32, block: int = QUANT_BLOCK):
+    """Inverse of :func:`block_quantize` (``size`` = original length)."""
+    if mode == "int4-block":
+        codes = unpack_int4(q, size + ((-size) % block))
+    else:
+        codes = jnp.asarray(q).astype(jnp.int32).reshape(-1)
+    scale = jnp.asarray(scale)
+    out = (codes.reshape(-1, block).astype(dtype)
+           * scale[:, None].astype(dtype)).reshape(-1)
+    return out[:size]
 
 
 def quantize_allreduce(v, axes, mode: str):
@@ -61,7 +197,9 @@ def quantize_allreduce(v, axes, mode: str):
 
     Returns ``(reduced_sum, local_dequant)`` — the second output is this
     rank's dequantized contribution, which error feedback subtracts from
-    the pre-quantization value to form the residual.
+    the pre-quantization value to form the residual. The dequantize is
+    fused onto the collective output (narrow-dtype collective in the
+    compiled HLO — DL205).
     """
     dt = v.dtype
     if mode == "bf16":
@@ -72,31 +210,56 @@ def quantize_allreduce(v, axes, mode: str):
         scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(dt)
         q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int32)
         return lax.psum(q, axes).astype(dt) * scale, q.astype(dt) * scale
+    if mode in ("int8-block", "int4-block"):
+        qmax = _QMAX[mode]
+        pad = (-v.size) % QUANT_BLOCK
+        vp = (jnp.concatenate([v, jnp.zeros((pad,), dt)]) if pad else v)
+        b = vp.reshape(-1, QUANT_BLOCK)
+        scale = _block_scale(b, qmax, axes)
+        q = jnp.clip(jnp.round(b / scale[:, None]),
+                     -qmax, qmax).astype(jnp.int32)
+        red = lax.psum(q, axes)  # s32 on the wire (narrow — DL205)
+        deq = (red.astype(dt) * scale[:, None]).reshape(-1)
+        loc = (q.astype(dt) * scale[:, None]).reshape(-1)
+        return deq[:v.size], loc[:v.size]
     raise ValueError(f"unknown quantization mode {mode!r}")
 
 
 class QuantizedReducer(GradReducer):
-    """Per-bucket scaled quantized allreduce with error feedback.
+    """Scaled quantized allreduce / reduce-scatter with error feedback.
 
-    Args (beyond the base): ``mode`` — ``'bf16'`` (default) or
-    ``'int8'``; ``ef`` — carry error-feedback residuals (default True;
-    ``ef=False`` is stateless — usable in the ZeRO reduce-scatter paths,
+    Args (beyond the base): ``mode`` (alias ``wire_format``) — one of
+    :data:`QUANT_MODES` (``'bf16'`` default); ``ef`` — carry
+    error-feedback residuals (default True; ``ef=False`` is stateless
     and the degraded baseline the convergence tests compare against).
+    Stateful operation works in the DP path (residuals ride
+    ``_ReducerWrappedState``) AND the ZeRO-1/2 flat paths
+    (:meth:`reduce_scatter_flat_ef` — the ZeRO step factories thread
+    the residual automatically).
     """
 
     name = "quantized"
+    wire_formats = QUANT_MODES
 
     def __init__(self, comm, op: str = "mean",
                  bucket_bytes: Optional[int] = None,
                  mode: str = "bf16", ef: bool = True,
-                 bucket_order: str = "emission"):
+                 bucket_order: str = "emission",
+                 wire_format: Optional[str] = None):
         # bucket_order intentionally NOT forwarded to _plan: the EF
         # residual layout is pinned to the dtype-grouped pytree-order
         # plan (checkpoints depend on it) — accepted for signature
         # parity, validated by the base
         super().__init__(comm, op, bucket_bytes, bucket_order)
-        if mode not in WIRE_ITEMSIZE:
-            raise ValueError(f"unknown quantization mode {mode!r}")
+        if wire_format is not None:
+            if wire_format == "f32":
+                raise ValueError(
+                    "wire_format='f32' is the uncompressed wire — use "
+                    "the 'flat' strategy instead of QuantizedReducer")
+            mode = wire_format
+        if mode not in QUANT_MODES:
+            raise ValueError(f"unknown quantization mode {mode!r}; "
+                             f"expected one of {QUANT_MODES}")
         self.mode = mode
         self.ef = ef
         self.stateful = bool(ef)
@@ -188,29 +351,71 @@ class QuantizedReducer(GradReducer):
         return (jax.tree_util.tree_unflatten(treedef, out),
                 tuple(new_state) if self.ef else state)
 
+    # -- ZeRO flat-vector hooks -----------------------------------------
+    def _quantize_scatter(self, v, ax: str, n: int):
+        """Quantized sum-reduce-scatter of one flat vector (length a
+        multiple of ``n``): the collective runs on the narrow/int tensor
+        and the dequant lands on the scattered tile with that tile's
+        slice of the scales. Returns ``(tile_sum, local_dequant)`` —
+        ``local_dequant`` is full-length (this rank's dequantized
+        contribution, the error-feedback subtrahend)."""
+        dt = v.dtype
+        if self.mode == "bf16":
+            q = v.astype(jnp.bfloat16)
+            s = lax.psum_scatter(q, ax, tiled=True)
+            return s.astype(dt), q.astype(dt)
+        if self.mode == "int8":
+            amax = lax.pmax(jnp.max(jnp.abs(v)), ax)
+            scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(dt)
+            q = jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int32)
+            s = lax.psum_scatter(q, ax, tiled=True)
+            return s.astype(dt) * scale, q.astype(dt) * scale
+        # blockwise: the block must divide the tile so no scale block
+        # straddles a tile boundary. ZeRO's padding quantum (256, zero.py
+        # _padded_size) makes tiles multiples of QUANT_BLOCK/n, so the
+        # gcd stays >= 256/n on any axis size dividing 256.
+        qmax = _QMAX[self.mode]
+        tile = v.size // n
+        blk = math.gcd(QUANT_BLOCK, tile) or 1
+        b = v.reshape(-1, blk)
+        scale = _block_scale(b, qmax, ax)
+        q = jnp.clip(jnp.round(b / scale[:, None]),
+                     -qmax, qmax).astype(jnp.int32)
+        s = lax.psum_scatter(q.reshape(-1), ax, tiled=True)  # s32 wire
+        tb = tile // blk
+        ts = lax.dynamic_slice_in_dim(scale, lax.axis_index(ax) * tb, tb)
+        tile_sum = (s.reshape(tb, blk).astype(dt)
+                    * ts[:, None]).reshape(-1)
+        local = (q.astype(dt) * scale[:, None]).reshape(-1)
+        return tile_sum, local
+
     def reduce_scatter_flat(self, g, ax: str, n: int):
         if self.ef:
             raise RuntimeError(
-                "QuantizedReducer(ef=True) carries per-rank residual "
-                "state, which the ZeRO flat-vector paths cannot thread; "
-                "use ef=False here, or the data-parallel step "
-                "(make_data_parallel_train_step) for error feedback")
-        dt = g.dtype
-        if self.mode == "bf16":
-            s = lax.psum_scatter(g.astype(jnp.bfloat16), ax, tiled=True)
-            return s.astype(dt) / n
-        amax = lax.pmax(jnp.max(jnp.abs(g)), ax)
-        scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(dt)
-        q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int32)
-        return lax.psum_scatter(q, ax, tiled=True).astype(dt) * scale / n
+                "QuantizedReducer(ef=True) threads per-rank residual "
+                "state through reduce_scatter_flat_ef — the ZeRO step "
+                "factories do this automatically; call "
+                "reduce_scatter_flat only on stateless (ef=False) "
+                "reducers")
+        tile_sum, _ = self._quantize_scatter(g, ax, n)
+        return tile_sum / n
+
+    def reduce_scatter_flat_ef(self, g, e, ax: str, n: int):
+        """Error-feedback mean-reduce-scatter: ``e`` is this rank's
+        residual in the FLAT-BUCKET frame (full padded vector — the
+        frame the rank quantizes in, independent of which tile the
+        scatter hands it, so the state survives the ZeRO tile layout
+        and resharding). Returns ``(tile_mean, new_residual)``."""
+        v = g + e
+        tile_sum, local = self._quantize_scatter(v, ax, n)
+        return tile_sum / n, v - local
 
     def wire_bytes(self, payload_bytes: int) -> int:
         # payload is in the leaf dtype (4 B f32 typical); the wire
-        # carries the quantized format (+ nothing for bf16's implicit
-        # scale, + one f32 scale per bucket for int8)
-        ratio = WIRE_ITEMSIZE[self.mode] / 4.0
-        extra = 4 if self.mode == "int8" else 0
-        return int(payload_bytes * ratio) + extra
+        # carries the quantized values plus the f32 scales (one per
+        # bucket for int8, one per QUANT_BLOCK elements for the block
+        # formats; bf16's scale is implicit in the exponent)
+        return quantized_wire_bytes(payload_bytes, self.mode)
 
 
 register_reducer("quantized", QuantizedReducer)

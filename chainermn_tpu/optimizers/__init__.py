@@ -81,6 +81,7 @@ def create_multi_node_optimizer(
     grad_reducer: Any = None,
     tune: Any = None,
     model_key: Optional[str] = None,
+    wire_format: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with the gradient all-reduce.
 
@@ -119,6 +120,14 @@ def create_multi_node_optimizer(
     plan). A plan whose topology fingerprint does not match this
     communicator's mesh raises ``ValueError`` — the wrong-machine
     profile bug dlint DL107 flags statically.
+
+    ``wire_format`` selects the compressed wire
+    (docs/collectives.md#quantized-wire-formats): ``'bf16' | 'int8' |
+    'int8-block' | 'int4-block'`` are forwarded to the reducer being
+    built (from a name or a tuned plan); an explicit value overrides a
+    tuned plan's recorded format. ``'f32'``/``None`` keep the strategy's
+    own default. Refused (ValueError) when the resolved strategy cannot
+    compress — same rule as ``make_grad_reducer``.
     """
     from chainermn_tpu.collectives import make_grad_reducer
 
@@ -147,13 +156,24 @@ def create_multi_node_optimizer(
                 "silently mis-tune (dlint DL107); re-run "
                 "tools/schedtune.py here")
         if grad_reducer is None:
+            wf = wire_format or getattr(plan, "wire_format", None)
             grad_reducer = make_grad_reducer(
                 plan.strategy, communicator, op=op,
                 bucket_bytes=plan.bucket_bytes,
-                bucket_order=plan.bucket_order)
+                bucket_order=plan.bucket_order,
+                wire_format=wf)
         double_buffering = bool(double_buffering or plan.double_buffering)
 
-    reducer = make_grad_reducer(grad_reducer, communicator, op=op)
+    if isinstance(grad_reducer, str):
+        reducer = make_grad_reducer(grad_reducer, communicator, op=op,
+                                    wire_format=wire_format)
+    else:
+        if wire_format not in (None, "f32") and grad_reducer is None:
+            raise ValueError(
+                f"wire_format={wire_format!r} needs a compressing "
+                "grad_reducer ('quantized' or 'auto'); the default flat "
+                "psum carries f32")
+        reducer = make_grad_reducer(grad_reducer, communicator, op=op)
     stateful = bool(reducer is not None and reducer.stateful)
 
     if reducer is None:

@@ -24,6 +24,7 @@ ResNet-50 drop from 2x model size per chip to 2x/N.
 
 from __future__ import annotations
 
+import math
 import warnings
 from typing import Callable, Optional, Tuple
 
@@ -36,31 +37,40 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import PartitionSpec as P
 
 
-def _resolve_rs(grad_reducer, comm) -> Optional[Callable]:
-    """Resolve a ``grad_reducer=`` argument into the flat-vector
-    mean-reduce-scatter callable the ZeRO steps use, or ``None`` for the
-    legacy inline ``psum_scatter / n`` (bit-identical default).
+def _resolve_rs(grad_reducer, comm) -> Tuple[Optional[Callable], Optional[object]]:
+    """Resolve a ``grad_reducer=`` argument for the ZeRO flat paths.
 
-    Only STATELESS reducers fit here: the ZeRO flat-vector paths cannot
-    thread per-rank residual state (use ``QuantizedReducer(ef=False)``,
-    or the data-parallel step for error feedback). Every strategy must
-    preserve the tile-``r``-to-rank-``r`` scatter layout — the sharded
-    optimizer state depends on it (``GradReducer.reduce_scatter_flat``).
+    Returns ``(rs, ef_reducer)``: ``rs`` is the stateless flat-vector
+    mean-reduce-scatter callable (or ``None`` for the legacy inline
+    ``psum_scatter / n`` — bit-identical default); ``ef_reducer`` is
+    non-None when the reducer is STATEFUL (error feedback), in which
+    case ``rs`` is ``None`` and the step factories thread the per-rank
+    residual through ``reduce_scatter_flat_ef`` — the residual lives in
+    the flat-bucket frame (full padded vector per rank), rides the
+    optimizer state as ``_ReducerWrappedState`` exactly as in the DP
+    path, and is sharded ``P(ax)`` on its stacked leading axis.
+
+    Every strategy must preserve the tile-``r``-to-rank-``r`` scatter
+    layout — the sharded optimizer state depends on it
+    (``GradReducer.reduce_scatter_flat``).
     """
     from chainermn_tpu.collectives import make_grad_reducer
 
     reducer = make_grad_reducer(grad_reducer, comm, op="mean")
     if reducer is None:
-        return None
+        return None, None
     if reducer.stateful:
-        raise ValueError(
-            f"grad_reducer {reducer.name!r} is stateful (error-feedback "
-            "residuals are per-rank state the ZeRO flat-vector paths "
-            "cannot thread); pass QuantizedReducer(ef=False) here, or "
-            "use make_data_parallel_train_step for error feedback")
+        if not hasattr(reducer, "reduce_scatter_flat_ef"):
+            raise ValueError(
+                f"grad_reducer {reducer.name!r} is stateful but "
+                "implements no reduce_scatter_flat_ef — the ZeRO flat "
+                "paths cannot thread its per-rank state; pass a "
+                "stateless reducer here, or use "
+                "make_data_parallel_train_step")
+        return None, reducer
     ax = comm.axis_name
     n = comm.size
-    return lambda g: reducer.reduce_scatter_flat(g, ax, n)
+    return (lambda g: reducer.reduce_scatter_flat(g, ax, n)), None
 
 
 def _require_elementwise(optimizer, params) -> None:
@@ -323,7 +333,13 @@ def make_zero1_train_step(
 
     ``grad_reducer``: reduction strategy for the gradient reduce-scatter
     (docs/collectives.md). Default ``None`` is today's flat
-    ``psum_scatter`` — bit-identical. Stateless strategies only (see
+    ``psum_scatter`` — bit-identical. A STATEFUL reducer (quantized
+    with error feedback) wraps the optimizer state in
+    ``_ReducerWrappedState`` exactly as the DP path does: the per-rank
+    residual lives in the flat-bucket frame (one full padded vector per
+    rank — the frame the rank quantizes in, indifferent to the tile
+    layout), globally stacked ``(n, padded)`` and sharded ``P(ax)``,
+    riding checkpoints like any other optimizer-state leaf (see
     :func:`_resolve_rs`).
     """
     from chainermn_tpu.training.step import classifier_loss
@@ -335,13 +351,16 @@ def make_zero1_train_step(
     n = comm.size
     axes = comm.axis_names
     dspec = P(ax)
-    rs = (_resolve_rs(grad_reducer, comm)
-          # dlint: disable=DL106 — this IS the reducer plumbing
-          or (lambda g: lax.psum_scatter(g, ax, tiled=True) / n))
+    rs, ef_reducer = _resolve_rs(grad_reducer, comm)
+    if rs is None and ef_reducer is None:
+        # dlint: disable=DL106 — this IS the reducer plumbing
+        rs = lambda g: lax.psum_scatter(g, ax, tiled=True) / n
 
     if bucket_bytes is not None:
         return _make_zero1_bucketed(model, optimizer, comm, params, lf,
-                                    donate, bucket_bytes, rs)
+                                    donate, bucket_bytes, rs, ef_reducer)
+
+    from chainermn_tpu.optimizers import _ReducerWrappedState
 
     flat, unravel = ravel_pytree(params)
     total = flat.size
@@ -357,12 +376,18 @@ def make_zero1_train_step(
         i = lax.axis_index(ax)
         shard = lax.dynamic_slice_in_dim(v, i * shard_shape[0],
                                          shard_shape[0])
-        return shard, optimizer.init(shard)
+        opt = optimizer.init(shard)
+        if ef_reducer is not None:
+            opt = _ReducerWrappedState(
+                opt, (jnp.zeros((1, padded), v.dtype),))
+        return shard, opt
 
     abs_opt = jax.eval_shape(
         optimizer.init, jax.ShapeDtypeStruct(shard_shape, flat.dtype))
     opt_specs = jax.tree_util.tree_map(
         lambda l: P(ax) if l.shape == shard_shape else P(), abs_opt)
+    if ef_reducer is not None:
+        opt_specs = _ReducerWrappedState(opt_specs, (P(ax),))
 
     state = jax.jit(shard_map(
         init_fn, mesh=mesh, in_specs=(P(),),
@@ -388,8 +413,17 @@ def make_zero1_train_step(
         g = ravel_pytree(grads)[0]
         if padded != total:
             g = jnp.concatenate([g, jnp.zeros((padded - total,), g.dtype)])
-        g_shard = rs(g)
-        updates, opt_state = optimizer.update(g_shard, opt_state, p_shard)
+        if ef_reducer is not None:
+            e = opt_state.reducer[0][0]  # this rank's residual, (padded,)
+            g_shard, e_new = ef_reducer.reduce_scatter_flat_ef(
+                g, e, ax, n)
+            updates, inner = optimizer.update(g_shard, opt_state.inner,
+                                              p_shard)
+            opt_state = _ReducerWrappedState(inner, (e_new[None],))
+        else:
+            g_shard = rs(g)
+            updates, opt_state = optimizer.update(g_shard, opt_state,
+                                                  p_shard)
         p_shard = optax.apply_updates(p_shard, updates)
         metrics = {
             "main/loss": lax.pmean(loss, axes),
@@ -408,11 +442,18 @@ def make_zero1_train_step(
     return step, state
 
 
-def _bucketed_init(optimizer, comm, params, bucket_bytes):
+def _bucketed_init(optimizer, comm, params, bucket_bytes,
+                   ef_reducer=None):
     """Shared bucketed-state construction for ZeRO-1 and ZeRO-2: the
     layout, per-bucket P(ax) specs, opt-state specs, and the initial
     (tuple-of-shards, opt_state) — one definition so the two steps can
-    never diverge on state layout."""
+    never diverge on state layout. With a stateful (error-feedback)
+    reducer the opt state is wrapped in ``_ReducerWrappedState`` whose
+    ``reducer`` field holds one per-rank residual PER BUCKET, each in
+    that bucket's padded flat frame, stacked ``(n, padded_b)`` and
+    sharded ``P(ax)``."""
+    from chainermn_tpu.optimizers import _ReducerWrappedState
+
     mesh = comm.mesh
     ax = comm.axis_name
     n = comm.size
@@ -427,7 +468,12 @@ def _bucketed_init(optimizer, comm, params, bucket_bytes):
             for v, ln in zip(layout.pack_buckets(params),
                              layout.shard_lens)
         )
-        return shards, optimizer.init(shards)
+        opt = optimizer.init(shards)
+        if ef_reducer is not None:
+            opt = _ReducerWrappedState(opt, tuple(
+                jnp.zeros((1, pb), layout.dtype)
+                for pb in layout.padded))
+        return shards, opt
 
     abs_shards = tuple(
         jax.ShapeDtypeStruct((ln,), layout.dtype)
@@ -435,6 +481,9 @@ def _bucketed_init(optimizer, comm, params, bucket_bytes):
     abs_opt = jax.eval_shape(optimizer.init, abs_shards)
     opt_specs = jax.tree_util.tree_map(
         lambda l: P(ax) if l.shape in shard_shapes else P(), abs_opt)
+    if ef_reducer is not None:
+        opt_specs = _ReducerWrappedState(
+            opt_specs, tuple(P(ax) for _ in layout.padded))
     shard_specs = tuple(P(ax) for _ in layout.buckets)
 
     state = jax.jit(shard_map(
@@ -445,7 +494,7 @@ def _bucketed_init(optimizer, comm, params, bucket_bytes):
 
 
 def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
-                         bucket_bytes, rs):
+                         bucket_bytes, rs, ef_reducer=None):
     """Bucketed ZeRO-1 (see ``make_zero1_train_step(bucket_bytes=...)``).
 
     Per step, per bucket: ``psum_scatter`` the bucket's padded gradient
@@ -457,6 +506,8 @@ def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
     late-layer buckets' collectives while early layers are still in
     backward (tests/comm_tests/test_overlap_schedule.py asserts the
     schedule interleaving for the DP path)."""
+    from chainermn_tpu.optimizers import _ReducerWrappedState
+
     mesh = comm.mesh
     ax = comm.axis_name
     n = comm.size
@@ -464,7 +515,7 @@ def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
     dspec = P(ax)
 
     layout, shard_specs, opt_specs, state = _bucketed_init(
-        optimizer, comm, params, bucket_bytes)
+        optimizer, comm, params, bucket_bytes, ef_reducer)
 
     def local_step(state, x, y):
         p_shards, opt_state = state
@@ -476,9 +527,20 @@ def _make_zero1_bucketed(model, optimizer, comm, params, lf, donate,
             return loss, acc
 
         (loss, acc), grads = jax.value_and_grad(f, has_aux=True)(p)
-        g_shards = tuple(rs(g) for g in layout.pack_buckets(grads))
-        updates, opt_state = optimizer.update(g_shards, opt_state,
+        if ef_reducer is not None:
+            pairs = [
+                ef_reducer.reduce_scatter_flat_ef(g, e[0], ax, n)
+                for g, e in zip(layout.pack_buckets(grads),
+                                opt_state.reducer)]
+            g_shards = tuple(gs for gs, _ in pairs)
+            updates, inner = optimizer.update(g_shards, opt_state.inner,
                                               p_shards)
+            opt_state = _ReducerWrappedState(
+                inner, tuple(e_new[None] for _, e_new in pairs))
+        else:
+            g_shards = tuple(rs(g) for g in layout.pack_buckets(grads))
+            updates, opt_state = optimizer.update(g_shards, opt_state,
+                                                  p_shards)
         p_shards = optax.apply_updates(p_shards, updates)
         metrics = {
             "main/loss": lax.pmean(loss, axes),
@@ -545,9 +607,12 @@ def make_zero2_train_step(
     axes = comm.axis_names
     dspec = P(ax)
     m = n_microbatches
-    rs = (_resolve_rs(grad_reducer, comm)
-          # dlint: disable=DL106 — this IS the reducer plumbing
-          or (lambda g: lax.psum_scatter(g, ax, tiled=True) / n))
+    rs, ef_reducer = _resolve_rs(grad_reducer, comm)
+    if rs is None and ef_reducer is None:
+        # dlint: disable=DL106 — this IS the reducer plumbing
+        rs = lambda g: lax.psum_scatter(g, ax, tiled=True) / n
+
+    from chainermn_tpu.optimizers import _ReducerWrappedState
 
     flat, unravel = ravel_pytree(params)
     total = flat.size
@@ -562,12 +627,18 @@ def make_zero2_train_step(
         i = lax.axis_index(ax)
         shard = lax.dynamic_slice_in_dim(v, i * shard_shape[0],
                                          shard_shape[0])
-        return shard, optimizer.init(shard)
+        opt = optimizer.init(shard)
+        if ef_reducer is not None:
+            opt = _ReducerWrappedState(
+                opt, (jnp.zeros((1, padded), v.dtype),))
+        return shard, opt
 
     abs_opt = jax.eval_shape(
         optimizer.init, jax.ShapeDtypeStruct(shard_shape, flat.dtype))
     opt_specs = jax.tree_util.tree_map(
         lambda l: P(ax) if l.shape == shard_shape else P(), abs_opt)
+    if ef_reducer is not None:
+        opt_specs = _ReducerWrappedState(opt_specs, (P(ax),))
 
     state = jax.jit(shard_map(
         init_fn, mesh=mesh, in_specs=(P(),),
@@ -586,7 +657,9 @@ def make_zero2_train_step(
         ym = y.reshape((m, bl // m) + y.shape[1:])
 
         def micro(carry, xy):
-            acc, loss_a, acc_a = carry
+            # error feedback applies PER SCATTER: each microbatch's
+            # residual feeds the next microbatch's quantization
+            acc, e, loss_a, acc_a = carry
             xi, yi = xy
 
             def f(p):
@@ -599,17 +672,29 @@ def make_zero2_train_step(
                 g = jnp.concatenate(
                     [g, jnp.zeros((padded - total,), g.dtype)])
             # the full-size g dies here; only the 1/N shard accumulates
-            acc = acc + rs(g)
-            return (acc, loss_a + loss, acc_a + a), None
+            if ef_reducer is not None:
+                tile, e = ef_reducer.reduce_scatter_flat_ef(g, e, ax, n)
+                acc = acc + tile
+            else:
+                acc = acc + rs(g)
+            return (acc, e, loss_a + loss, acc_a + a), None
 
         from chainermn_tpu.utils import match_vma
 
         acc0 = match_vma(jnp.zeros(shard_shape, flat.dtype), p_shard)
         z = match_vma(jnp.zeros(()), full)
-        (g_shard, loss_sum, acc_sum), _ = lax.scan(
-            micro, (acc0, z, z), (xm, ym))
+        e0 = (opt_state.reducer[0][0] if ef_reducer is not None
+              else match_vma(jnp.zeros((0,), flat.dtype), p_shard))
+        (g_shard, e_fin, loss_sum, acc_sum), _ = lax.scan(
+            micro, (acc0, e0, z, z), (xm, ym))
         g_shard = g_shard / m
-        updates, opt_state = optimizer.update(g_shard, opt_state, p_shard)
+        if ef_reducer is not None:
+            updates, inner = optimizer.update(g_shard, opt_state.inner,
+                                              p_shard)
+            opt_state = _ReducerWrappedState(inner, (e_fin[None],))
+        else:
+            updates, opt_state = optimizer.update(g_shard, opt_state,
+                                                  p_shard)
         p_shard = optax.apply_updates(p_shard, updates)
         metrics = {
             "main/loss": lax.pmean(loss_sum / m, axes),
@@ -641,12 +726,15 @@ def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
     axes = comm.axis_names
     dspec = P(ax)
     m = n_microbatches
-    rs = (_resolve_rs(grad_reducer, comm)
-          # dlint: disable=DL106 — this IS the reducer plumbing
-          or (lambda g: lax.psum_scatter(g, ax, tiled=True) / n))
+    rs, ef_reducer = _resolve_rs(grad_reducer, comm)
+    if rs is None and ef_reducer is None:
+        # dlint: disable=DL106 — this IS the reducer plumbing
+        rs = lambda g: lax.psum_scatter(g, ax, tiled=True) / n
+
+    from chainermn_tpu.optimizers import _ReducerWrappedState
 
     layout, shard_specs, opt_specs, state = _bucketed_init(
-        optimizer, comm, params, bucket_bytes)
+        optimizer, comm, params, bucket_bytes, ef_reducer)
 
     def local_step(state, x, y):
         p_shards, opt_state = state
@@ -660,7 +748,9 @@ def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
         ym = y.reshape((m, bl // m) + y.shape[1:])
 
         def micro(carry, xy):
-            accs, loss_a, acc_a = carry
+            # error feedback applies PER SCATTER: each bucket keeps its
+            # own residual, updated every microbatch
+            accs, es, loss_a, acc_a = carry
             xi, yi = xy
 
             def f(p):
@@ -670,20 +760,35 @@ def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
             (loss, a), grads = jax.value_and_grad(f, has_aux=True)(p)
             # each full-size BUCKET dies right here; only 1/N shards
             # persist across the accumulation window
-            accs = tuple(
-                acc + rs(g)
-                for acc, g in zip(accs, layout.pack_buckets(grads)))
-            return (accs, loss_a + loss, acc_a + a), None
+            if ef_reducer is not None:
+                pairs = [
+                    ef_reducer.reduce_scatter_flat_ef(g, e, ax, n)
+                    for g, e in zip(layout.pack_buckets(grads), es)]
+                accs = tuple(acc + t for acc, (t, _) in zip(accs, pairs))
+                es = tuple(e for _, e in pairs)
+            else:
+                accs = tuple(
+                    acc + rs(g)
+                    for acc, g in zip(accs, layout.pack_buckets(grads)))
+            return (accs, es, loss_a + loss, acc_a + a), None
 
         accs0 = tuple(
             _mv(jnp.zeros((ln,), layout.dtype), s)
             for ln, s in zip(layout.shard_lens, p_shards))
         z = _mv(jnp.zeros(()), fulls[0])
-        (g_shards, loss_sum, acc_sum), _ = lax.scan(
-            micro, (accs0, z, z), (xm, ym))
+        es0 = (tuple(e[0] for e in opt_state.reducer)
+               if ef_reducer is not None else ())
+        (g_shards, es_fin, loss_sum, acc_sum), _ = lax.scan(
+            micro, (accs0, es0, z, z), (xm, ym))
         g_shards = tuple(g / m for g in g_shards)
-        updates, opt_state = optimizer.update(g_shards, opt_state,
+        if ef_reducer is not None:
+            updates, inner = optimizer.update(g_shards, opt_state.inner,
                                               p_shards)
+            opt_state = _ReducerWrappedState(
+                inner, tuple(e[None] for e in es_fin))
+        else:
+            updates, opt_state = optimizer.update(g_shards, opt_state,
+                                                  p_shards)
         p_shards = optax.apply_updates(p_shards, updates)
         metrics = {
             "main/loss": lax.pmean(loss_sum / m, axes),
@@ -865,6 +970,7 @@ def make_fsdp_train_step(
     remat=False,
     param_shardings=None,
     grad_reducer=None,
+    param_wire: Optional[str] = None,
 ) -> Tuple[Callable, Tuple]:
     """ZeRO-3 (FSDP) data-parallel train step: parameters AND optimizer
     state live sharded over the data axis; every use gathers just-in-time.
@@ -913,6 +1019,16 @@ def make_fsdp_train_step(
     transform equals the per-rank wire compression). Stateful reducers
     (error feedback) raise — use ``make_data_parallel_train_step``.
 
+    ``param_wire``: compress the parameter ALL-GATHER the same way —
+    ``'bf16' | 'int8-block' | 'int4-block'`` quantize each sharded leaf
+    blockwise, constrain the narrow codes (plus the f32 scale sidecar)
+    replicated so the partitioner's gather moves the narrow dtype, and
+    dequantize at the consumer (XLA fuses it — DL205 sees a narrow
+    all-gather). The optimizer still updates master-f32 shards; the
+    backward is a straight-through estimator (round() has zero
+    gradient), so gradients flow as if the wire were exact. ``'f32'`` /
+    ``None`` keep today's uncompressed gather.
+
     Returns ``(step, state)`` with ``state = (params, opt_state)`` sharded;
     use :func:`fsdp_gather_params` to re-assemble for export. Models with
     mutable collections (BN stats) should use
@@ -937,6 +1053,19 @@ def make_fsdp_train_step(
             "make_data_parallel_train_step for error feedback.")
     quant_mode = getattr(reducer, "mode", None) if (
         reducer is not None and reducer.name == "quantized") else None
+
+    from chainermn_tpu.collectives.quantized import (
+        QUANT_BLOCK, QUANT_MODES, block_dequantize, block_quantize)
+
+    if param_wire == "f32":
+        param_wire = None
+    if param_wire is not None and param_wire not in QUANT_MODES:
+        raise ValueError(
+            f"unknown param_wire {param_wire!r}; expected one of "
+            f"{('f32',) + QUANT_MODES}")
+    if param_wire == "int8":
+        param_wire = "int8-block"  # single-scale int8 gather has no
+        # per-tensor accumulation to protect; blockwise strictly better
 
     if param_shardings is None:
         stacked_at = _find_stacked_subtree(params, comm.size)
@@ -999,7 +1128,55 @@ def make_fsdp_train_step(
     dsh = NamedSharding(mesh, P(ax))
     repl = NamedSharding(mesh, P())
 
+    def _gather_deq(v, p_spec, k):
+        # quantize THIS RANK'S shard, all-gather the narrow codes (plus
+        # the f32 scale sidecar), dequantize every shard and reassemble
+        # — an explicit shard_map, because a replicated-output sharding
+        # constraint only pins layout, not where the quantize computes:
+        # GSPMD is free to (and measured: does) gather f32 first
+        n = comm.size
+
+        def local(vs):
+            shp = vs.shape
+            if param_wire == "bf16":
+                parts = lax.all_gather(
+                    vs.astype(jnp.bfloat16), ax).astype(v.dtype)
+            else:
+                flat = vs.reshape(-1)
+                blk = math.gcd(QUANT_BLOCK, flat.size) or 1
+                q, s = block_quantize(flat, param_wire, blk)
+                qg = lax.all_gather(q, ax)
+                sg = lax.all_gather(s, ax)
+                parts = jax.vmap(
+                    lambda qq, ss: block_dequantize(
+                        qq, ss, flat.size, param_wire, v.dtype,
+                        blk).reshape(shp))(qg, sg)
+            return jnp.concatenate([parts[i] for i in range(n)], axis=k)
+
+        return shard_map(local, mesh=mesh, in_specs=(p_spec,),
+                         out_specs=P(), check_vma=False)(v)
+
+    def _param_wire_leaf(v, sharding):
+        # forward sees the dequantized wire value; backward is the
+        # identity onto the master-f32 shard (straight-through — the
+        # quantizer's round() has zero gradient everywhere anyway)
+        p_spec = sharding.spec
+        if (not jnp.issubdtype(v.dtype, jnp.floating)
+                or ax not in tuple(p_spec)):
+            return v  # replicated leaf: nothing travels on the gather
+        k = tuple(p_spec).index(ax)
+
+        @jax.custom_vjp
+        def gather(u):
+            return _gather_deq(u, p_spec, k)
+
+        gather.defvjp(lambda u: (_gather_deq(u, p_spec, k), None),
+                      lambda _, g: (g,))
+        return gather(v)
+
     def f(p, x, y):
+        if param_wire is not None:
+            p = jax.tree_util.tree_map(_param_wire_leaf, p, pshard)
         loss, (acc, _) = lf(model, p, x, y, train=True)
         return loss, acc
 
@@ -1014,6 +1191,10 @@ def make_fsdp_train_step(
             return g
         if quant_mode == "bf16":
             return g.astype(jnp.bfloat16).astype(g.dtype)
+        if quant_mode in ("int8-block", "int4-block"):
+            q, s = block_quantize(g.reshape(-1), quant_mode)
+            return block_dequantize(
+                q, s, g.size, quant_mode, g.dtype).reshape(g.shape)
         amax = jnp.max(jnp.abs(g))
         scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(g.dtype)
         q = jnp.clip(jnp.round(g / scale), -127, 127)

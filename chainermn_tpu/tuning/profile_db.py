@@ -74,6 +74,10 @@ class SchedulePlan:
     bucket_bytes: int
     bucket_order: str = "emission"
     double_buffering: bool = False
+    #: what the quantized wire carries ('f32' = uncompressed; older DB
+    #: records omit the key and from_dict's unknown-key filter keeps
+    #: them loading with this default)
+    wire_format: str = "f32"
     overlap_fraction: float = 0.0
     est_exposed_us: float = 0.0
     #: 'canned' (emulated schedule), 'aot' (real compiled HLO), or

@@ -34,8 +34,22 @@ ICI_LATENCY_US = 1.0
 ICI_BW_GBPS = 100.0
 DCN_LATENCY_US = 100.0
 DCN_BW_GBPS = 25.0
-#: quantize/dequantize kernel overhead for the bf16-wire strategy
+#: quantize/dequantize kernel overhead for the quantized-wire strategy
 QUANT_OVERHEAD_US = 2.0
+
+#: wire bytes per f32 payload byte, by wire format — values plus the
+#: f32 scale sidecar of the blockwise formats (one scale per 256
+#: elements = +1/256). MUST stay numerically equal to
+#: ``collectives.quantized.wire_ratio`` (this module is stdlib-only so
+#: it cannot import the jax-side table; the equality is pinned by
+#: tests/tuning_tests/test_wire_cost.py).
+WIRE_RATIO = {
+    "f32": 1.0,
+    "bf16": 0.5,
+    "int8": 0.25,
+    "int8-block": 0.25 + 1.0 / 256,
+    "int4-block": 0.125 + 1.0 / 256,
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -147,13 +161,16 @@ class Topology:
         return cls(tuple(tiers), platform, kind, quant_overhead_us)
 
     # -- the cost model -------------------------------------------------
-    def estimate_us(self, strategy: str, nbytes: int) -> float:
+    def estimate_us(self, strategy: str, nbytes: int,
+                    wire_format: str = "bf16") -> float:
         """Modeled time for ONE reduction of ``nbytes`` payload.
 
         ``flat``: one allreduce whose ring crosses the slowest tier.
         ``hierarchical``: reduce-scatter + all-gather on the innermost
         tier, then an allreduce per outer tier carrying ``1/intra`` of
-        the bytes. ``quantized``: flat at bf16 wire width plus the
+        the bytes. ``quantized``: flat at ``wire_format``'s wire width
+        (:data:`WIRE_RATIO` — beta scales with the actual bytes on the
+        wire, so the narrower formats genuinely price cheaper) plus the
         (de)quantize kernel overhead. For a two-tier topology these are
         exactly the ``collectives.auto.CostModel`` formulas.
         """
@@ -171,7 +188,12 @@ class Topology:
                     _ring_bytes(carried, tier.size), tier.bw_gbps)
             return t
         if strategy == "quantized":
-            wire = nbytes * 2 / 4.0  # bf16 wire over f32 payload
+            try:
+                wire = nbytes * WIRE_RATIO[wire_format]
+            except KeyError:
+                raise ValueError(
+                    f"unknown wire_format {wire_format!r}; expected one "
+                    f"of {tuple(WIRE_RATIO)}") from None
             return (slow.latency_us + self.quant_overhead_us
                     + _xfer_us(_ring_bytes(wire, self.n), slow.bw_gbps))
         raise ValueError(f"unknown strategy {strategy!r}")

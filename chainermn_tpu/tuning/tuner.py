@@ -44,6 +44,12 @@ DEFAULT_BUCKET_BYTES = 4 << 20
 LAUNCH_EPSILON_US = 1e-3
 
 
+#: wire formats the lossy sweep tries for the quantized strategy —
+#: plain 'int8' is omitted (blockwise strictly dominates it: same wire
+#: width, per-256-element scales)
+QUANT_WIRE_SWEEP = ("bf16", "int8-block", "int4-block")
+
+
 @dataclasses.dataclass(frozen=True)
 class Candidate:
     """One point in the knob space."""
@@ -52,6 +58,9 @@ class Candidate:
     bucket_bytes: int = DEFAULT_BUCKET_BYTES
     bucket_order: str = "emission"
     double_buffering: bool = False
+    #: quantized-wire format; 'f32' (the non-compressing strategies'
+    #: only wire) is priced as bf16 when the strategy is 'quantized'
+    wire_format: str = "f32"
 
 
 def default_flat_candidate() -> Candidate:
@@ -75,11 +84,16 @@ def default_candidates(topology: Topology,
         strategies.append("quantized")
     out = []
     for strategy in strategies:
-        for bb in bucket_sweep:
-            for order in ("emission", "size"):
-                out.append(Candidate(strategy, int(bb), order, False))
-                if allow_stale:
-                    out.append(Candidate(strategy, int(bb), order, True))
+        wires = (QUANT_WIRE_SWEEP if strategy == "quantized"
+                 else ("f32",))
+        for wf in wires:
+            for bb in bucket_sweep:
+                for order in ("emission", "size"):
+                    out.append(Candidate(strategy, int(bb), order,
+                                         False, wf))
+                    if allow_stale:
+                        out.append(Candidate(strategy, int(bb), order,
+                                             True, wf))
     return out
 
 
@@ -103,6 +117,10 @@ def estimate_comm_us(topology: Topology, candidate: Candidate,
                    in measured.items() if s == strategy]
             if pts:
                 return min(pts)[1]
+        if strategy == "quantized":
+            wf = (candidate.wire_format
+                  if candidate.wire_format != "f32" else "bf16")
+            return topology.estimate_us(strategy, nbytes, wire_format=wf)
         return topology.estimate_us(strategy, nbytes)
 
     total = 0.0
@@ -220,6 +238,7 @@ def tune(topology: Topology, total_bytes: int,
         bucket_bytes=best.bucket_bytes,
         bucket_order=best.bucket_order,
         double_buffering=best.double_buffering,
+        wire_format=best.wire_format,
         overlap_fraction=best_row["overlap_fraction"],
         est_exposed_us=round(best_row["score"], 3),
         source=source,

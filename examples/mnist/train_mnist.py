@@ -59,6 +59,12 @@ def main():
                    help="gradient-reduction strategy (collectives/ "
                         "registry; 'flat' is bit-identical to the "
                         "legacy psum path)")
+    p.add_argument("--wire-format", default=None,
+                   choices=["f32", "bf16", "int8", "int8-block",
+                            "int4-block"],
+                   help="quantized wire format for compressing "
+                        "reducers (docs/collectives.md"
+                        "#quantized-wire-formats)")
     p.add_argument("--out", "-o", default="result")
     args = p.parse_args()
 
@@ -97,7 +103,9 @@ def main():
                         np.zeros((2, 28, 28), np.float32))["params"]
     params = comm.bcast_data(params)
 
-    reducer = chainermn_tpu.make_grad_reducer(args.grad_reducer, comm)
+    wf = None if args.wire_format in (None, "f32") else args.wire_format
+    reducer = chainermn_tpu.make_grad_reducer(args.grad_reducer, comm,
+                                              wire_format=wf)
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.adam(args.lr), comm, grad_reducer=reducer
     )

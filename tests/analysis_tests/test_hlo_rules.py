@@ -13,6 +13,7 @@ from chainermn_tpu.analysis import (
     check_dp_overlap,
     check_fsdp_gather_liveness,
     check_pipeline_permute_overlap,
+    check_quantized_wire_dtype,
     dp_overlap_fraction,
     parse_computations,
     scheduled_entry_ops,
@@ -307,3 +308,106 @@ def test_dl204_async_gather_interval_extends_to_done_use():
 def test_dl204_no_gathers_skips():
     out = check_fsdp_gather_liveness(_DP_OVERLAPPED)
     assert out["ok"] is None and "skip" in out
+
+
+# ---------------------------------------------------------------------------
+# DL205 — quantized wire dtype
+# ---------------------------------------------------------------------------
+
+_QUANT_REDUCE_OK = _hlo("""\
+    HloModule train_step, is_scheduled=true
+
+    ENTRY %main.7 (p0: s32[4096]) -> s32[4096] {
+      %p0 = s32[4096]{0} parameter(0)
+      %ar = s32[4096]{0} all-reduce(%p0), replica_groups={{0,1}}, to_apply=%add
+      %scale = f32[16]{0} all-reduce(%s), replica_groups={{0,1}}, to_apply=%max
+      ROOT %out = s32[4096]{0} copy(%ar)
+    }
+    """)
+
+_QUANT_HOISTED_BAD = _hlo("""\
+    HloModule train_step, is_scheduled=true
+
+    ENTRY %main.7 (p0: f32[4096]) -> f32[4096] {
+      %p0 = f32[4096]{0} parameter(0)
+      %ar = f32[4096]{0} all-reduce(%p0), replica_groups={{0,1}}, to_apply=%add
+      %q = s8[512]{0} all-reduce(%t), replica_groups={{0,1}}, to_apply=%add
+      ROOT %out = f32[4096]{0} copy(%ar)
+    }
+    """)
+
+
+def test_dl205_narrow_dominant_reduce_is_ok():
+    out = check_quantized_wire_dtype(_QUANT_REDUCE_OK)
+    assert out["ok"] is True
+    # s32 counts as narrow ON THE REDUCE (int8/int4 codes accumulate in
+    # s32); the f32 scale sidecar is smaller and does not fail the rule
+    assert out["dominant"]["reduce"]["dtype"] == "s32"
+
+
+def test_dl205_wide_dominant_with_narrow_evidence_fails():
+    out = check_quantized_wire_dtype(_QUANT_HOISTED_BAD)
+    assert out["ok"] is False
+    assert "fix" in out and "DL205".lower() in out["fix"].lower()
+
+
+def test_dl205_unquantized_program_skips_unless_expected():
+    # an ordinary f32 program shows no quantization evidence: silent
+    # skip for the argument-free dlint run, hard fail when the caller
+    # BUILT a quantized step and expects the wire to prove it
+    out = check_quantized_wire_dtype(_DP_SERIALIZED)
+    assert out["ok"] is None and "skip" in out
+    out = check_quantized_wire_dtype(_DP_SERIALIZED,
+                                     expect_quantized=True)
+    assert out["ok"] is False and "fix" in out
+
+
+def test_dl205_s32_gather_is_not_quantization_evidence():
+    # an s32 ALL-GATHER is wide integer data (indices, ids) — only
+    # reducing collectives accumulate quantized codes in s32
+    hlo = _hlo("""\
+        HloModule m, is_scheduled=true
+
+        ENTRY %main.3 (p0: s32[4096]) -> s32[8192] {
+          %p0 = s32[4096]{0} parameter(0)
+          ROOT %ag = s32[8192]{0} all-gather(%p0), dimensions={0}
+        }
+        """)
+    out = check_quantized_wire_dtype(hlo)
+    assert out["ok"] is None and "skip" in out
+
+
+def test_dl205_tiny_narrow_collectives_are_not_evidence():
+    # sub-256-element narrow collectives (loop counters, flag psums)
+    # must not drag an ordinary f32 program into the rule
+    hlo = _hlo("""\
+        HloModule m, is_scheduled=true
+
+        ENTRY %main.3 (p0: f32[4096]) -> f32[4096] {
+          %p0 = f32[4096]{0} parameter(0)
+          %flag = s32[1]{0} all-reduce(%i), replica_groups={{0,1}}, to_apply=%add
+          ROOT %ar = f32[4096]{0} all-reduce(%p0), replica_groups={{0,1}}, to_apply=%add
+        }
+        """)
+    out = check_quantized_wire_dtype(hlo)
+    assert out["ok"] is None and "skip" in out
+
+
+def test_dl205_judges_reduce_and_gather_families_independently():
+    # FSDP param_wire: s8 codes dominate the GATHER family while the
+    # (ungated) gradients legitimately reduce in f32 — per-family
+    # dominance must pass this, global dominance would not
+    hlo = _hlo("""\
+        HloModule fsdp, is_scheduled=true
+
+        ENTRY %main.5 (p0: s8[4096]) -> f32[65536] {
+          %p0 = s8[4096]{0} parameter(0)
+          %ag = s8[32768]{0} all-gather(%p0), dimensions={0}
+          %sc = f32[128]{0} all-gather(%s), dimensions={0}
+          ROOT %ar = f32[65536]{0} all-reduce(%g), replica_groups={{0,1}}, to_apply=%add
+        }
+        """)
+    out = check_quantized_wire_dtype(hlo)
+    assert out["ok"] is True
+    assert out["dominant"]["gather"]["dtype"] == "s8"
+    assert "reduce" not in out["dominant"]  # no narrow-reduce evidence

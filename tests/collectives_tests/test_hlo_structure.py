@@ -104,3 +104,23 @@ def test_quantized_int8_reduces_in_integers(comm):
     assert int_ars, "no s32 all-reduce in the int8 quantized program"
     assert not re.search(r"= f32\[%d\]\S* all-reduce\(" % NELEM, text), (
         "quantized program still all-reduces the full f32 payload")
+
+
+def test_quantized_blockwise_program_passes_dl205(comm):
+    """The blockwise wire on REAL compiled HLO: codes all-reduce in s32
+    (the f32 scale sidecar is the smaller collective), and the DL205
+    pass — the same one dlint --hlo runs — confirms the dominant
+    reduce is narrow."""
+    from chainermn_tpu.analysis import check_quantized_wire_dtype
+    from chainermn_tpu.collectives.quantized import quantize_allreduce
+
+    axes = comm.axis_names
+    for mode in ("int8-block", "int4-block"):
+        text = _compiled_text(
+            comm, lambda v: quantize_allreduce(v, axes, mode)[0])
+        int_ars = [l for l in text.splitlines()
+                   if re.search(r"= s32\[[\d,]+\]\S* all-reduce\(", l)]
+        assert int_ars, f"no s32 all-reduce in the {mode} program"
+        out = check_quantized_wire_dtype(text, expect_quantized=True)
+        assert out["ok"] is True, (mode, out)
+        assert out["dominant"]["reduce"]["dtype"] == "s32", (mode, out)

@@ -220,11 +220,53 @@ def test_quantized_bf16_stateless_tracks_flat(comm):
     np.testing.assert_allclose(l_flat, l_q, rtol=0.05, atol=0.02)
 
 
-def test_quantized_ef_reduce_scatter_refused(comm):
+def test_quantized_ef_reduce_scatter_flat_ef_accounting(comm):
+    """The lifted ZeRO hook: ``reduce_scatter_flat_ef`` returns the tile
+    mean AND the residual (what the wire dropped, in the flat-bucket
+    frame), with the conservation identity
+
+        mean_r(g_r) == concat(tile_means) + mean_r(residual_r)
+
+    and — on exactly int8-representable data — zero residual bitwise."""
+    n = comm.size
+    ax = comm.axis_names[0]
+    red = QuantizedReducer(comm, mode="int8-block", ef=True)
+    L = n * 512  # multiple of both n and QUANT_BLOCK
+
+    def kernel(v):
+        t, e = red.reduce_scatter_flat_ef(
+            v[0], jnp.zeros_like(v[0]), ax, n)
+        return t[None], e[None]
+
+    f = jax.jit(shard_map(kernel, mesh=comm.mesh, in_specs=P(ax),
+                          out_specs=(P(ax), P(ax))))
+
+    rs = np.random.RandomState(0)
+    g = rs.randn(n, L).astype(np.float32)
+    tiles, res = f(g)
+    np.testing.assert_allclose(
+        np.asarray(tiles).reshape(-1) + np.asarray(res).mean(axis=0),
+        g.mean(axis=0), rtol=1e-5, atol=1e-6)
+
+    # exactly representable: integer values, block amax pinned to 127 ->
+    # scale 1.0, quantization is lossless, residual is EXACTLY zero
+    gi = rs.randint(-127, 128, size=(n, L)).astype(np.float32)
+    gi[0, ::256] = 127.0
+    tiles, res = f(gi)
+    np.testing.assert_array_equal(np.asarray(res),
+                                  np.zeros_like(np.asarray(res)))
+    np.testing.assert_array_equal(np.asarray(tiles).reshape(-1),
+                                  gi.mean(axis=0))
+
+
+def test_quantized_ef_plain_reduce_scatter_still_refused(comm):
+    """The STATELESS entry point must keep refusing an ef=True reducer —
+    silently dropping the residual is the bug class the EF tests above
+    exist for; the error directs to reduce_scatter_flat_ef."""
     red = QuantizedReducer(comm, mode="int8", ef=True)
     L = comm.size * 16
     ax = comm.axis_names[0]
-    with pytest.raises(RuntimeError, match="error.feedback|ef"):
+    with pytest.raises(RuntimeError, match="reduce_scatter_flat_ef"):
         _shard_reduce(
             comm,
             lambda v: red.reduce_scatter_flat(v, ax, comm.size),
@@ -358,12 +400,25 @@ def test_zero1_hierarchical_matches_default(comm):
         zero1_params(st0, params), zero1_params(st1, params))
 
 
-def test_zero1_stateful_reducer_rejected(comm):
+def test_zero1_stateful_reducer_accepted_and_trains(comm):
+    """ZeRO-1 now ACCEPTS a stateful quantized reducer (PR 8): the
+    per-rank EF residual rides _ReducerWrappedState in the flat-bucket
+    frame. Short smoke here; the calibrated EF-vs-no-EF separation
+    lives in test_quantized_wire.py."""
     model, params = _mlp_params(comm)
-    with pytest.raises(ValueError, match="stateful"):
-        make_zero1_train_step(
-            model, optax.adam(1e-2), comm, params,
-            grad_reducer=QuantizedReducer(comm, mode="int8", ef=True))
+    x, y = _data(comm)
+    step, state = make_zero1_train_step(
+        model, optax.adam(1e-2), comm, params, donate=False,
+        grad_reducer=QuantizedReducer(comm, mode="int8-block", ef=True))
+    losses = []
+    for _ in range(4):
+        state, m = step(state, x, y)
+        losses.append(float(m["main/loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # the residual state is there, in the flat-bucket frame: each rank
+    # holds the FULL padded flat vector (that is the layout contract)
+    assert state[1].reducer[0].shape == (comm.size, state[0].shape[0])
 
 
 def test_fsdp_stateful_reducer_rejected(comm):

@@ -1,0 +1,123 @@
+"""The wire-width cost model (PR 8): the stdlib-only WIRE_RATIO table
+must stay bitwise-equal to the jax-side codec accounting it mirrors
+(tuning/topology.py cannot import collectives.quantized), and
+``estimate_us`` must price the quantized candidate by the ACTUAL wire
+bytes — the bug this PR fixed was every format priced at bf16, which
+made 'auto' and schedtune incapable of ever choosing the int8/int4
+wires.
+"""
+
+import pytest
+
+from chainermn_tpu.collectives import CostModel
+from chainermn_tpu.collectives.quantized import wire_ratio
+from chainermn_tpu.tuning import single_tier
+from chainermn_tpu.tuning.topology import WIRE_RATIO
+
+
+def test_wire_ratio_tables_agree():
+    """tuning.topology.WIRE_RATIO is a hand-copy (stdlib-only module);
+    this is the pin that keeps it equal to the codec's arithmetic."""
+    assert set(WIRE_RATIO) == {"f32", "bf16", "int8", "int8-block",
+                               "int4-block"}
+    for fmt, r in WIRE_RATIO.items():
+        assert r == wire_ratio(fmt), fmt
+
+
+def test_topology_estimate_us_scales_with_wire_width():
+    t = single_tier(8)
+    nbytes = 64 << 20
+    est = {f: t.estimate_us("quantized", nbytes, wire_format=f)
+           for f in WIRE_RATIO}
+    # strictly narrower wire -> strictly cheaper estimate
+    assert (est["f32"] > est["bf16"] > est["int8-block"]
+            > est["int4-block"])
+    # int8's single scale prices marginally under int8-block's sidecar
+    assert est["int8"] < est["int8-block"]
+    # beta term scales EXACTLY with the ratio: subtract the constant
+    # alpha+overhead (the f32 ratio is 1.0, so flat's beta is the base)
+    base = est["f32"] - t.estimate_us("quantized", 0, wire_format="f32")
+    for f, r in WIRE_RATIO.items():
+        width = est[f] - t.estimate_us("quantized", 0, wire_format=f)
+        assert width == pytest.approx(base * r, rel=1e-9), f
+
+
+def test_topology_estimate_us_unknown_wire_rejected():
+    with pytest.raises(ValueError, match="wire_format"):
+        single_tier(8).estimate_us("quantized", 1 << 20,
+                                   wire_format="int3")
+
+
+def test_cost_model_quantized_prices_actual_wire():
+    """collectives.auto.CostModel (the two-tier reference formulas):
+    same wire-width scaling, on both one- and two-tier shapes."""
+    cm = CostModel()
+    for topo in (
+            # duck-typed HierTopology shapes (n, intra, inter)
+            type("T", (), {"n": 8, "intra": 8, "inter": 1})(),
+            type("T", (), {"n": 8, "intra": 4, "inter": 2})()):
+        nbytes = 64 << 20
+        est = {f: cm.estimate_us("quantized", nbytes, topo,
+                                 wire_format=f) for f in WIRE_RATIO}
+        assert (est["f32"] > est["bf16"] > est["int8-block"]
+                > est["int4-block"])
+        base = est["f32"] - cm.estimate_us("quantized", 0, topo,
+                                           wire_format="f32")
+        for f, r in WIRE_RATIO.items():
+            width = est[f] - cm.estimate_us("quantized", 0, topo,
+                                            wire_format=f)
+            assert width == pytest.approx(base * r, rel=1e-9), f
+
+
+def test_two_tier_topology_matches_cost_model_on_quantized_wire():
+    """The algebraic identity the Topology docstring claims, now
+    including the wire_format axis."""
+    from chainermn_tpu.tuning import Tier, Topology
+
+    cm = CostModel()
+    topo2 = Topology(
+        (Tier("ici", 4, cm.ici_latency_us, cm.ici_bw_gbps),
+         Tier("dcn", 2, cm.dcn_latency_us, cm.dcn_bw_gbps)),
+        platform="tpu", quant_overhead_us=cm.quant_overhead_us)
+    hier = type("T", (), {"n": 8, "intra": 4, "inter": 2})()
+    for f in WIRE_RATIO:
+        assert topo2.estimate_us("quantized", 1 << 22, wire_format=f) \
+            == pytest.approx(
+                cm.estimate_us("quantized", 1 << 22, hier,
+                               wire_format=f), rel=1e-12), f
+
+
+def test_default_candidates_sweep_wire_formats():
+    """lossy=True expands the quantized strategy across the wire sweep
+    (bf16/int8-block/int4-block; plain int8 is strictly dominated by
+    int8-block in the cost model and is omitted); lossless candidates
+    stay pinned to f32."""
+    from chainermn_tpu.tuning.tuner import (QUANT_WIRE_SWEEP,
+                                            default_candidates)
+
+    t = single_tier(8)
+    cands = default_candidates(t, lossy=True)
+    quant_wires = {c.wire_format for c in cands
+                   if c.strategy == "quantized"}
+    assert quant_wires == set(QUANT_WIRE_SWEEP)
+    assert all(c.wire_format == "f32" for c in cands
+               if c.strategy != "quantized")
+    assert all(c.wire_format == "f32"
+               for c in default_candidates(t, lossy=False))
+
+
+def test_tune_plan_records_winning_wire_format():
+    """With a wire-width-aware estimator and no overlap signal (no
+    compiled HLO), the cheapest quantized candidate is the narrowest
+    wire — and the chosen plan must RECORD it so schedtune's DB replays
+    the same reducer."""
+    from chainermn_tpu.tuning.tuner import tune
+
+    hlo = ("HloModule m, is_scheduled=true\n\n"
+           "ENTRY %main (p0: f32[8]) -> f32[8] {\n"
+           "  ROOT %p0 = f32[8]{0} parameter(0)\n"
+           "}\n")
+    t = single_tier(8)
+    res = tune(t, 256 << 20, lambda c: hlo, lossy=True)
+    assert res.plan.strategy == "quantized"
+    assert res.plan.wire_format == "int4-block"

@@ -10,6 +10,7 @@ Usage: python tools/bench_lm.py [d_model n_layers seq_len batch
                                  [loss [d_head [qkv_layout]]]]
                                 [--autotune-blocks] [--tune[=DB_PATH]]
                                 [--grad-reducer=flat,hierarchical,...]
+                                [--wire-format=f32,bf16,int8,int8-block,int4-block]
   --tune: build the optimizer from the schedtune profile DB
   (create_multi_node_optimizer(tune=...), docs/tuning.md; default DB
   path unless =DB_PATH given — run tools/schedtune.py first). The JSON
@@ -22,6 +23,13 @@ Usage: python tools/bench_lm.py [d_model n_layers seq_len batch
   bytes from the reducer's bucket plan. Off TPU the throughput deltas
   are meaningless (host-platform collectives are memcpys — BASELINE.md
   records the honest null); the byte accounting is exact everywhere.
+  --wire-format: comma-separated wire formats
+  (docs/collectives.md#quantized-wire-formats); one JSON line per
+  format. 'f32' runs the flat reference; the narrow formats default the
+  strategy to 'quantized' when --grad-reducer is absent. Each line's
+  ``comm_wire_bytes_per_step`` is EXACT (scale sidecars included) and
+  ``comm_wire_compression`` is wire/payload — byte accounting is
+  host-side and correct off-TPU, like --grad-reducer.
   --autotune-blocks: time the flash-attention (block_q, block_k)
   candidates for this shape (ops/autotune.py) and build the model with
   the winner; off-TPU the tuner returns the defaults untimed (recorded
@@ -48,7 +56,7 @@ import numpy as np
 def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
             loss_kind="unfused", d_head=64, scan_k=4, n_iters=6,
             qkv_layout="blhd", autotune_blocks=False, grad_reducer=None,
-            tune=None):
+            tune=None, wire_format=None):
     """Measure LM training throughput; returns (tokens_per_sec_per_chip,
     config dict). Importable — bench.py reuses this as its LM gate."""
     import jax
@@ -84,10 +92,13 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
     params = comm.bcast_data(
         model.init(jax.random.PRNGKey(0), toks[:1, :-1])["params"])
     reducer = None
-    if grad_reducer:
+    wf = None if wire_format in (None, "f32") else wire_format
+    if grad_reducer or wf:
         from chainermn_tpu.collectives import make_grad_reducer
 
-        reducer = make_grad_reducer(grad_reducer, comm)
+        # a narrow wire with no explicit strategy means 'quantized'
+        reducer = make_grad_reducer(grad_reducer or "quantized", comm,
+                                    wire_format=wf)
     opt = chainermn_tpu.create_multi_node_optimizer(
         optax.adamw(3e-4), comm, grad_reducer=reducer, tune=tune)
     plan = getattr(opt, "plan", None)
@@ -140,16 +151,54 @@ def measure(d_model=768, n_layers=12, seq_len=2048, batch=8,
               "attention_blocks": blocks}
     if reducer is not None:
         rows = reducer.plan(params)
+        payload = sum(r["bytes"] for r in rows)
+        wire = sum(r["wire_bytes"] for r in rows)
         config["grad_reducer"] = reducer.name
-        config["comm_bytes_per_step"] = sum(r["bytes"] for r in rows)
-        config["comm_wire_bytes_per_step"] = sum(
-            r["wire_bytes"] for r in rows)
+        config["comm_bytes_per_step"] = payload
+        config["comm_wire_bytes_per_step"] = wire
+        config["comm_wire_compression"] = round(
+            wire / payload, 6) if payload else 1.0
+    if wire_format is not None:
+        config["wire_format"] = wire_format
     if plan is not None:
         config["tuning/overlap_frac"] = plan.overlap_fraction
         config["tuning/bucket_bytes"] = plan.bucket_bytes
         config["tuning/strategy"] = plan.strategy
         config["tuning/source"] = plan.source
     return tokens_per_sec / comm.size, config
+
+
+def wire_report(wire_format="f32", d_model=768, n_layers=12,
+                seq_len=2048, d_head=64):
+    """Exact per-step wire accounting for the LM bench config WITHOUT
+    running a step: abstract params (``jax.eval_shape`` of the model
+    init — zero FLOPs, zero device memory) through the reducer's bucket
+    plan. Works anywhere; bench.py's wire gate is built on this."""
+    import jax
+    import jax.numpy as jnp
+
+    import chainermn_tpu
+    from chainermn_tpu.collectives import make_grad_reducer
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    comm = chainermn_tpu.create_communicator("xla")
+    model = TransformerLM(
+        vocab=32768, d_model=d_model, n_heads=d_model // d_head,
+        n_layers=n_layers, d_ff=4 * d_model, max_len=seq_len,
+        pos_emb="rope", attention="flash", dtype=jnp.bfloat16)
+    toks = jax.ShapeDtypeStruct((1, seq_len), jnp.int32)
+    params = jax.eval_shape(
+        lambda t: model.init(jax.random.PRNGKey(0), t)["params"], toks)
+    wf = None if wire_format in (None, "f32") else wire_format
+    reducer = make_grad_reducer("quantized" if wf else "flat", comm,
+                                wire_format=wf)
+    rows = reducer.plan(params)
+    payload = sum(r["bytes"] for r in rows)
+    wire = sum(r["wire_bytes"] for r in rows)
+    return {"wire_format": wire_format or "f32",
+            "payload_bytes": payload,
+            "wire_bytes": wire,
+            "compression": round(wire / payload, 6) if payload else 1.0}
 
 
 def main():
@@ -161,6 +210,11 @@ def main():
     for a in list(argv):
         if a.startswith("--grad-reducer"):
             reducers = a.split("=", 1)[1].split(",")
+            argv.remove(a)
+    wire_formats = [None]
+    for a in list(argv):
+        if a.startswith("--wire-format"):
+            wire_formats = a.split("=", 1)[1].split(",")
             argv.remove(a)
     tune = None
     for a in list(argv):
@@ -175,20 +229,22 @@ def main():
     d_head = int(argv[5]) if len(argv) > 5 else 64
     qkv_layout = argv[6] if len(argv) > 6 else "blhd"
     for gr in reducers:
-        try:
-            per_chip, config = measure(d_model, n_layers, seq_len, batch,
-                                       loss_kind, d_head,
-                                       qkv_layout=qkv_layout,
-                                       autotune_blocks=autotune,
-                                       grad_reducer=gr, tune=tune)
-        except ValueError as e:
-            raise SystemExit(str(e))
-        print(json.dumps({
-            "metric": "transformer_lm_tokens_per_sec_per_chip",
-            "value": round(per_chip, 1),
-            "unit": "tokens/sec/chip",
-            "config": config,
-        }), flush=True)
+        for wfmt in wire_formats:
+            try:
+                per_chip, config = measure(d_model, n_layers, seq_len,
+                                           batch, loss_kind, d_head,
+                                           qkv_layout=qkv_layout,
+                                           autotune_blocks=autotune,
+                                           grad_reducer=gr, tune=tune,
+                                           wire_format=wfmt)
+            except ValueError as e:
+                raise SystemExit(str(e))
+            print(json.dumps({
+                "metric": "transformer_lm_tokens_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "tokens/sec/chip",
+                "config": config,
+            }), flush=True)
 
 
 if __name__ == "__main__":

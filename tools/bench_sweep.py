@@ -5,11 +5,16 @@ per variant.
 
 Usage: python tools/bench_sweep.py BATCH N_SCAN S2D
                                    [--grad-reducer=flat,hierarchical,...]
+                                   [--wire-format=f32,bf16,int8-block,...]
                                    [--tune[=DB_PATH]]
   --grad-reducer sweeps collectives/ strategies; each line carries the
   strategy's per-step payload and wire bytes from the reducer's bucket
   plan. Off TPU the throughput deltas are an honest null (BASELINE.md);
   the byte accounting is exact everywhere.
+  --wire-format sweeps the quantized wire formats
+  (docs/collectives.md#quantized-wire-formats; narrow formats default
+  the strategy to 'quantized'); each line carries exact wire bytes and
+  the wire/payload compression ratio.
   --tune builds the optimizer from the schedtune profile DB
   (docs/tuning.md; run tools/schedtune.py first) and adds the plan's
   tuning/overlap_frac + tuning/bucket_bytes keys to the JSON line."""
@@ -25,7 +30,7 @@ import numpy as np
 
 
 def run_variant(batch, n_scan, s2d, n_iters=10, grad_reducer=None,
-                tune=None):
+                tune=None, wire_format=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -48,10 +53,13 @@ def run_variant(batch, n_scan, s2d, n_iters=10, grad_reducer=None,
     params = comm.bcast_data(variables["params"])
     extra = {k: comm.bcast_data(variables[k]) for k in mutable}
     reducer = None
-    if grad_reducer:
+    wf = None if wire_format in (None, "f32") else wire_format
+    if grad_reducer or wf:
         from chainermn_tpu.collectives import make_grad_reducer
 
-        reducer = make_grad_reducer(grad_reducer, comm)
+        # a narrow wire with no explicit strategy means 'quantized'
+        reducer = make_grad_reducer(grad_reducer or "quantized", comm,
+                                    wire_format=wf)
     opt = chainermn_tpu.create_multi_node_optimizer(
         optax.sgd(0.1, momentum=0.9), comm, grad_reducer=reducer,
         tune=tune)
@@ -108,10 +116,15 @@ def run_variant(batch, n_scan, s2d, n_iters=10, grad_reducer=None,
     }
     if reducer is not None:
         rows = reducer.plan(params)
+        payload = sum(r["bytes"] for r in rows)
+        wire = sum(r["wire_bytes"] for r in rows)
         line["grad_reducer"] = reducer.name
-        line["comm_bytes_per_step"] = sum(r["bytes"] for r in rows)
-        line["comm_wire_bytes_per_step"] = sum(
-            r["wire_bytes"] for r in rows)
+        line["comm_bytes_per_step"] = payload
+        line["comm_wire_bytes_per_step"] = wire
+        line["comm_wire_compression"] = round(
+            wire / payload, 6) if payload else 1.0
+    if wire_format is not None:
+        line["wire_format"] = wire_format
     if plan is not None:
         line["tuning/overlap_frac"] = plan.overlap_fraction
         line["tuning/bucket_bytes"] = plan.bucket_bytes
@@ -126,6 +139,11 @@ if __name__ == "__main__":
         if a.startswith("--grad-reducer"):
             reducers = a.split("=", 1)[1].split(",")
             argv.remove(a)
+    wire_formats = [None]
+    for a in list(argv):
+        if a.startswith("--wire-format"):
+            wire_formats = a.split("=", 1)[1].split(",")
+            argv.remove(a)
     tune = None
     for a in list(argv):
         if a.startswith("--tune"):
@@ -135,4 +153,6 @@ if __name__ == "__main__":
     n_scan = int(argv[1])
     s2d = argv[2] == "1"
     for gr in reducers:
-        run_variant(batch, n_scan, s2d, grad_reducer=gr, tune=tune)
+        for wfmt in wire_formats:
+            run_variant(batch, n_scan, s2d, grad_reducer=gr, tune=tune,
+                        wire_format=wfmt)

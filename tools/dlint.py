@@ -91,7 +91,8 @@ def main(argv=None):
         with open(args.hlo, encoding="utf-8") as fh:
             txt = fh.read()
         for check in (hlo_passes.check_dp_overlap,
-                      hlo_passes.check_pipeline_permute_overlap):
+                      hlo_passes.check_pipeline_permute_overlap,
+                      hlo_passes.check_quantized_wire_dtype):
             out = check(txt)
             if rules is not None and out["rule"] not in rules:
                 continue

@@ -119,7 +119,9 @@ def _aot_compile_fn(topology_name):
 
         reducer = make_grad_reducer(
             cand.strategy, comm, bucket_bytes=cand.bucket_bytes,
-            bucket_order=cand.bucket_order)
+            bucket_order=cand.bucket_order,
+            wire_format=(cand.wire_format
+                         if cand.wire_format != "f32" else None))
         mnopt = chainermn_tpu.create_multi_node_optimizer(
             opt, comm, grad_reducer=reducer,
             double_buffering=cand.double_buffering)
@@ -203,6 +205,7 @@ def main():
     k = max(1, math.ceil(grad_bytes / plan.bucket_bytes))
     print(f"chosen schedule  : {plan.strategy} bucket_bytes="
           f"{plan.bucket_bytes:,} ({k} buckets) order={plan.bucket_order}"
+          f"{' wire=' + plan.wire_format if plan.wire_format != 'f32' else ''}"
           f"{' +double_buffering' if plan.double_buffering else ''}",
           file=sys.stderr)
     print(f"overlap fraction : {plan.overlap_fraction:.4f} (default "
