@@ -350,6 +350,70 @@ def main():
             and cached["traces"] == 1 and recomp["traces"] == n_new)
     except Exception as e:  # never sink the headline metric
         record["serving_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # async checkpoint plane gate
+    # (docs/fault_tolerance.md#checkpoint-cadence), folded into the same
+    # JSON line: the per-step stall of saving through
+    # checkpointing.AsyncSnapshotPlane must be <= 0.25x the synchronous
+    # save's wall time on the same state. The state is a ~16 MB sharded
+    # leaf — big enough that the sync path's device-get + serialize +
+    # fsync + SHA-256 costs tens of ms; the async stall is just the
+    # device-side copy dispatch + offload kick. Host/disk-side, so the
+    # gate is NOT TPU-gated and holds on the 8-device CPU mesh.
+    try:
+        import shutil
+        import tempfile
+
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from chainermn_tpu.checkpointing import AsyncSnapshotPlane
+        from chainermn_tpu.extensions.checkpoint import \
+            MultiNodeCheckpointer
+
+        mesh = comm.mesh
+        axis0 = mesh.axis_names[0]
+        n0 = int(mesh.devices.shape[0])
+        big = jax.device_put(
+            jnp.zeros((n0, (4 << 20) // n0), jnp.float32),
+            NamedSharding(mesh, PartitionSpec(axis0)))
+        ckpt_state = {"w": big}
+        ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+        try:
+            ck_sync = MultiNodeCheckpointer("sync", comm, path=ckpt_dir)
+            ck_sync.save(ckpt_state, iteration=0)  # warm the write path
+            t0 = time.perf_counter()
+            reps = 3
+            for i in range(reps):
+                ck_sync.save(ckpt_state, iteration=i + 1)
+            sync_ms = (time.perf_counter() - t0) * 1000.0 / reps
+
+            plane = AsyncSnapshotPlane(
+                MultiNodeCheckpointer("async", comm, path=ckpt_dir))
+            plane.save(ckpt_state, iteration=0)  # warm the copy trace
+            plane.flush()
+            stalls = []
+            for i in range(reps):
+                t0 = time.perf_counter()
+                plane.save(ckpt_state, iteration=(i + 1) * 10)
+                stalls.append((time.perf_counter() - t0) * 1000.0)
+                # the cadence a real run would have: a step's worth of
+                # compute between saves, which the writer overlaps
+                time.sleep(sync_ms / 1000.0)
+            plane.flush()
+            async_ms = sum(stalls) / len(stalls)
+            record["ckpt_sync_save_ms"] = round(sync_ms, 3)
+            record["ckpt_async_stall_ms"] = round(async_ms, 3)
+            record["ckpt_stall_ratio"] = round(
+                async_ms / sync_ms if sync_ms else 1.0, 4)
+            record["ckpt_bytes"] = int(plane.bytes_last)
+            record["ckpt_cadence_steps"] = int(plane.cadence_last)
+            record["ckpt_published"] = int(plane.published)
+            record["ckpt_gate_ok"] = bool(async_ms <= 0.25 * sync_ms)
+            plane.close()
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+    except Exception as e:  # never sink the headline metric
+        record["ckpt_gate_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(record))
 
 

@@ -36,6 +36,7 @@ from chainermn_tpu.iterators import (
 )
 from chainermn_tpu.links import MultiNodeBatchNormalization, MultiNodeChainList
 from chainermn_tpu.optimizers import create_multi_node_optimizer
+from chainermn_tpu import checkpointing
 from chainermn_tpu import resilience
 from chainermn_tpu import serving
 
@@ -59,6 +60,7 @@ __all__ = [
     "links",
     "MultiNodeBatchNormalization",
     "MultiNodeChainList",
+    "checkpointing",
     "resilience",
     "serving",
     "__version__",

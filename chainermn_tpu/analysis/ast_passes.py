@@ -954,3 +954,106 @@ def check_decode_step_recompile(tree, src, path) -> List[Finding]:
 
 register(Rule("DL108", "decode-step-recompile", f"{_DOC}#dl108",
               check_decode_step_recompile))
+
+# ---------------------------------------------------------------------------
+# DL109 — blocking-save-in-step-loop
+# ---------------------------------------------------------------------------
+
+#: constructors whose result is a SYNCHRONOUS checkpointer (save() runs
+#: device-get + serialize + fsync + SHA-256 on the calling thread)
+_CKPT_FACTORIES = {"create_multi_node_checkpointer",
+                   "MultiNodeCheckpointer"}
+
+
+def _async_plane_available() -> bool:
+    """Is the async snapshot plane shipped alongside this analysis
+    package? File-existence probe on purpose — importing
+    ``chainermn_tpu.checkpointing`` would drag jax into a pass suite
+    that deliberately runs on bare ASTs."""
+    import os
+
+    pkg = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "checkpointing", "async_plane.py")
+    return os.path.exists(pkg)
+
+
+def _ckpt_bound_names(tree: ast.AST) -> Set[str]:
+    """Names assigned DIRECTLY from a synchronous-checkpointer
+    constructor anywhere in the file (same intra-file tracking contract
+    as :func:`_jit_bound_names`). Only the OUTERMOST call counts:
+    ``plane = AsyncSnapshotPlane(MultiNodeCheckpointer(...))`` binds a
+    plane, not a checkpointer — that IS the fix this rule points at."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if (isinstance(node.value, ast.Call)
+                and _callee_name(node.value) in _CKPT_FACTORIES):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return names
+
+
+def check_blocking_save_in_step_loop(tree, src, path) -> List[Finding]:
+    """A synchronous ``checkpointer.save(...)`` on the step path.
+
+    The sync save spends device-get + serialize + fsync + SHA-256 on
+    the step thread — at a checkpoint cadence dense enough to survive
+    preemption, that stall dominates the step
+    (docs/fault_tolerance.md#checkpoint-cadence). Flagged when a name
+    bound from ``create_multi_node_checkpointer`` /
+    ``MultiNodeCheckpointer`` has ``.save(...)`` called inside a
+    ``for``/``while`` loop that ALSO dispatches a training step (a call
+    to a jit-bound name, or an ``.update()`` method call) — a plain
+    save loop (tests, offline conversion) is not a step loop and stays
+    clean. Fix: wrap the checkpointer in
+    ``checkpointing.AsyncSnapshotPlane`` and call ``plane.save(...)``
+    (or extend the plane on the Trainer); names bound from
+    ``AsyncSnapshotPlane(...)`` are not tracked, so the fixed code
+    passes. The rule only fires when the async plane ships next to this
+    package (``chainermn_tpu/checkpointing/``) — there is no fix to
+    point at otherwise. Intra-file, like every pass here. Suppress a
+    deliberate sync save (e.g. benchmarking the stall itself) with
+    ``# dlint: disable=DL109``.
+    """
+    if not _async_plane_available():
+        return []
+    findings: List[Finding] = []
+    ckpts = _ckpt_bound_names(tree)
+    if not ckpts:
+        return findings
+    jitted = _jit_bound_names(tree)
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        calls = [n for n in _walk_excluding_defs(loop.body)
+                 if isinstance(n, ast.Call)]
+        steps = any(
+            (_callee_name(n) in jitted)
+            or (isinstance(n.func, ast.Attribute)
+                and n.func.attr == "update")
+            for n in calls)
+        if not steps:
+            continue
+        for n in calls:
+            if (isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "save"
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in ckpts):
+                findings.append(Finding(
+                    "DL109", path, n.lineno,
+                    f"synchronous '{n.func.value.id}.save(...)' inside "
+                    "a step loop: the device-get + serialize + fsync + "
+                    "SHA-256 all stall the step thread. Wrap the "
+                    "checkpointer in checkpointing.AsyncSnapshotPlane "
+                    "and save through the plane — the write pipeline "
+                    "moves off the critical path and the stall drops "
+                    "to a device-side copy dispatch "
+                    f"({_DOC}#dl109)."))
+    return findings
+
+
+register(Rule("DL109", "blocking-save-in-step-loop", f"{_DOC}#dl109",
+              check_blocking_save_in_step_loop))

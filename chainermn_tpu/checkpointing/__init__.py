@@ -1,0 +1,46 @@
+"""checkpointing/ — the asynchronous snapshot plane and manifest-driven
+elastic resharding.
+
+Two halves of one story — checkpoint cadence cheap enough for
+preemption-heavy operation, and snapshots that survive a MESH change,
+not just a restart:
+
+* :class:`~chainermn_tpu.checkpointing.async_plane.AsyncSnapshotPlane`
+  — a double-buffered snapshot pipeline over the existing
+  :class:`~chainermn_tpu.extensions.checkpoint.MultiNodeCheckpointer`:
+  the step thread only dispatches a device-side copy and kicks off the
+  device→host offload; a background writer serializes, fsyncs,
+  SHA-256s, atomically publishes, and pushes to the ring replica. The
+  same overlap discipline schedtune applies to collectives
+  (docs/tuning.md), applied to checkpoint I/O.
+* :mod:`~chainermn_tpu.checkpointing.reshard` — manifest-driven
+  resharding: load snapshots written on one mesh onto a DIFFERENT mesh
+  shape (changed DP world, changed tile layout, multi-axis TP×DP
+  meshes), including the world-stacked flat-bucket EF residual frames
+  from ``optimizers/zero.py``. ``resilience/elastic.py`` routes its
+  multi-axis plans through here instead of raising
+  ``ElasticTopologyError``.
+
+See docs/fault_tolerance.md#checkpoint-cadence for the cookbook and
+``tools/ckpt.py`` for the offline inspect/verify/dry-run CLI.
+"""
+
+from chainermn_tpu.checkpointing.async_plane import AsyncSnapshotPlane
+from chainermn_tpu.checkpointing.reshard import (default_leaf_resharder,
+                                                 ef_frame_regroup,
+                                                 leaf_coverage,
+                                                 manifest_info, mesh_axes,
+                                                 reshard_state, saved_axes,
+                                                 scan_snapshot_dir)
+
+__all__ = [
+    "AsyncSnapshotPlane",
+    "default_leaf_resharder",
+    "ef_frame_regroup",
+    "leaf_coverage",
+    "manifest_info",
+    "mesh_axes",
+    "reshard_state",
+    "saved_axes",
+    "scan_snapshot_dir",
+]

@@ -1,0 +1,353 @@
+"""Double-buffered async snapshot pipeline.
+
+The synchronous ``MultiNodeCheckpointer.save`` spends the whole
+device-get + serialize + fsync + SHA-256 + rename on the STEP thread —
+at a cadence dense enough to survive preemption, that stall dominates
+the step. This plane splits the save at the step boundary exactly where
+the reference's double buffering split communication:
+
+1. **Step thread** (inside :meth:`AsyncSnapshotPlane.save`): dispatch a
+   device-side copy of every leaf (``jnp.copy`` preserves the sharding
+   and decouples the snapshot from the caller's next DONATING train
+   step — the original buffers may be deleted the moment save returns),
+   kick off the device→host offload on the copies
+   (``copy_to_host_async``), and enqueue. That is the entire per-step
+   stall, measured and exported as ``ckpt/stall_ms``.
+2. **Writer thread**: block on the offload (``np.asarray``), then run
+   the checkpointer's own atomic publish — tmp + fsync + SHA-256 +
+   rename + manifest (``MultiNodeCheckpointer._publish``) — and push
+   the fresh file to the ring replica
+   (:class:`~chainermn_tpu.resilience.replica.PeerReplicator`), all off
+   the critical path.
+
+Backpressure is explicit: the pending queue is bounded
+(``max_pending``, default 1 = classic double buffering) and
+``backpressure='block'`` stalls save() when the writer falls behind
+(bounded host memory, every snapshot published) while ``'skip'`` drops
+the NEW snapshot and counts it (bounded stall, sparser cadence under a
+slow disk). ``drain(deadline_s=)`` is the barrier the emergency paths
+use: :meth:`AsyncSnapshotPlane.emergency_save` drains within a reserved
+slice of the SAME preemption grace window
+(:func:`~chainermn_tpu.resilience.preemption.reserve_grace` — the drain
+budget is subtracted from the emergency-save deadline, never doubled),
+and the Trainer's finally-block calls :meth:`close`.
+
+Crash windows: a SIGKILL between offload and publish loses ONLY the
+in-flight snapshot — nothing partial is ever visible (the publish is
+the checkpointer's tmp+rename), so the consensus election falls back to
+the newest fully-verified iteration. The chaos harness widens exactly
+that window (``stall_writer``) to prove it.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import queue
+import threading
+import time
+import warnings
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.extensions.checkpoint import (MultiNodeCheckpointer,
+                                                 _flatten_state,
+                                                 _is_device_sharded,
+                                                 _unique_shards)
+from chainermn_tpu.resilience import chaos as _chaos
+from chainermn_tpu.resilience.preemption import reserve_grace
+
+__all__ = ["AsyncSnapshotPlane"]
+
+#: writer-thread poll period: how quickly an owed replica round (from a
+#: skipped save) is noticed when the queue is idle
+_POLL_S = 0.05
+
+
+class AsyncSnapshotPlane:
+    """Async snapshot pipeline over a synchronous checkpointer.
+
+    ``plane = AsyncSnapshotPlane(ck)`` then use the plane wherever the
+    checkpointer was used on the hot path: as a trainer extension
+    (``trainer.extend(plane, trigger=...)``), or via
+    :meth:`save` in a manual step loop. Read-side operations
+    (:meth:`maybe_load`, :meth:`resume`,
+    :meth:`latest_common_iteration`) drain the pipeline first so they
+    only ever see published files.
+
+    ``backpressure``: ``'block'`` (default) stalls save() while the
+    queue is full — every snapshot is published, the stall is the
+    backpressure signal; ``'skip'`` never stalls — a full queue drops
+    the NEW snapshot (counted in :attr:`skipped`) and the run keeps its
+    step time at the cost of sparser checkpoint cadence.
+
+    ``replicator`` (a
+    :class:`~chainermn_tpu.resilience.replica.PeerReplicator` built on
+    the same checkpointer) moves the ring push to the writer thread
+    too. The exchange is collective, so the plane owes exactly one
+    round per :meth:`save` CALL — including skipped ones — keeping
+    send/recv counts matched across ranks as long as every rank
+    triggers saves at the same cadence (the replicator's existing
+    contract). Do NOT also extend the replicator on the trainer.
+    """
+
+    def __init__(self, checkpointer: MultiNodeCheckpointer,
+                 max_pending: int = 1, backpressure: str = "block",
+                 replicator: Optional[Any] = None):
+        if backpressure not in ("block", "skip"):
+            raise ValueError(
+                f"backpressure={backpressure!r}: 'block' (stall save "
+                "until the writer catches up) or 'skip' (drop the new "
+                "snapshot, count it)")
+        if getattr(checkpointer, "async_write", False):
+            raise ValueError(
+                "AsyncSnapshotPlane owns the write pipeline — build the "
+                "checkpointer with async_write=False (double-queueing "
+                "through both would reorder publishes)")
+        if getattr(checkpointer, "backend", "npz") != "npz":
+            raise ValueError(
+                "AsyncSnapshotPlane is npz-backend territory (orbax is "
+                "natively async — use it directly)")
+        if max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1: {max_pending}")
+        self.ck = checkpointer
+        self.backpressure = backpressure
+        self.max_pending = max_pending
+        self.replicator = replicator
+        self._queue: queue.Queue = queue.Queue(maxsize=max_pending)
+        self._writer: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._owed_replica = 0
+        self._lock = threading.Lock()
+        # -- stats (CheckpointReport folds these into observations) ------
+        self.published = 0
+        self.skipped = 0
+        self.stall_ms_last = 0.0
+        self.stall_ms_total = 0.0
+        self.bytes_last = 0
+        self.bytes_total = 0
+        self.cadence_last = 0  # iterations since the previous save()
+        self._last_iter: Optional[int] = None
+
+    # -- step-thread half -------------------------------------------------
+
+    def save(self, state: Any, iteration: int,
+             host_state: Any = None) -> bool:
+        """Enqueue a snapshot of ``state`` for ``iteration``; returns
+        False when backpressure='skip' dropped it. The only work on this
+        thread is the device-side copy dispatch + offload kick — the
+        measured stall lands in :attr:`stall_ms_last`."""
+        self._raise_pending()
+        self._ensure_writer()
+        t0 = time.monotonic()
+        fn = os.path.join(
+            self.ck.path,
+            f"snapshot_iter_{iteration}.{self.ck.comm.inter_rank}")
+        # chaos: a congested device→host link stretches THIS stall
+        _chaos.on_offload(fn, "offload")
+        # device-side copy: the caller's next donating step may delete
+        # the original buffers the moment we return — the copy keeps its
+        # sharding and stays readable after the donation
+        snap = jax.tree_util.tree_map(
+            lambda l: jnp.copy(l) if isinstance(l, jax.Array) else l,
+            state)
+        for l in jax.tree_util.tree_leaves(snap):
+            if _is_device_sharded(l):
+                for s in _unique_shards(l):
+                    if hasattr(s.data, "copy_to_host_async"):
+                        s.data.copy_to_host_async()
+            elif hasattr(l, "copy_to_host_async"):
+                l.copy_to_host_async()
+        item = (snap, fn, int(iteration), host_state)
+        accepted = True
+        if self.backpressure == "skip":
+            try:
+                self._queue.put_nowait(item)
+            except queue.Full:
+                accepted = False
+                self.skipped += 1
+        else:
+            self._queue.put(item)
+        with self._lock:
+            if self.replicator is not None:
+                # one ring round owed per save CALL (even a skipped one):
+                # peers at the same cadence are already counting on it
+                self._owed_replica += 1
+        if self._last_iter is not None:
+            self.cadence_last = int(iteration) - self._last_iter
+        self._last_iter = int(iteration)
+        self.stall_ms_last = (time.monotonic() - t0) * 1000.0
+        self.stall_ms_total += self.stall_ms_last
+        return accepted
+
+    # -- writer-thread half -----------------------------------------------
+
+    def _ensure_writer(self):
+        if self._writer is not None and self._writer.is_alive():
+            return
+        self._stop.clear()
+        self._writer = threading.Thread(
+            target=self._writer_loop,
+            name=f"ckpt-plane-{self.ck.name}", daemon=True)
+        self._writer.start()
+        self._register_atexit()
+
+    def _register_atexit(self):
+        if getattr(self, "_atexit_done", False):
+            return
+        self._atexit_done = True
+        import atexit
+
+        def _close_at_exit():
+            try:
+                self.close()
+            except Exception as e:
+                warnings.warn(f"async snapshot plane at exit: {e}")
+
+        atexit.register(_close_at_exit)
+
+    def _writer_loop(self):
+        while True:
+            try:
+                item = self._queue.get(timeout=_POLL_S)
+            except queue.Empty:
+                # idle: settle replica rounds owed by SKIPPED saves (no
+                # item ever carried them) so peers' recvs don't starve
+                self._run_owed_replica()
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                if item is None:
+                    return
+                snap, fn, iteration, host_state = item
+                # chaos: stretch the offload→publish window (the SIGKILL
+                # drill lands its kill in here)
+                _chaos.on_offload(fn, "writer")
+                arrays, _ = _flatten_state(snap)  # blocks on the D2H
+                del snap
+                arrays["__world__"] = np.int64(self.ck.comm.inter_size)
+                if host_state is not None:
+                    arrays["__host_state__"] = np.frombuffer(
+                        pickle.dumps(host_state,
+                                     pickle.HIGHEST_PROTOCOL),
+                        np.uint8).copy()
+                self.ck._publish(
+                    arrays, fn,
+                    meta=self.ck._coverage_meta(arrays, iteration))
+                self.bytes_last = int(sum(
+                    getattr(a, "nbytes", 0) for a in arrays.values()))
+                self.bytes_total += self.bytes_last
+                self.published += 1
+            except BaseException as e:  # surfaced on next save/flush
+                self._error = e
+            finally:
+                self._run_owed_replica()
+                self._queue.task_done()
+
+    def _run_owed_replica(self):
+        while True:
+            with self._lock:
+                if self._owed_replica <= 0:
+                    return
+                self._owed_replica -= 1
+            try:
+                # drain=False: we ARE the writer thread — the
+                # checkpointer queue is not ours and a join here on the
+                # item being processed would self-deadlock
+                self.replicator.replicate(drain=False)
+            except Exception as e:
+                # best-effort by design, same as the replicator's store
+                warnings.warn(f"async replica push failed: {e}")
+
+    def _raise_pending(self):
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise RuntimeError(
+                f"async snapshot publish failed: {e!r}") from e
+
+    # -- barriers ----------------------------------------------------------
+
+    @property
+    def pending(self) -> int:
+        """Snapshots accepted but not yet published."""
+        return int(self._queue.unfinished_tasks)
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Wait until every accepted snapshot is published (or failed).
+        ``deadline_s`` is an ABSOLUTE monotonic deadline (same convention
+        as ``emergency_save``); returns False when it passed with work
+        still pending. Never raises — the emergency path must reach its
+        own synchronous write regardless."""
+        if deadline_s is None:
+            self._queue.join()
+            return True
+        with self._queue.all_tasks_done:
+            while self._queue.unfinished_tasks:
+                remaining = deadline_s - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._queue.all_tasks_done.wait(
+                    timeout=min(remaining, _POLL_S))
+        return True
+
+    def flush(self):
+        """Drain fully and raise any deferred publish error."""
+        self.drain()
+        self._raise_pending()
+
+    def close(self):
+        """Drain, settle owed replica rounds, and stop the writer — the
+        Trainer's finally-block calls this on every extension."""
+        if self._writer is not None and self._writer.is_alive():
+            self._queue.join()
+            self._stop.set()  # writer exits after settling owed rounds
+            self._writer.join()
+        self._writer = None
+        self._raise_pending()
+
+    # -- trainer integration ----------------------------------------------
+
+    def __call__(self, trainer):
+        """Trainer-extension protocol — drop-in for extending the
+        checkpointer itself, with the save moved off the step path."""
+        host_fn = getattr(trainer.updater, "host_state_dict", None)
+        self.save(trainer.updater.state, trainer.updater.iteration,
+                  host_state=host_fn() if callable(host_fn) else None)
+
+    def emergency_save(self, trainer,
+                       deadline_s: Optional[float] = None):
+        """Preemption/crash path: drain the in-flight snapshot within a
+        RESERVED slice of the grace window, then run the checkpointer's
+        synchronous last-chance save against the original deadline. One
+        absolute window covers both phases — the drain budget is
+        subtracted from the emergency-save deadline, never doubled."""
+        self.drain(reserve_grace(deadline_s))
+        return self.ck.emergency_save(trainer, deadline_s=deadline_s)
+
+    # -- read-side passthrough (drain-first) ------------------------------
+
+    def latest_common_iteration(self) -> Optional[int]:
+        self.drain()
+        return self.ck.latest_common_iteration()
+
+    def maybe_load(self, state: Any, iteration: Optional[int] = None,
+                   **kwargs):
+        self.drain()
+        return self.ck.maybe_load(state, iteration=iteration, **kwargs)
+
+    def resume(self, updater) -> Optional[int]:
+        self.drain()
+        return self.ck.resume(updater)
+
+    def load_host_state(self, iteration: int) -> Any:
+        self.drain()
+        return self.ck.load_host_state(iteration)
+
+    def protect(self, iteration: int) -> None:
+        self.ck.protect(iteration)

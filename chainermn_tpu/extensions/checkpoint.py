@@ -56,6 +56,24 @@ def _fsync_file(fn: str) -> None:
         os.close(fd)
 
 
+def read_manifest(fn: str) -> Optional[dict]:
+    """The sidecar JSON manifest published next to snapshot file ``fn``
+    (None when missing or torn). Beyond the integrity keys
+    (``format``/``sha256``/``bytes``), manifests written since the async
+    plane carry a COVERAGE map — ``iteration``, ``world``, ``axes`` (the
+    saving mesh's axis→size), per-leaf ``gshape``/``nshards``, and any
+    optimizer ``layout`` registered via
+    :meth:`MultiNodeCheckpointer.set_layout` — enough for offline
+    tooling (tools/ckpt.py) and the reshard planner
+    (checkpointing/reshard.py) to interpret the file set without
+    loading a single array."""
+    try:
+        with open(fn + ".json", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
 def _leaf_dict(state):
     """Pytree → flat {leaf_i: array} dict (orbax-friendly: a dict of
     arrays restores against any pytree with the same leaf order)."""
@@ -303,6 +321,9 @@ class MultiNodeCheckpointer:
         # otherwise delete the one file the election can still agree on.
         self._protected: set = set()
         self._elected: Optional[int] = None
+        #: optional optimizer shard-layout metadata (zero_layout_manifest
+        #: / fsdp_layout_manifest) folded into every published manifest
+        self.layout: Optional[dict] = None
         # every process writes its own snapshot file and may have its own
         # (non-shared) filesystem — each must create the directory
         os.makedirs(self.path, exist_ok=True)
@@ -360,8 +381,8 @@ class MultiNodeCheckpointer:
             try:
                 if item is None:
                     return
-                arrays, fn = item
-                self._publish(arrays, fn)
+                arrays, fn, meta = item
+                self._publish(arrays, fn, meta=meta)
             except BaseException as e:  # surfaced on next save/flush
                 self._write_error = e
             finally:
@@ -422,14 +443,20 @@ class MultiNodeCheckpointer:
 
     # -- save -----------------------------------------------------------
 
-    def _publish(self, arrays: dict, fn: str):
+    def _publish(self, arrays: dict, fn: str,
+                 meta: Optional[dict] = None):
         """Atomic, verifiable publish: write to a tmp name, fsync, rename
         into place, then publish a sidecar JSON manifest carrying the
         file's SHA-256 (itself tmp+fsync+renamed). A crash at any point
         leaves either the previous snapshot (tmp never renamed) or a
         data file whose manifest proves it intact — a torn or corrupted
         file FAILS verification and is excluded from the consensus
-        election instead of poisoning the restore."""
+        election instead of poisoning the restore.
+
+        ``meta`` (the coverage map from :meth:`_coverage_meta`) is folded
+        into the manifest under non-integrity keys — readers that only
+        verify (serving/weights.py) ignore it; the reshard planner and
+        tools/ckpt.py read the file set's geometry from it."""
         # chaos harness: pre-publish injection point — a full disk
         # (enospc) raises HERE with nothing published; slow_disk stalls
         _chaos.on_publish(fn)
@@ -439,7 +466,8 @@ class MultiNodeCheckpointer:
         sha = _sha256_file(tmp)
         size = os.path.getsize(tmp)
         os.replace(tmp, fn)  # atomic publish
-        manifest = {"format": 1, "sha256": sha, "bytes": size}
+        manifest = dict(meta or {})
+        manifest.update({"format": 1, "sha256": sha, "bytes": size})
         mtmp = fn + ".json.tmp"
         with open(mtmp, "w", encoding="utf-8") as fh:
             json.dump(manifest, fh)
@@ -453,6 +481,47 @@ class MultiNodeCheckpointer:
         # happens AFTER a fully valid publish, exactly like a bad disk
         _chaos.on_checkpoint(fn)
         self._gc()
+
+    def set_layout(self, layout: Optional[dict]) -> None:
+        """Attach optimizer shard-layout metadata (see
+        ``optimizers/zero.py:zero_layout_manifest`` /
+        ``fsdp_layout_manifest``) to every subsequently published
+        manifest, so offline tools can interpret flat ZeRO/FSDP leaves
+        without the live train step."""
+        self.layout = layout
+
+    def _coverage_meta(self, arrays: dict, iteration: int) -> dict:
+        """The manifest coverage map for one flattened snapshot: saving
+        iteration/world, the mesh's axis→size map, and per-leaf global
+        shape + local shard count. Host-side metadata only — nothing
+        here touches device arrays."""
+        leaves: Dict[str, dict] = {}
+        for k in arrays:
+            m = re.match(r"leaf_(\d+)_nshards$", k)
+            if m:
+                leaves[m.group(1)] = {
+                    "gshape": [int(d)
+                               for d in arrays[f"leaf_{m.group(1)}_gshape"]],
+                    "nshards": int(arrays[k])}
+                continue
+            m = re.match(r"leaf_(\d+)$", k)
+            if m:
+                leaves[m.group(1)] = {
+                    "gshape": [int(d) for d in np.shape(arrays[k])],
+                    "nshards": 0}
+        meta = {"iteration": int(iteration),
+                "world": int(self.comm.inter_size),
+                "leaves": leaves}
+        mesh = getattr(self.comm, "mesh", None)
+        if mesh is not None:
+            try:
+                meta["axes"] = {str(a): int(s) for a, s in zip(
+                    mesh.axis_names, np.shape(mesh.devices))}
+            except Exception:  # noqa: BLE001 — metadata is best-effort
+                pass
+        if self.layout is not None:
+            meta["layout"] = self.layout
+        return meta
 
     def _orbax_ck(self):
         if self._orbax is None:
@@ -501,11 +570,12 @@ class MultiNodeCheckpointer:
             arrays["__host_state__"] = np.frombuffer(
                 pickle.dumps(host_state, pickle.HIGHEST_PROTOCOL),
                 np.uint8).copy()
+        meta = self._coverage_meta(arrays, iteration)
         if self.async_write:
             self._ensure_writer()
-            self._queue.put((arrays, fn))
+            self._queue.put((arrays, fn, meta))
         else:
-            self._publish(arrays, fn)
+            self._publish(arrays, fn, meta=meta)
         return fn
 
     def _iters_on_disk(self) -> List[int]:
@@ -706,7 +776,8 @@ class MultiNodeCheckpointer:
             arrays["__host_state__"] = np.frombuffer(
                 pickle.dumps(host_state, pickle.HIGHEST_PROTOCOL),
                 np.uint8).copy()
-        self._publish(arrays, fn)
+        self._publish(arrays, fn,
+                      meta=self._coverage_meta(arrays, iteration))
         return fn
 
     def load_host_state(self, iteration: int) -> Any:
@@ -850,7 +921,8 @@ class MultiNodeCheckpointer:
         return None
 
     def maybe_load(self, state: Any, iteration: Optional[int] = None,
-                   allow_incomplete: bool = False):
+                   allow_incomplete: bool = False,
+                   leaf_resharder: Optional[Any] = None):
         """Restore ``state`` from the newest complete snapshot (or the given
         iteration). Returns (state, iteration) — unchanged state and None if
         nothing restorable exists.
@@ -869,7 +941,18 @@ class MultiNodeCheckpointer:
         for fully-replicated leaves any one surviving file holds the
         whole state, so a dead rank's missing file need not block the
         resume. Leave it False everywhere else: the gate is what keeps a
-        scale-up from silently loading wrong state."""
+        scale-up from silently loading wrong state.
+
+        ``leaf_resharder`` is the multi-axis escape hatch for leaves
+        whose saved GLOBAL shape differs from the template's — by
+        construction only world-dependent frames (the flat-bucket EF
+        residual stacks, optimizers/zero.py) hit this. It is called as
+        ``leaf_resharder(i, ref, saved_gshape, fetch_full)`` where
+        ``fetch_full()`` splices the full saved global array on host;
+        returning an ndarray of the template's shape re-scatters it onto
+        the template's sharding, returning None falls through to the
+        usual different-model error. See
+        ``checkpointing/reshard.py:default_leaf_resharder``."""
         self._drain()
         it = iteration if iteration is not None else self.latest_common_iteration()
         if it is None:
@@ -917,33 +1000,48 @@ class MultiNodeCheckpointer:
         try:
             for i, ref in enumerate(leaves):
                 if f"leaf_{i}_nshards" in keys:
-                    new_leaves.append(
-                        self._load_sharded_leaf(loaded, i, ref, peers))
+                    new_leaves.append(self._load_sharded_leaf(
+                        loaded, i, ref, peers,
+                        leaf_resharder=leaf_resharder))
                 elif f"leaf_{i}" in keys:
-                    new_leaves.append(self._plain_leaf(loaded, i, ref))
+                    new_leaves.append(self._plain_leaf(
+                        loaded, i, ref, leaf_resharder=leaf_resharder))
                 else:
-                    new_leaves.append(
-                        self._leaf_from_peers(i, ref, peers, it))
+                    new_leaves.append(self._leaf_from_peers(
+                        i, ref, peers, it,
+                        leaf_resharder=leaf_resharder))
         finally:
             peers.close()
         return jax.tree_util.tree_unflatten(treedef, new_leaves), it
 
-    def _leaf_from_peers(self, i: int, ref, peers, it: int):
+    def _leaf_from_peers(self, i: int, ref, peers, it: int,
+                         leaf_resharder=None):
         """Load leaf ``i`` when this process's own snapshot file lacks it
         (a rank that did not exist in the saving run)."""
         for z in peers:
             zk = set(getattr(z, "files", z))
             if f"leaf_{i}_nshards" in zk:
-                return self._load_sharded_leaf(z, i, ref, peers)
+                return self._load_sharded_leaf(
+                    z, i, ref, peers, leaf_resharder=leaf_resharder)
             if f"leaf_{i}" in zk:
-                return self._plain_leaf(z, i, ref)
+                return self._plain_leaf(
+                    z, i, ref, leaf_resharder=leaf_resharder)
         raise ValueError(
             f"snapshot iteration {it}: leaf {i} appears in no snapshot "
             "file — incomplete snapshot set")
 
     @staticmethod
-    def _plain_leaf(loaded, i: int, ref):
+    def _plain_leaf(loaded, i: int, ref, leaf_resharder=None):
         arr = loaded[f"leaf_{i}"]
+        if (leaf_resharder is not None and hasattr(ref, "shape")
+                and tuple(np.shape(arr)) != tuple(ref.shape)):
+            # a replicated-saved world-dependent frame (e.g. an EF stack
+            # snapshot from a 1-device run) restoring onto a different
+            # world: same escape hatch as the sharded path
+            out = leaf_resharder(i, ref, tuple(np.shape(arr)),
+                                 lambda: np.asarray(arr))
+            if out is not None:
+                arr = np.asarray(out)
         # honor the reference leaf's sharding only when it was actually
         # committed — device_put on an uncommitted default-device array
         # would PIN the restored leaf to one device and clash with
@@ -954,7 +1052,8 @@ class MultiNodeCheckpointer:
             return jnp.asarray(arr, ref.dtype)
         return arr
 
-    def _load_sharded_leaf(self, loaded, i: int, ref, peers):
+    def _load_sharded_leaf(self, loaded, i: int, ref, peers,
+                           leaf_resharder=None):
         """Reassemble a per-shard-saved leaf onto the template's sharding —
         each process device_puts only its own shards; no host ever sees the
         global array.
@@ -976,7 +1075,36 @@ class MultiNodeCheckpointer:
                 f"snapshot leaf {i} was saved device-sharded ({n} shards, "
                 f"global shape {gshape}) but the template leaf is not an "
                 "array")
+        def splice(targets):
+            sp = _SpliceTargets(targets, gshape, np.dtype(ref.dtype))
+            sp.consume(loaded, i)
+            if not sp.complete:
+                for z in peers:  # lazy: opened only when actually needed
+                    sp.consume(z, i)
+                    if sp.complete:
+                        break
+            sp.require_complete(i)
+            return sp.bufs
+
         if tuple(ref.shape) != gshape:
+            if leaf_resharder is not None:
+                import types
+
+                full = types.SimpleNamespace(
+                    index=tuple(slice(0, d) for d in gshape))
+                out = leaf_resharder(i, ref, gshape,
+                                     lambda: splice([full])[0])
+                if out is not None:
+                    out = np.asarray(out)
+                    if tuple(out.shape) != tuple(ref.shape):
+                        raise ValueError(
+                            f"leaf_resharder returned shape "
+                            f"{tuple(out.shape)} for leaf {i}; template "
+                            f"is {tuple(ref.shape)}")
+                    if (hasattr(ref, "sharding")
+                            and getattr(ref, "committed", False)):
+                        return jax.device_put(out, ref.sharding)
+                    return jnp.asarray(out, ref.dtype)
             hint = ""
             if (len(gshape) == 1 and len(ref.shape) == 1
                     and abs(gshape[0] - ref.shape[0]) < 256):
@@ -992,17 +1120,6 @@ class MultiNodeCheckpointer:
                 f"snapshot leaf {i}: saved global shape {gshape}, "
                 f"template is {tuple(ref.shape)} — different model, not "
                 f"a resharding{hint}")
-
-        def splice(targets):
-            sp = _SpliceTargets(targets, gshape, np.dtype(ref.dtype))
-            sp.consume(loaded, i)
-            if not sp.complete:
-                for z in peers:  # lazy: opened only when actually needed
-                    sp.consume(z, i)
-                    if sp.complete:
-                        break
-            sp.require_complete(i)
-            return sp.bufs
 
         if not _is_device_sharded(ref):
             # REPLICATED template: the caller asks for the whole leaf on

@@ -26,6 +26,7 @@ import optax
 from chainermn_tpu.comm.base import CommunicatorBase
 from chainermn_tpu.optimizers.zero import (  # noqa: F401
     fsdp_gather_params,
+    fsdp_layout_manifest,
     fsdp_scan_apply,
     fsdp_shardings,
     fsdp_stack_shardings,
@@ -33,6 +34,7 @@ from chainermn_tpu.optimizers.zero import (  # noqa: F401
     make_zero1_train_step,
     make_zero2_train_step,
     zero1_params,
+    zero_layout_manifest,
 )
 
 

@@ -1239,3 +1239,51 @@ def fsdp_gather_params(state):
         if hasattr(l, "copy_to_host_async"):
             l.copy_to_host_async()
     return jax.tree_util.tree_map(lambda l: np.asarray(l), params)
+
+
+def zero_layout_manifest(params, comm, bucket_bytes=None) -> dict:
+    """Shard-layout metadata for checkpoint manifests: the flat-frame
+    geometry of a ZeRO-1/2 state — padding quantum, world, per-bucket
+    padded lengths, and the ``(n, padded)`` EF-frame shapes — so
+    offline tooling (tools/ckpt.py) and the reshard planner
+    (checkpointing/reshard.py) can interpret flat leaves without the
+    live train step. Attach via
+    ``checkpointer.set_layout(zero_layout_manifest(params, comm))``;
+    pure host metadata, no device computation."""
+    n = comm.size
+    total = sum(int(np.prod(jnp.shape(l), initial=1))
+                for l in jax.tree_util.tree_leaves(params))
+    if bucket_bytes is None:
+        padded = _padded_size(total, n)
+        return {"kind": "zero-flat", "quantum": 256, "n": n,
+                "total": total, "padded": padded,
+                "ef_frames": [[n, padded]]}
+    layout = _BucketLayout(params, n, bucket_bytes)
+    return {"kind": "zero-bucketed", "quantum": 256, "n": n,
+            "bucket_bytes": int(bucket_bytes),
+            "totals": [int(t) for t in layout.totals],
+            "padded": [int(p) for p in layout.padded],
+            "ef_frames": [[n, int(p)] for p in layout.padded]}
+
+
+def fsdp_layout_manifest(params, comm, param_shardings=None) -> dict:
+    """Shard-layout metadata for FSDP states: per-leaf path, global
+    shape, and partition spec under the first-divisible-dim rule (or
+    the explicit ``param_shardings``). Same manifest slot as
+    :func:`zero_layout_manifest` (``checkpointer.set_layout``)."""
+    pshard = param_shardings if param_shardings is not None \
+        else fsdp_shardings(params, comm)
+    rows = []
+    named = jax.tree_util.tree_flatten_with_path(params)[0]
+    shardings = jax.tree_util.tree_leaves(pshard)
+    for (path, leaf), sh in zip(named, shardings):
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        spec = []
+        for el in tuple(getattr(sh, "spec", ()) or ()):
+            spec.append(list(el) if isinstance(el, tuple)
+                        else (None if el is None else str(el)))
+        rows.append({"path": key,
+                     "shape": [int(d) for d in jnp.shape(leaf)],
+                     "spec": spec})
+    return {"kind": "fsdp", "n": comm.size, "leaves": rows}

@@ -46,7 +46,14 @@ Hook points (all no-ops when the env var is unset):
 * :func:`on_checkpoint` — called by the checkpointer after publishing a
   snapshot file, with its path;
 * :func:`on_publish` — called by the checkpointer right BEFORE writing a
-  snapshot file (fires ``enospc``/``slow_disk``).
+  snapshot file (fires ``enospc``/``slow_disk``);
+* :func:`on_offload` — called by the async snapshot plane
+  (``checkpointing/async_plane.py``) at its two pipeline stages:
+  ``stage="offload"`` on the step thread right before the device→host
+  copy is kicked off (fires ``slow_offload``), and ``stage="writer"`` on
+  the background writer thread right before serialization + publish
+  (fires ``stall_writer`` — widening the offload→publish window a crash
+  can land in, which is exactly what the SIGKILL drill needs).
 """
 
 from __future__ import annotations
@@ -79,6 +86,14 @@ FAULT_KINDS: Dict[str, str] = {
                "match=SUBSTRING[,rank=R|*][,after=K][,prob=P][,seed=S]"),
     "slow_disk": ("sleep before a matching snapshot publish: "
                   "ms=M,match=SUBSTRING[,rank=R|*][,prob=P][,seed=S]"),
+    "slow_offload": ("sleep on the STEP thread before the async plane's "
+                     "device-to-host offload (a congested PCIe/ICI "
+                     "link): ms=M,match=SUBSTRING[,rank=R|*][,after=K]"
+                     "[,prob=P][,seed=S]"),
+    "stall_writer": ("sleep on the async plane's WRITER thread before "
+                     "serialize+publish (stretches the offload→publish "
+                     "window): ms=M,match=SUBSTRING[,rank=R|*][,after=K]"
+                     "[,prob=P][,seed=S]"),
 }
 
 #: every fault kind also accepts ``run=K`` — fire only in supervised
@@ -183,11 +198,13 @@ def parse_spec(spec: str) -> List[Fault]:
                 f"bad field in chaos clause {clause!r}: {e}") from e
         if fault.kind == "kill" and fault.step is None:
             raise ValueError(f"kill fault needs step=N: {clause!r}")
-        if (fault.kind in ("corrupt", "truncate", "enospc", "slow_disk")
+        if (fault.kind in ("corrupt", "truncate", "enospc", "slow_disk",
+                           "slow_offload", "stall_writer")
                 and not fault.match):
             raise ValueError(
                 f"{fault.kind} fault needs match=SUBSTRING: {clause!r}")
-        if fault.kind in ("delay_rpc", "slow_disk") and fault.ms is None:
+        if (fault.kind in ("delay_rpc", "slow_disk", "slow_offload",
+                           "stall_writer") and fault.ms is None):
             raise ValueError(f"{fault.kind} fault needs ms=M: {clause!r}")
         if not (0.0 <= fault.prob <= 1.0):
             raise ValueError(f"prob must be in [0, 1]: {clause!r}")
@@ -337,6 +354,37 @@ class ChaosPlan:
                     errno.ENOSPC,
                     f"No space left on device (chaos enospc: {base})")
 
+    #: pipeline stage → fault kind for :meth:`on_offload`
+    _OFFLOAD_STAGES = {"offload": "slow_offload", "writer": "stall_writer"}
+
+    def on_offload(self, path: str, stage: str,
+                   rank: Optional[int] = None) -> None:
+        """Async-plane hook: ``stage`` names the pipeline point —
+        ``"offload"`` (step thread, before the device→host copy) fires
+        ``slow_offload``; ``"writer"`` (writer thread, before
+        serialize+publish) fires ``stall_writer``."""
+        kind = self._OFFLOAD_STAGES.get(stage)
+        if kind is None:
+            raise ValueError(f"unknown offload stage {stage!r} — known: "
+                             + ", ".join(sorted(self._OFFLOAD_STAGES)))
+        rank = _own_rank() if rank is None else rank
+        base = os.path.basename(path)
+        for f in self.faults:
+            if f.kind != kind:
+                continue
+            if not f.applies_to_rank(rank) or not f.applies_to_run():
+                continue
+            if f.match not in path and f.match not in base:
+                continue
+            if f._skipped < f.after:
+                f._skipped += 1
+                continue
+            if not f.roll():
+                continue
+            f.fired += 1
+            self.log.append(f"{f.kind} path={base}")
+            self._sleep((f.ms or 0) / 1000.0)
+
 
 _plan: Optional[ChaosPlan] = None
 _plan_spec: Optional[str] = None
@@ -384,3 +432,10 @@ def on_publish(path: str) -> None:
         plan = chaos_from_env()
         if plan is not None:
             plan.on_publish(path)
+
+
+def on_offload(path: str, stage: str) -> None:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            plan.on_offload(path, stage)

@@ -37,17 +37,23 @@ What shrinking preserves and what it does not:
   world size into a constant must multiply by
   :attr:`ElasticPlan.averaging_rescale`.
 
-Topology guard: only a single (data-parallel) mesh axis is supported.
-Tensor/pipeline-parallel shards are rank-position-dependent — dropping
-a rank re-maps which parameters live where, and re-splicing them onto
-a smaller axis would produce silently wrong math; those topologies
-raise :class:`ElasticTopologyError` instead.
+Multi-axis meshes: snapshots restore by GLOBAL INDEX (the checkpointer
+splices saved shard ranges onto whatever the template's sharding asks
+for), so a tensor/pipeline-parallel mesh change is index-correct by
+construction. The one genuinely world-DEPENDENT leaf class — the
+flat-bucket error-feedback residual stacks from ``optimizers/zero.py``,
+saved as ``(n_ranks, padded)`` frames — is regrouped by the
+manifest-driven reshard path (``checkpointing/reshard.py``); such plans
+come back as ``action="reshard"``. :class:`ElasticTopologyError`
+(historically raised for any multi-axis mesh) is retained for
+compatibility with callers that catch it, but the planner no longer
+raises it.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Any, List, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
 
 from chainermn_tpu.datasets import scatter_dataset
 
@@ -57,17 +63,24 @@ class ElasticResumeError(RuntimeError):
 
 
 class ElasticTopologyError(ElasticResumeError):
-    """The mesh topology does not support elastic resharding."""
+    """The mesh topology does not support elastic resharding.
+
+    Retained for compatibility: since the manifest-driven reshard path
+    (checkpointing/reshard.py) landed, multi-axis meshes plan as
+    ``action="reshard"`` instead of raising this."""
 
 
 @dataclass
 class ElasticPlan:
     """The decision :func:`plan_elastic_resume` reached.
 
-    ``action`` is ``resume`` / ``shrink`` / ``give_up``;
+    ``action`` is ``resume`` / ``shrink`` / ``reshard`` / ``give_up``;
     ``averaging_rescale`` is ``saved_world / new_world`` — multiply
     into any loss/grad normalization that baked in the OLD world size
-    (steps averaging through the live communicator need no fix)."""
+    (steps averaging through the live communicator need no fix).
+    ``saved_axes``/``new_axes`` carry the mesh axis→size maps for
+    ``reshard`` plans (from the coverage manifest and the live mesh;
+    None when unknowable)."""
 
     action: str
     iteration: Optional[int]
@@ -75,6 +88,8 @@ class ElasticPlan:
     new_world: int
     reason: str
     averaging_rescale: float = 1.0
+    saved_axes: Optional[Dict[str, int]] = field(default=None)
+    new_axes: Optional[Dict[str, int]] = field(default=None)
 
     def describe(self) -> str:
         return (f"elastic plan: {self.action} at iteration "
@@ -82,16 +97,15 @@ class ElasticPlan:
                 f"current {self.new_world}) — {self.reason}")
 
 
-def _check_topology(comm) -> None:
-    axes = tuple(getattr(comm, "axis_names", ()) or ())
-    if len(axes) > 1:
-        raise ElasticTopologyError(
-            f"shrink-to-fit supports a single data-parallel mesh axis; "
-            f"this communicator spans axes {axes}. Tensor/pipeline "
-            "shards are rank-position-dependent — re-splicing them onto "
-            "a different world size would be silently wrong math, so "
-            "elastic resume refuses. Restore at the original world "
-            "size, or re-partition from a converted full checkpoint.")
+def _axes_total(axes: Optional[Dict[str, int]]) -> Optional[int]:
+    """Total device count spanned by an axis→size map (None when
+    unknown)."""
+    if not axes:
+        return None
+    n = 1
+    for v in axes.values():
+        n *= int(v)
+    return n
 
 
 def _recoverable_iters(ck) -> List[int]:
@@ -122,11 +136,12 @@ def plan_elastic_resume(ck) -> ElasticPlan:
     """Elect over the CURRENT world and classify the resume.
 
     Collective: every surviving process must call it (the inventory is
-    allgathered). Raises :class:`ElasticTopologyError` on unsupported
-    meshes; never raises for "nothing found" — that returns a
-    ``give_up`` plan so the caller can report and exit."""
+    allgathered). Never raises for "nothing found" — that returns a
+    ``give_up`` plan so the caller can report and exit. A snapshot
+    whose MESH differs from the current one (multi-axis reshape, tile
+    re-layout) plans as ``action="reshard"`` — executed through the
+    manifest-driven path in ``checkpointing/reshard.py``."""
     comm = ck.comm
-    _check_topology(comm)
     world = comm.inter_size
     ck._drain()
     ck._pre_election_barrier()
@@ -145,10 +160,29 @@ def plan_elastic_resume(ck) -> ElasticPlan:
     it = max(common)
     ck._elected = it  # pin against GC, same as the strict election
     saved = ck._saved_world(it)
+    from chainermn_tpu.checkpointing.reshard import mesh_axes, saved_axes
+
+    cur_axes = mesh_axes(comm)
+    sv_axes = saved_axes(ck, it)
+    axes_changed = (sv_axes is not None and cur_axes is not None
+                    and sv_axes != cur_axes)
+    multi = len(tuple(getattr(comm, "axis_names", ()) or ())) > 1
+    if axes_changed or (multi and saved is not None and saved != world):
+        sv_n, cur_n = _axes_total(sv_axes), _axes_total(cur_axes)
+        rescale = (sv_n / cur_n if sv_n and cur_n
+                   else (saved / world if saved else 1.0))
+        return ElasticPlan(
+            action="reshard", iteration=it, saved_world=saved,
+            new_world=world, averaging_rescale=rescale,
+            saved_axes=sv_axes, new_axes=cur_axes,
+            reason=f"snapshot mesh {sv_axes} differs from the current "
+                   f"mesh {cur_axes} — re-splicing through the "
+                   "manifest-driven reshard path "
+                   "(checkpointing/reshard.py)")
     if saved is None or saved == world:
         return ElasticPlan(
             action="resume", iteration=it, saved_world=saved,
-            new_world=world,
+            new_world=world, saved_axes=sv_axes, new_axes=cur_axes,
             reason="saved world matches the current world"
                    if saved == world else
                    "saved world unknown (pre-marker snapshot) — "
@@ -157,6 +191,7 @@ def plan_elastic_resume(ck) -> ElasticPlan:
     return ElasticPlan(
         action="shrink", iteration=it, saved_world=saved,
         new_world=world, averaging_rescale=rescale,
+        saved_axes=sv_axes, new_axes=cur_axes,
         reason=f"snapshot was saved by {saved} process(es), "
                f"{world} survive — re-splicing shards onto the "
                "smaller mesh")
@@ -178,12 +213,26 @@ def elastic_resume(ck, updater, global_dataset: Any = None,
     plan = plan_elastic_resume(ck)
     if plan.action == "give_up":
         raise ElasticResumeError(plan.describe())
+    resharder = None
+    if plan.action == "reshard":
+        from chainermn_tpu.checkpointing.reshard import \
+            default_leaf_resharder
+
+        resharder = default_leaf_resharder
+    allow_inc = (plan.action == "shrink"
+                 or (plan.action == "reshard"
+                     and plan.saved_world is not None
+                     and plan.saved_world > plan.new_world))
     state, it = ck.maybe_load(updater.state, iteration=plan.iteration,
-                              allow_incomplete=(plan.action == "shrink"))
+                              allow_incomplete=allow_inc,
+                              leaf_resharder=resharder)
     updater.state = state
     updater.iteration = it
-    if plan.action == "resume":
-        # the normal shape-preserving path: exact host-state restore
+    same_world = plan.saved_world in (None, plan.new_world)
+    if plan.action == "resume" or (plan.action == "reshard"
+                                   and same_world):
+        # shape-preserving host side (a mesh reshape within the same
+        # process count leaves the iterator untouched): exact restore
         host = ck.load_host_state(it)
         restore = getattr(updater, "load_host_state", None)
         if host is not None and callable(restore):

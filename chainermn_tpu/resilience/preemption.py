@@ -119,6 +119,28 @@ class PreemptionGuard:
         self._installed = ()
 
 
+def reserve_grace(deadline_s: Optional[float], fraction: float = 0.5,
+                  floor_s: float = 0.0) -> Optional[float]:
+    """Split one absolute (monotonic) emergency deadline between a drain
+    phase and the final synchronous write.
+
+    The async snapshot plane must drain its in-flight publish before the
+    last-chance ``emergency_save`` runs — but both phases share ONE grace
+    window (``CHAINERMN_TPU_PREEMPTION_GRACE_S``): the drain budget is
+    SUBTRACTED from the window, never added on top. Returns the earlier
+    deadline the drain phase must beat, reserving ``fraction`` of the
+    remaining window (at least ``floor_s`` seconds) for the write; the
+    caller keeps using the ORIGINAL ``deadline_s`` for the write itself.
+    None passes through (no deadline → unbounded drain, the crash-path
+    semantics)."""
+    if deadline_s is None:
+        return None
+    now = time.monotonic()
+    remaining = max(0.0, deadline_s - now)
+    reserve = max(floor_s, remaining * fraction)
+    return max(now, deadline_s - reserve)
+
+
 def grace_seconds() -> float:
     raw = os.environ.get(_ENV_GRACE)
     if not raw:

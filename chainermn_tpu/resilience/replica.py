@@ -164,17 +164,23 @@ class PeerReplicator:
 
     # -- trainer-extension protocol --------------------------------------
 
-    def replicate(self) -> Optional[str]:
+    def replicate(self, drain: bool = True) -> Optional[str]:
         """One ring exchange; returns the stored replica path (None when
         the neighbor had nothing new). Collective: every rank must call
-        with the same cadence."""
+        with the same cadence.
+
+        ``drain=False`` skips the checkpointer-queue join — the async
+        snapshot plane (checkpointing/async_plane.py) calls from its OWN
+        writer thread right after publishing, where a drain would
+        self-deadlock on the item being processed."""
         world = self.comm.inter_size
         if world < 2:
             return None
         # published files only — an in-flight async write is invisible
         # and a FAILED one must not block the exchange (peers are
         # already waiting in recv)
-        self.ck._drain()
+        if drain:
+            self.ck._drain()
         right = (self.comm.inter_rank + 1) % world
         left = (self.comm.inter_rank - 1) % world
         # KV-store p2p: the put returns without waiting on the peer, so

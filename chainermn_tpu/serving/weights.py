@@ -25,6 +25,13 @@ preemption into a visible outage. This module is the
 * ``pull_weights`` — the in-process fast path: fetch the params from a
   live peer over the communicator object plane (``bcast_obj``), for
   replicas joining while the fleet is up.
+* ``load_snapshot_weights`` — warm-reload straight from the TRAINING
+  checkpoint directory: the async snapshot plane
+  (``checkpointing/async_plane.py``) publishes ``snapshot_iter_<N>``
+  files under the same manifest grammar, so a serving replica can come
+  back hot from the newest verified training snapshot without a
+  separate weight-publish step (the ``leaf_{i}``/``leaf_{i}_s<k>``
+  shard keys are reassembled against a template pytree).
 """
 
 from __future__ import annotations
@@ -39,7 +46,8 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 __all__ = ["publish_weights", "load_weights", "pull_weights",
-           "weight_candidates", "WeightsError"]
+           "weight_candidates", "load_snapshot_weights",
+           "snapshot_candidates", "WeightsError"]
 
 _MANIFEST_FORMAT = 1
 #: format 2 = blockwise-quantized payload; the manifest's ``codec`` key
@@ -233,6 +241,104 @@ def _unflatten_like(like, flat: dict):
                 f"{arr.shape} vs {np.shape(leaf)}")
         leaves.append(jnp.asarray(arr))
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def snapshot_candidates(ckpt_dir: str,
+                        iteration: Optional[int] = None) -> List[str]:
+    """Training-snapshot files under a checkpoint directory (primaries
+    plus ``replicas/``), filtered to ``iteration`` when given, sorted
+    newest iteration first (rank order within an iteration). No
+    verification here — :func:`load_snapshot_weights` verifies each
+    candidate's manifest before touching it."""
+    import re
+
+    pat = re.compile(r"snapshot_iter_(\d+)\.(\d+)$")
+    found = []
+    for d in (ckpt_dir, os.path.join(ckpt_dir, "replicas")):
+        if not os.path.isdir(d):
+            continue
+        for f in os.listdir(d):
+            m = pat.match(f)
+            fn = os.path.join(d, f)
+            if not m or os.path.isdir(fn):
+                continue
+            it = int(m.group(1))
+            if iteration is not None and it != iteration:
+                continue
+            found.append((it, int(m.group(2)), fn))
+    found.sort(key=lambda t: (-t[0], t[1]))
+    return [fn for _, _, fn in found]
+
+
+def load_snapshot_weights(ckpt_dir: str, like: Any,
+                          iteration: Optional[int] = None):
+    """Warm-reload serving weights from the newest VERIFIED training
+    snapshot under ``ckpt_dir`` (the async snapshot plane's output —
+    same manifest grammar as :func:`publish_weights`, so the same
+    verification applies). ``like`` is the params template pytree; the
+    snapshot's ``leaf_{i}`` arrays and ``leaf_{i}_s<k>`` shard pieces
+    are reassembled against it BY FLATTEN ORDER — pass the exact
+    subtree that was saved (for training states that bundle optimizer
+    state, save/publish the params subtree for serving, or use
+    ``fsdp_gather_params`` first). Returns ``(params, source_path)``;
+    raises :class:`WeightsError` when nothing verifies or the template
+    does not match."""
+    import jax
+    import jax.numpy as jnp
+
+    last_err = None
+    for cand in snapshot_candidates(ckpt_dir, iteration=iteration):
+        if _verify(cand) is None:
+            continue
+        try:
+            with np.load(cand, allow_pickle=False) as z:
+                keys = set(z.files)
+                leaves, treedef = jax.tree_util.tree_flatten(like)
+                out = []
+                for i, ref in enumerate(leaves):
+                    if f"leaf_{i}" in keys:
+                        arr = z[f"leaf_{i}"]
+                    elif f"leaf_{i}_nshards" in keys:
+                        gshape = tuple(int(d)
+                                       for d in z[f"leaf_{i}_gshape"])
+                        n = int(z[f"leaf_{i}_nshards"])
+                        first = z[f"leaf_{i}_s0"]
+                        arr = np.empty(gshape, first.dtype)
+                        vol = 0
+                        for k in range(n):
+                            idx = np.asarray(z[f"leaf_{i}_idx{k}"])
+                            sl = tuple(
+                                slice(int(a),
+                                      int(b) if b != -1 else d)
+                                for (a, b), d in zip(idx, gshape))
+                            arr[sl] = z[f"leaf_{i}_s{k}"]
+                            vol += int(np.prod(
+                                [s.stop - s.start for s in sl],
+                                initial=1))
+                        if vol != int(np.prod(gshape, initial=1)):
+                            raise WeightsError(
+                                f"snapshot {cand} holds only part of "
+                                f"leaf {i} ({vol} of "
+                                f"{int(np.prod(gshape, initial=1))} "
+                                "elements) — a multi-process sharded "
+                                "snapshot; gather before publishing")
+                    else:
+                        raise WeightsError(
+                            f"snapshot {cand} has no leaf {i} — the "
+                            "template does not match the saved pytree "
+                            "(per-rank sharded snapshots need every "
+                            "rank's file; this loader reads ONE file)")
+                    if tuple(arr.shape) != tuple(np.shape(ref)):
+                        raise WeightsError(
+                            f"snapshot leaf {i} shape {arr.shape} vs "
+                            f"template {np.shape(ref)}")
+                    out.append(jnp.asarray(arr))
+            return jax.tree_util.tree_unflatten(treedef, out), cand
+        except WeightsError as e:
+            last_err = e  # try the next candidate (older/replica)
+            continue
+    raise last_err or WeightsError(
+        f"no verified training snapshot under {ckpt_dir!r}")
 
 
 def pull_weights(comm, params: Optional[Any], root: int = 0):

@@ -117,6 +117,47 @@ class TuningReport:
         trainer.observation["tuning/strategy"] = plan.strategy
 
 
+class CheckpointReport:
+    """Surfaces the async snapshot plane's pipeline stats
+    (docs/fault_tolerance.md#checkpoint-cadence) beside LogReport.
+
+    ``plane`` is a
+    :class:`~chainermn_tpu.checkpointing.AsyncSnapshotPlane`. On the
+    first call it prints the pipeline configuration once; on every call
+    it folds ``ckpt/stall_ms`` (the step-thread save stall —
+    the number the async plane exists to shrink), ``ckpt/bytes``,
+    ``ckpt/cadence`` (iterations between saves), ``ckpt/pending``,
+    ``ckpt/published``, and ``ckpt/skipped`` (backpressure drops) into
+    ``trainer.observation`` so LogReport/PrintReport and bench runs
+    pick them up. Host-side counters only — nothing here touches the
+    device or the writer thread.
+    """
+
+    def __init__(self, plane, quiet: bool = False):
+        self.plane = plane
+        self.quiet = quiet
+        self._printed = False
+
+    def __call__(self, trainer):
+        p = self.plane
+        if not self._printed and not self.quiet:
+            print(f"ckpt plane: backpressure="
+                  f"{getattr(p, 'backpressure', 'sync')} "
+                  f"max_pending={getattr(p, 'max_pending', 0)} "
+                  f"replicator="
+                  f"{'on' if getattr(p, 'replicator', None) else 'off'}",
+                  flush=True)
+            self._printed = True
+        obs = trainer.observation
+        obs["ckpt/stall_ms"] = round(
+            float(getattr(p, "stall_ms_last", 0.0)), 3)
+        obs["ckpt/bytes"] = int(getattr(p, "bytes_last", 0))
+        obs["ckpt/cadence"] = int(getattr(p, "cadence_last", 0))
+        obs["ckpt/pending"] = int(getattr(p, "pending", 0))
+        obs["ckpt/published"] = int(getattr(p, "published", 0))
+        obs["ckpt/skipped"] = int(getattr(p, "skipped", 0))
+
+
 class PrintReport:
     def __init__(self, keys: List[str]):
         self.keys = keys

@@ -23,7 +23,7 @@ def _only(findings, rule):
 
 def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
-            "DL107", "DL108", "DL201", "DL202", "DL203",
+            "DL107", "DL108", "DL109", "DL201", "DL202", "DL203",
             "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
@@ -747,3 +747,87 @@ def test_dl108_suppression_with_rationale():
             step(toks[:, :t])  # dlint: disable=DL108
     """
     assert _only(_lint(src), "DL108") == []
+
+
+# ---------------------------------------------------------------------------
+# DL109 — blocking-save-in-step-loop
+# ---------------------------------------------------------------------------
+
+
+def test_dl109_flags_sync_save_in_jitted_step_loop():
+    src = """\
+    import jax
+    import chainermn_tpu
+
+    def train(state, batches, comm):
+        ck = chainermn_tpu.create_multi_node_checkpointer("job", comm)
+        step = jax.jit(lambda s, b: s)
+        for i, b in enumerate(batches):
+            state = step(state, b)
+            ck.save(state, i)
+    """
+    fs = _only(_lint(src), "DL109")
+    assert len(fs) == 1
+    assert fs[0].line == 9
+    assert "ck.save" in fs[0].message
+    assert "AsyncSnapshotPlane" in fs[0].message
+    assert "docs/static_analysis.md#dl109" in fs[0].message
+
+
+def test_dl109_flags_save_beside_updater_update():
+    src = """\
+    from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
+
+    def run(updater, comm, n):
+        ck = MultiNodeCheckpointer("job", comm)
+        while updater.iteration < n:
+            updater.update()
+            ck.save(updater.state, updater.iteration)
+    """
+    fs = _only(_lint(src), "DL109")
+    assert len(fs) == 1
+    assert fs[0].line == 7
+
+
+def test_dl109_clean_when_saving_through_the_plane():
+    src = """\
+    import jax
+    from chainermn_tpu.checkpointing import AsyncSnapshotPlane
+    from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
+
+    def train(state, batches, comm):
+        plane = AsyncSnapshotPlane(MultiNodeCheckpointer("job", comm))
+        step = jax.jit(lambda s, b: s)
+        for i, b in enumerate(batches):
+            state = step(state, b)
+            plane.save(state, i)
+    """
+    assert _only(_lint(src), "DL109") == []
+
+
+def test_dl109_clean_on_save_loop_without_step_dispatch():
+    src = """\
+    import chainermn_tpu
+
+    def convert(snapshots, comm):
+        ck = chainermn_tpu.create_multi_node_checkpointer("job", comm)
+        for i, s in enumerate(snapshots):   # offline conversion, no step
+            ck.save(s, i)
+    """
+    assert _only(_lint(src), "DL109") == []
+
+
+def test_dl109_suppression_with_rationale():
+    src = """\
+    import jax
+    import chainermn_tpu
+
+    def bench(state, batches, comm):
+        ck = chainermn_tpu.create_multi_node_checkpointer("job", comm)
+        step = jax.jit(lambda s, b: s)
+        for i, b in enumerate(batches):
+            state = step(state, b)
+            # fixture: measuring the sync stall is the point
+            ck.save(state, i)  # dlint: disable=DL109
+    """
+    assert _only(_lint(src), "DL109") == []

@@ -1,0 +1,4 @@
+"""Async snapshot plane + manifest-driven resharding tests (ISSUE 9).
+
+Runs on the conftest's 8-virtual-CPU-device mesh; the multi-axis
+reshard tests carve 2x4 and 2x2 meshes out of the same 8 devices."""

@@ -1,0 +1,342 @@
+"""AsyncSnapshotPlane: bitwise parity with the sync save, donation
+safety, backpressure (block vs skip), drain deadlines, emergency-save
+grace accounting, deferred-error surfacing, and the SIGKILL crash
+window between offload and publish (subprocess)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.checkpointing import AsyncSnapshotPlane
+from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
+from chainermn_tpu.resilience import chaos
+from chainermn_tpu.resilience.preemption import reserve_grace
+
+
+@pytest.fixture()
+def comm():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _sharded(comm, shape, offset=0.0):
+    x = jnp.arange(float(np.prod(shape)), dtype=jnp.float32)
+    x = x.reshape(shape) + offset
+    return jax.device_put(
+        x, NamedSharding(comm.mesh, P(comm.mesh.axis_names[0])))
+
+
+def _state(comm):
+    return {"w": _sharded(comm, (8, 4)),
+            "b": jnp.arange(3.0, dtype=jnp.float32),
+            "h": np.arange(5, dtype=np.int32)}
+
+
+# -- construction contract ----------------------------------------------
+
+
+def test_rejects_async_write_checkpointer(comm, tmp_path):
+    ck = MultiNodeCheckpointer("job", comm, path=str(tmp_path),
+                               async_write=True)
+    with pytest.raises(ValueError, match="async_write"):
+        AsyncSnapshotPlane(ck)
+
+
+def test_rejects_bad_backpressure_and_pending(comm, tmp_path):
+    ck = MultiNodeCheckpointer("job", comm, path=str(tmp_path))
+    with pytest.raises(ValueError, match="backpressure"):
+        AsyncSnapshotPlane(ck, backpressure="drop")
+    with pytest.raises(ValueError, match="max_pending"):
+        AsyncSnapshotPlane(ck, max_pending=0)
+
+
+# -- bitwise parity with the sync path ----------------------------------
+
+
+def test_async_save_bitwise_equals_sync(comm, tmp_path):
+    state = _state(comm)
+    ck_sync = MultiNodeCheckpointer("sync", comm, path=str(tmp_path))
+    ck_sync.save(state, iteration=3, host_state={"pos": 7})
+
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("async", comm, path=str(tmp_path)))
+    plane.save(state, iteration=3, host_state={"pos": 7})
+    plane.flush()
+
+    a = np.load(tmp_path / "sync" / "snapshot_iter_3.0",
+                allow_pickle=False)
+    b = np.load(tmp_path / "async" / "snapshot_iter_3.0",
+                allow_pickle=False)
+    assert set(a.files) == set(b.files)
+    for k in a.files:
+        assert np.array_equal(a[k], b[k]), k
+    plane.close()
+
+
+def test_round_trip_through_the_plane(comm, tmp_path):
+    state = _state(comm)
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("job", comm, path=str(tmp_path)))
+    plane.save(state, iteration=2, host_state={"rng": 11})
+    # read-side drains first — no explicit flush needed
+    assert plane.latest_common_iteration() == 2
+    template = {"w": jnp.zeros_like(state["w"]),
+                "b": jnp.zeros(3, jnp.float32),
+                "h": np.zeros(5, np.int32)}
+    loaded, it = plane.maybe_load(template)
+    assert it == 2
+    assert np.array_equal(np.asarray(loaded["w"]),
+                          np.asarray(state["w"]))
+    assert plane.load_host_state(2) == {"rng": 11}
+    plane.close()
+
+
+def test_resume_bit_for_bit_vs_uninterrupted(comm, tmp_path):
+    """Losses after resuming from the async snapshot must be bit-for-bit
+    identical to the uninterrupted run — including with a DONATING step
+    that deletes the saved buffers right after save() returns."""
+    sharding = NamedSharding(comm.mesh, P(comm.mesh.axis_names[0]))
+
+    @jax.jit
+    def loss_of(w):
+        return jnp.float32(jnp.mean(w * w))
+
+    step = jax.jit(lambda w: w * 1.0001 + 0.01, donate_argnums=0)
+
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("job", comm, path=str(tmp_path)))
+    w = _sharded(comm, (8, 4))
+    ref_losses = []
+    for i in range(1, 11):
+        w = step(w)
+        ref_losses.append(float(loss_of(w)))  # per-iter sync (1-core rule)
+        if i == 5:
+            plane.save({"w": w}, iteration=5)
+    plane.flush()
+
+    template = {"w": jax.device_put(jnp.zeros((8, 4), jnp.float32),
+                                    sharding)}
+    loaded, it = plane.maybe_load(template, iteration=5)
+    assert it == 5
+    w2 = loaded["w"]
+    resumed = []
+    for _ in range(6, 11):
+        w2 = step(w2)
+        resumed.append(float(loss_of(w2)))
+    assert resumed == ref_losses[5:]
+    plane.close()
+
+
+# -- backpressure -------------------------------------------------------
+
+
+def test_backpressure_skip_drops_and_counts(comm, tmp_path, monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR,
+                       "stall_writer@ms=500,match=snapshot_iter")
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("job", comm, path=str(tmp_path)),
+        max_pending=1, backpressure="skip")
+    state = _state(comm)
+    assert plane.save(state, iteration=1) is True
+    time.sleep(0.15)  # writer picked item 1, now inside the stall
+    assert plane.save(state, iteration=2) is True   # fills the slot
+    assert plane.save(state, iteration=3) is False  # queue full: dropped
+    assert plane.skipped == 1
+    monkeypatch.delenv(chaos.ENV_VAR)
+    plane.flush()
+    assert plane.published == 2
+    assert plane.latest_common_iteration() == 2  # iter 3 never existed
+    plane.close()
+
+
+def test_backpressure_block_stalls_until_slot_frees(comm, tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR,
+                       "stall_writer@ms=300,match=snapshot_iter")
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("job", comm, path=str(tmp_path)),
+        max_pending=1, backpressure="block")
+    state = _state(comm)
+    plane.save(state, iteration=1)
+    plane.save(state, iteration=2)  # blocks until the writer takes #1
+    t0 = time.monotonic()
+    plane.save(state, iteration=3)  # blocks through #1's 300 ms stall
+    blocked = time.monotonic() - t0
+    assert blocked > 0.05  # the stall IS the backpressure signal
+    assert plane.skipped == 0
+    monkeypatch.delenv(chaos.ENV_VAR)
+    plane.flush()
+    assert plane.published == 3
+    plane.close()
+
+
+# -- drain / deadline / errors ------------------------------------------
+
+
+def test_drain_deadline_false_then_flush_completes(comm, tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR,
+                       "stall_writer@ms=400,match=snapshot_iter")
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("job", comm, path=str(tmp_path)))
+    plane.save(_state(comm), iteration=1)
+    assert plane.drain(time.monotonic() + 0.05) is False  # budget passed
+    assert plane.pending == 1
+    monkeypatch.delenv(chaos.ENV_VAR)
+    plane.flush()  # unbounded drain finishes the publish
+    assert plane.published == 1
+    assert plane.pending == 0
+    plane.close()
+
+
+def test_writer_error_surfaces_on_flush(comm, tmp_path, monkeypatch):
+    monkeypatch.setenv(chaos.ENV_VAR, "enospc@match=snapshot_iter_7")
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("job", comm, path=str(tmp_path)))
+    plane.save(_state(comm), iteration=7)
+    with pytest.raises(RuntimeError,
+                       match="async snapshot publish failed"):
+        plane.flush()
+    monkeypatch.delenv(chaos.ENV_VAR)
+    # nothing partial was published — the failed iteration is invisible
+    assert plane.latest_common_iteration() is None
+    plane.close()
+
+
+# -- emergency-save grace accounting ------------------------------------
+
+
+def test_reserve_grace_subtracts_from_the_window():
+    assert reserve_grace(None) is None
+    now = time.monotonic()
+    d = reserve_grace(now + 10.0, fraction=0.5)
+    assert now + 4.5 < d < now + 5.5  # half reserved for the sync save
+    d = reserve_grace(now + 10.0, fraction=0.5, floor_s=8.0)
+    assert d <= now + 2.1  # floor wins: 8 s kept for the sync save
+    # an already-passed deadline never goes further into the past
+    assert reserve_grace(now - 5.0) >= now - 1e-3
+
+
+def test_emergency_save_drains_inside_the_same_window(comm, tmp_path):
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("job", comm, path=str(tmp_path)))
+    seen = {}
+    plane.drain = lambda deadline_s=None: seen.update(drain=deadline_s)
+    plane.ck.emergency_save = \
+        lambda trainer, deadline_s=None: seen.update(sync=deadline_s)
+    deadline = time.monotonic() + 10.0
+    plane.emergency_save(trainer=None, deadline_s=deadline)
+    # drain gets a RESERVED slice of the window; the sync last-chance
+    # save still sees the ORIGINAL deadline — one window, never doubled
+    assert seen["sync"] == deadline
+    assert seen["drain"] is not None
+    assert seen["drain"] < deadline
+    assert seen["drain"] >= time.monotonic() - 1.0
+
+
+# -- trainer protocol ----------------------------------------------------
+
+
+class _FakeUpdater:
+    def __init__(self, comm):
+        self.state = {"w": _sharded(comm, (8, 4))}
+        self.iteration = 9
+
+    def host_state_dict(self):
+        return {"epoch": 2}
+
+
+class _FakeTrainer:
+    def __init__(self, comm):
+        self.updater = _FakeUpdater(comm)
+        self.observation = {}
+
+
+def test_extension_protocol_and_report(comm, tmp_path, capsys):
+    from chainermn_tpu.training.reports import CheckpointReport
+
+    plane = AsyncSnapshotPlane(
+        MultiNodeCheckpointer("job", comm, path=str(tmp_path)))
+    trainer = _FakeTrainer(comm)
+    plane(trainer)  # extension __call__ = save off the step path
+    plane.flush()
+    assert plane.latest_common_iteration() == 9
+    assert plane.load_host_state(9) == {"epoch": 2}
+
+    report = CheckpointReport(plane)
+    report(trainer)
+    out = capsys.readouterr().out
+    assert "ckpt plane: backpressure=block" in out
+    obs = trainer.observation
+    assert obs["ckpt/published"] == 1
+    assert obs["ckpt/skipped"] == 0
+    assert obs["ckpt/cadence"] == 0  # single save — no cadence yet
+    assert obs["ckpt/bytes"] > 0
+    assert obs["ckpt/stall_ms"] >= 0.0
+    plane.close()
+
+
+# -- the SIGKILL window --------------------------------------------------
+
+_CHILD = """
+import os, signal, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.checkpointing import AsyncSnapshotPlane
+from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
+from chainermn_tpu.resilience import chaos
+
+comm = chainermn_tpu.create_communicator("xla")
+state = {"w": jax.device_put(
+    jnp.arange(32.0).reshape(8, 4),
+    NamedSharding(comm.mesh, P(comm.mesh.axis_names[0])))}
+plane = AsyncSnapshotPlane(
+    MultiNodeCheckpointer("job", comm, path=sys.argv[1]))
+plane.save(state, iteration=1)
+plane.flush()
+# widen the offload->publish window, then die inside it
+os.environ[chaos.ENV_VAR] = "stall_writer@ms=30000,match=snapshot_iter_2"
+plane.save(state, iteration=2)
+time.sleep(0.5)  # the writer is now stalled BEFORE the publish
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_between_offload_and_publish_falls_back(comm, tmp_path):
+    """A SIGKILL while iteration 2 sits between offload and publish must
+    lose ONLY that snapshot: nothing partial is visible, and the
+    election falls back to the fully-published iteration 1."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD, str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+
+    job = tmp_path / "job"
+    assert (job / "snapshot_iter_1.0").exists()
+    # iteration 2 never published: no data file (a tmp may linger — the
+    # atomic rename is the publish, and it never ran)
+    assert not (job / "snapshot_iter_2.0").exists()
+    ck = MultiNodeCheckpointer("job", comm, path=str(tmp_path))
+    assert ck.latest_common_iteration() == 1
+    template = {"w": jax.device_put(
+        jnp.zeros((8, 4), jnp.float32),
+        NamedSharding(comm.mesh, P(comm.mesh.axis_names[0])))}
+    loaded, it = ck.maybe_load(template)
+    assert it == 1
+    assert np.array_equal(np.asarray(loaded["w"]),
+                          np.arange(32.0, dtype=np.float32).reshape(8, 4))

@@ -1,0 +1,315 @@
+"""Manifest-driven multi-axis resharding: EF-frame regroup math, the
+2x4 -> 2x2 mesh reshape parity run, tile-layout-only bitwise splices,
+the elastic planner's reshard decision on a REAL two-axis comm, layout
+manifests, and the offline coverage helpers tools/ckpt.py builds on."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.checkpointing.reshard import (
+    default_leaf_resharder,
+    ef_frame_regroup,
+    leaf_coverage,
+    manifest_info,
+    mesh_axes,
+    reshard_state,
+    saved_axes,
+    scan_snapshot_dir,
+)
+from chainermn_tpu.extensions.checkpoint import MultiNodeCheckpointer
+from chainermn_tpu.optimizers.zero import (
+    _padded_size,
+    zero_layout_manifest,
+    fsdp_layout_manifest,
+)
+from chainermn_tpu.resilience.elastic import (
+    elastic_resume,
+    plan_elastic_resume,
+)
+
+
+def _mesh(shape, axes):
+    devs = np.array(jax.devices()[:int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, axes)
+
+
+@pytest.fixture()
+def comm24():
+    return chainermn_tpu.create_communicator(
+        "xla", mesh=_mesh((2, 4), ("data", "model")))
+
+
+@pytest.fixture()
+def comm22():
+    return chainermn_tpu.create_communicator(
+        "xla", mesh=_mesh((2, 2), ("data", "model")))
+
+
+@pytest.fixture()
+def comm8():
+    return chainermn_tpu.create_communicator("xla")
+
+
+def _put(x, mesh, spec):
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, spec))
+
+
+# -- EF regroup math -----------------------------------------------------
+
+
+def test_ef_regroup_shrink_is_group_mean():
+    full = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    out = ef_frame_regroup(full, 4)
+    assert out.shape == (4, 16)
+    np.testing.assert_array_equal(
+        out, full.reshape(4, 2, 16).sum(axis=1) / 2)
+
+
+def test_ef_regroup_grow_is_bitwise_repeat():
+    full = np.random.default_rng(0).normal(
+        size=(4, 16)).astype(np.float32)
+    out = ef_frame_regroup(full, 8)
+    np.testing.assert_array_equal(out, np.repeat(full, 2, axis=0))
+
+
+def test_ef_regroup_preserves_cross_rank_mean_both_ways():
+    """The invariant that makes the regroup CORRECT: the reducers
+    average residuals over ranks (op='mean'), and both directions keep
+    that mean bit-exact for power-of-two worlds."""
+    rng = np.random.default_rng(7)
+    full = rng.normal(size=(8, 64)).astype(np.float32)
+    mean = full.mean(axis=0, dtype=np.float64)
+    for n_new in (4, 2, 16):
+        out = ef_frame_regroup(full, n_new)
+        # the group sums round once in float32, so the float64
+        # reference mean is matched to f32 precision, not bit-exactly
+        np.testing.assert_allclose(
+            out.mean(axis=0, dtype=np.float64), mean,
+            rtol=1e-5, atol=1e-7)
+    # shrink-then-grow round trip: exact (each row is its group's mean)
+    back = ef_frame_regroup(ef_frame_regroup(full, 4), 8)
+    np.testing.assert_array_equal(
+        back, np.repeat(full.reshape(4, 2, 64).sum(1) / 2, 2, axis=0))
+
+
+def test_ef_regroup_rejects_non_divisible_and_non_2d():
+    with pytest.raises(ValueError, match="divide"):
+        ef_frame_regroup(np.zeros((3, 8), np.float32), 2)
+    with pytest.raises(ValueError, match="2-D"):
+        ef_frame_regroup(np.zeros(8, np.float32), 2)
+
+
+def test_default_resharder_only_touches_world_stacked_frames():
+    fetch = lambda: np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    ref = jnp.zeros((4, 16), jnp.float32)
+    out = default_leaf_resharder(0, ref, (8, 16), fetch)
+    assert out.shape == (4, 16)
+    # different trailing dim = a genuinely different model: refused
+    assert default_leaf_resharder(
+        0, jnp.zeros((4, 32)), (8, 16), fetch) is None
+    # same leading dim: splice territory, not regroup territory
+    assert default_leaf_resharder(
+        0, jnp.zeros((8, 16)), (8, 16), fetch) is None
+    # non-2-D: refused
+    assert default_leaf_resharder(
+        0, jnp.zeros((4, 2, 16)), (8, 2, 16), fetch) is None
+
+
+# -- multi-axis mesh reshape (the previously-impossible resume) ----------
+
+
+def test_reshard_2x4_to_2x2_parity(comm24, comm22, tmp_path):
+    """Save on a 2x4 TP x DP mesh, resume on 2x2: same-shape leaves
+    restore bitwise through the splice, the world-stacked EF frame
+    regroups to the oracle, and a step on the new mesh runs finite."""
+    m24, m22 = comm24.mesh, comm22.mesh
+    w = _put(jnp.arange(64.0).reshape(8, 8), m24, P("data", "model"))
+    ef_full = np.random.default_rng(3).normal(
+        size=(8, 256)).astype(np.float32)
+    ef = _put(ef_full, m24, P("model"))
+    ck24 = MultiNodeCheckpointer("job", comm24, path=str(tmp_path))
+    ck24.save({"w": w, "ef": ef}, iteration=7)
+
+    ck22 = MultiNodeCheckpointer("job", comm22, path=str(tmp_path))
+    template = {
+        "w": _put(jnp.zeros((8, 8)), m22, P("data", "model")),
+        "ef": _put(jnp.zeros((4, 256)), m22, P("model")),
+    }
+    loaded, it = reshard_state(ck22, template)
+    assert it == 7
+    np.testing.assert_array_equal(np.asarray(loaded["w"]),
+                                  np.arange(64.0).reshape(8, 8))
+    # EF oracle: the from-scratch regroup of the full saved frame
+    np.testing.assert_array_equal(
+        np.asarray(loaded["ef"]),
+        ef_full.reshape(4, 2, 256).sum(axis=1) / 2)
+    # the restored leaves live on the NEW mesh and step finite
+    assert loaded["w"].sharding.mesh.shape == {"data": 2, "model": 2}
+    loss = float(jax.jit(
+        lambda s: jnp.mean(s["w"]) + jnp.mean(s["ef"]))(loaded))
+    assert np.isfinite(loss)
+
+
+def test_tile_layout_only_change_is_bitwise_splice(comm8, comm24,
+                                                   tmp_path):
+    """Same global shapes, different tiling (1-D 'r' x8 -> 2x4): pure
+    interval splice, bit-for-bit — including the EF frame, whose world
+    count (8 devices) did not change."""
+    m8, m24 = comm8.mesh, comm24.mesh
+    w_full = np.random.default_rng(1).normal(size=(8, 8)) \
+        .astype(np.float32)
+    ef_full = np.random.default_rng(2).normal(size=(8, 256)) \
+        .astype(np.float32)
+    ck8 = MultiNodeCheckpointer("job", comm8, path=str(tmp_path))
+    ck8.save({"w": _put(w_full, m8, P("r")),
+              "ef": _put(ef_full, m8, P("r"))}, iteration=4)
+
+    ck24 = MultiNodeCheckpointer("job", comm24, path=str(tmp_path))
+    template = {"w": _put(jnp.zeros((8, 8)), m24, P("data", "model")),
+                "ef": _put(jnp.zeros((8, 256)), m24, P("model"))}
+    loaded, it = reshard_state(ck24, template)
+    assert it == 4
+    np.testing.assert_array_equal(np.asarray(loaded["w"]), w_full)
+    np.testing.assert_array_equal(np.asarray(loaded["ef"]), ef_full)
+
+
+def test_plan_and_elastic_resume_across_axes_change(comm24, comm22,
+                                                    tmp_path):
+    """End-to-end through resilience/elastic.py on REAL comms: the
+    2-axis mesh change that historically raised ElasticTopologyError
+    plans as 'reshard' (axes read from the coverage manifest) and
+    elastic_resume restores the updater exactly — same process count,
+    so the host side is the exact-restore path."""
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.training import StandardUpdater
+
+    m24, m22 = comm24.mesh, comm22.mesh
+    w = _put(jnp.arange(64.0).reshape(8, 8), m24, P("data", "model"))
+    ck24 = MultiNodeCheckpointer("job", comm24, path=str(tmp_path))
+    ck24.save({"w": w}, iteration=5, host_state={"pos": 40})
+
+    ck22 = MultiNodeCheckpointer("job", comm22, path=str(tmp_path))
+    plan = plan_elastic_resume(ck22)
+    assert plan.action == "reshard"
+    assert plan.iteration == 5
+    assert plan.saved_axes == {"data": 2, "model": 4}
+    assert plan.new_axes == {"data": 2, "model": 2}
+    assert plan.averaging_rescale == pytest.approx(2.0)  # 8 -> 4 devices
+
+    data = [(np.zeros(2, np.float32), np.int32(0))] * 8
+    it = SerialIterator(data, 2)
+
+    def step(state, x, y):
+        return state, {"loss": float(jnp.mean(state["w"]))}
+
+    u = StandardUpdater(
+        it, step,
+        {"w": _put(jnp.zeros((8, 8)), m22, P("data", "model"))}, comm22)
+    u.shard_batch = lambda arrays: arrays
+    host = {}
+    u.load_host_state = host.update
+    executed = elastic_resume(ck22, u)
+    assert executed.action == "reshard"
+    assert u.iteration == 5
+    np.testing.assert_array_equal(np.asarray(u.state["w"]),
+                                  np.arange(64.0).reshape(8, 8))
+    assert host.get("pos") == 40  # exact host restore: same world size
+    u.update()
+    assert np.isfinite(u.last_metrics["loss"])
+
+
+# -- layout manifests ----------------------------------------------------
+
+
+def test_zero_layout_manifest_is_device_count_independent(comm8,
+                                                          tmp_path):
+    params = {"a": jnp.zeros(1000, jnp.float32),
+              "b": jnp.zeros((10, 30), jnp.float32)}
+    m8 = zero_layout_manifest(params, comm8)
+    assert m8["kind"] == "zero-flat"
+    assert m8["n"] == 8
+    assert m8["total"] == 1300
+    assert m8["padded"] == _padded_size(1300, 8)
+    assert m8["ef_frames"] == [[8, m8["padded"]]]
+    # quantum padding: the TRAILING dim matches what a 4-device world
+    # would write — the reshard regroup only ever changes the leading dim
+    comm4 = chainermn_tpu.create_communicator(
+        "xla", mesh=Mesh(np.array(jax.devices()[:4]), ("r",)))
+    assert zero_layout_manifest(params, comm4)["padded"] == m8["padded"]
+
+    ck = MultiNodeCheckpointer("job", comm8, path=str(tmp_path))
+    ck.set_layout(m8)
+    ck.save({"w": jnp.zeros(4, jnp.float32)}, iteration=1)
+    info = manifest_info(ck, 1)
+    assert info["layout"]["kind"] == "zero-flat"
+    assert info["world"] == 1  # single process
+    assert saved_axes(ck, 1) == {"r": 8}
+
+
+def test_zero_layout_manifest_bucketed(comm8):
+    params = {"a": jnp.zeros(100_000, jnp.float32),
+              "b": jnp.zeros(60_000, jnp.float32)}
+    m = zero_layout_manifest(params, comm8, bucket_bytes=1 << 18)
+    assert m["kind"] == "zero-bucketed"
+    assert m["bucket_bytes"] == 1 << 18
+    assert len(m["ef_frames"]) == len(m["padded"]) >= 2
+    for (rows, cols), padded in zip(m["ef_frames"], m["padded"]):
+        assert rows == 8 and cols == padded
+
+
+def test_fsdp_layout_manifest_rows(comm8):
+    params = {"layer": {"w": jnp.zeros((8, 4), jnp.float32)},
+              "bias": jnp.zeros(4, jnp.float32)}
+    m = fsdp_layout_manifest(params, comm8)
+    assert m["kind"] == "fsdp"
+    assert m["n"] == 8
+    paths = {r["path"] for r in m["leaves"]}
+    assert any("w" in p for p in paths)
+    assert all("shape" in r and "spec" in r for r in m["leaves"])
+
+
+# -- offline helpers (the tools/ckpt.py substrate) -----------------------
+
+
+def test_mesh_axes_and_manifest_axes_agree(comm24, tmp_path):
+    assert mesh_axes(comm24) == {"data": 2, "model": 4}
+    ck = MultiNodeCheckpointer("job", comm24, path=str(tmp_path))
+    ck.save({"w": _put(jnp.zeros((8, 8)), comm24.mesh,
+                       P("data", "model"))}, iteration=3)
+    assert saved_axes(ck, 3) == {"data": 2, "model": 4}
+
+
+def test_scan_and_coverage_complete(comm8, tmp_path):
+    ck = MultiNodeCheckpointer("job", comm8, path=str(tmp_path))
+    ck.save({"w": _put(jnp.zeros((8, 4)), comm8.mesh, P("r"))},
+            iteration=2)
+    job = str(tmp_path / "job")
+    snaps = scan_snapshot_dir(job)
+    assert list(snaps) == [2]
+    cov = leaf_coverage(snaps[2])
+    (rec,) = cov.values()
+    assert rec["gshape"] == (8, 4)
+    assert rec["covered"] is True
+    assert rec["volume"] == 32
+
+
+def test_coverage_reports_missing_shards(tmp_path):
+    """A file set holding only half the shard intervals is INCOMPLETE —
+    the accounting tools/ckpt.py and the dry-run planner rely on."""
+    fn = str(tmp_path / "snapshot_iter_1.0")
+    np.savez(fn + ".npz",
+             leaf_0_nshards=np.int64(1),
+             leaf_0_gshape=np.asarray((8, 4), np.int64),
+             leaf_0_s0=np.zeros((4, 4), np.float32),
+             leaf_0_idx0=np.asarray([[0, 4], [0, -1]], np.int64))
+    import os
+
+    os.replace(fn + ".npz", fn)
+    cov = leaf_coverage([fn])
+    assert cov[0]["covered"] is False
+    assert cov[0]["volume"] == 16

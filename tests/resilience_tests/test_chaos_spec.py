@@ -171,3 +171,50 @@ def test_chaos_from_env_reparses_on_change(monkeypatch):
 def test_own_rank_prefers_harness_var(monkeypatch):
     monkeypatch.setenv("CHAINERMN_TPU_CHAOS_RANK", "3")
     assert chaos._own_rank() == 3
+
+
+# -- offload hooks (async snapshot plane) -----------------------------------
+
+
+@pytest.mark.parametrize("bad", [
+    "slow_offload@ms=5",           # missing match
+    "slow_offload@match=snap",     # missing ms
+    "stall_writer@ms=5",           # missing match
+    "stall_writer@match=snap",     # missing ms
+])
+def test_parse_rejects_offload_kinds_without_ms_and_match(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_slow_offload_fires_on_offload_stage_only():
+    slept = []
+    p = _plan("slow_offload@ms=100,match=snapshot_iter_3",
+              sleep_fn=slept.append)
+    p.on_offload("/d/snapshot_iter_2.0", "offload")   # path mismatch
+    p.on_offload("/d/snapshot_iter_3.0", "writer")    # wrong stage
+    assert slept == []
+    p.on_offload("/d/snapshot_iter_3.0", "offload")
+    assert slept == [0.1]
+
+
+def test_stall_writer_fires_on_writer_stage_only():
+    slept = []
+    p = _plan("stall_writer@ms=250,match=snapshot_iter",
+              sleep_fn=slept.append)
+    p.on_offload("/d/snapshot_iter_3.0", "offload")
+    assert slept == []
+    p.on_offload("/d/snapshot_iter_3.0", "writer")
+    assert slept == [0.25]
+
+
+def test_on_offload_rejects_unknown_stage():
+    p = _plan("stall_writer@ms=1,match=snap")
+    with pytest.raises(ValueError):
+        p.on_offload("/d/snap", "publish")
+
+
+def test_offload_env_wrapper_noop_when_unset(monkeypatch):
+    monkeypatch.delenv(chaos.ENV_VAR, raising=False)
+    chaos.on_offload("/nonexistent", "offload")
+    chaos.on_offload("/nonexistent", "writer")

@@ -1,0 +1,368 @@
+#!/usr/bin/env python
+"""ckpt — offline snapshot inspection, verification, and reshard planning.
+
+Operates on a checkpointer job directory (the ``<path>/<name>`` tree
+holding ``snapshot_iter_<N>.<rank>`` files, their ``.json`` sidecar
+manifests, and the ``replicas/`` ring copies) WITHOUT a communicator or
+any device work — everything here reads sidecar JSON and the small
+geometry keys inside each npz (gshape/nshards/idx); shard payloads are
+only hashed, never deserialized.
+
+Usage::
+
+    python tools/ckpt.py inspect  DIR [--iteration N]
+    python tools/ckpt.py verify   DIR [--iteration N]
+    python tools/ckpt.py reshard-dry-run DIR --target data=2,model=2 \\
+        [--iteration N]
+
+``inspect`` lists every iteration's file set, its manifest summary
+(saving world, mesh axes, bytes), and the per-leaf shard-coverage
+report — which global index ranges the surviving files actually hold.
+
+``verify`` recomputes each file's SHA-256 and byte size against its
+sidecar manifest (the same check the consensus election runs) and
+exits 1 on any mismatch; files without a manifest are reported but
+tolerated, matching the checkpointer's compatibility behavior.
+
+``reshard-dry-run`` plans the splice a resume onto ``--target`` (an
+``axis=size`` map for the NEW mesh) would perform: per leaf, which
+saved shards supply each target shard range, whether coverage is
+complete, and which world-stacked EF residual frames would regroup
+(``checkpointing/reshard.py:ef_frame_regroup``) instead of splicing.
+The per-dim split is a heuristic — offline, the template's
+PartitionSpec is unknown, so a dim is matched to a mesh axis by its
+saved cut count — but coverage itself is exact interval arithmetic.
+
+Exit status: 0 clean, 1 findings/failures, 2 usage error.
+"""
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+_SNAP_RE = re.compile(r"snapshot_iter_(\d+)\.(\d+)$")
+
+
+def _read_manifest(fn):
+    """Sidecar JSON for snapshot file ``fn`` (None when missing/torn).
+    Local copy of extensions/checkpoint.py:read_manifest so plain
+    verification needs no package import."""
+    try:
+        with open(fn + ".json", "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _sha256_file(fn):
+    h = hashlib.sha256()
+    with open(fn, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _scan(path):
+    """{iteration: [files]} across the job dir and its replicas/."""
+    out = {}
+    for d in (path, os.path.join(path, "replicas")):
+        if not os.path.isdir(d):
+            continue
+        for f in sorted(os.listdir(d)):
+            m = _SNAP_RE.match(f)
+            fn = os.path.join(d, f)
+            if m and not os.path.isdir(fn):
+                out.setdefault(int(m.group(1)), []).append(fn)
+    return out
+
+
+def _file_leaf_intervals(fn):
+    """{leaf: [interval bounds]} held by ONE file — the per-file half of
+    reshard.leaf_coverage's aggregate. Reads only geometry keys."""
+    out = {}
+    with np.load(fn, allow_pickle=False) as z:
+        keys = set(z.files)
+        for k in keys:
+            m = re.match(r"leaf_(\d+)_nshards$", k)
+            if m:
+                i = int(m.group(1))
+                gshape = tuple(int(d) for d in z[f"leaf_{i}_gshape"])
+                ivs = out.setdefault(i, [])
+                for s in range(int(z[k])):
+                    idx = np.asarray(z[f"leaf_{i}_idx{s}"])
+                    ivs.append(tuple(
+                        (int(a), int(b) if b != -1 else d)
+                        for (a, b), d in zip(idx, gshape)))
+                continue
+            m = re.match(r"leaf_(\d+)$", k)
+            if m:
+                i = int(m.group(1))
+                out.setdefault(i, []).append(tuple(
+                    (0, d) for d in z[k].shape))
+    return out
+
+
+def _coverage(files):
+    """Aggregate per-leaf coverage across a file set, with file
+    attribution: {leaf: {gshape, intervals: {bounds: [files]},
+    covered, volume}}."""
+    leaves = {}
+    for fn in files:
+        for i, ivs in _file_leaf_intervals(fn).items():
+            with np.load(fn, allow_pickle=False) as z:
+                if f"leaf_{i}_gshape" in z.files:
+                    gshape = tuple(int(d) for d in z[f"leaf_{i}_gshape"])
+                else:
+                    gshape = tuple(int(d) for d in z[f"leaf_{i}"].shape)
+            rec = leaves.setdefault(i, {"gshape": gshape, "intervals": {}})
+            for bounds in ivs:
+                rec["intervals"].setdefault(bounds, []).append(fn)
+    for rec in leaves.values():
+        total = int(np.prod(rec["gshape"], dtype=np.int64)) \
+            if rec["gshape"] else 1
+        vol = sum(int(np.prod([b - a for a, b in iv], dtype=np.int64))
+                  for iv in rec["intervals"])
+        rec["volume"] = vol
+        rec["covered"] = vol == total  # saved intervals are a partition
+    return leaves
+
+
+def _best_manifest(files):
+    best = None
+    for fn in files:
+        mf = _read_manifest(fn)
+        if mf is None:
+            continue
+        if "axes" in mf or "leaves" in mf:
+            return mf
+        best = best or mf
+    return best
+
+
+def _pick_iteration(snaps, iteration):
+    if not snaps:
+        print("no snapshot files found", file=sys.stderr)
+        return None
+    if iteration is None:
+        return max(snaps)
+    if iteration not in snaps:
+        print(f"iteration {iteration} not found "
+              f"(have: {sorted(snaps)})", file=sys.stderr)
+        return None
+    return iteration
+
+
+def _fmt_bounds(bounds):
+    return "[" + ", ".join(f"{a}:{b}" for a, b in bounds) + "]"
+
+
+# -- subcommands ---------------------------------------------------------
+
+def cmd_inspect(args):
+    snaps = _scan(args.dir)
+    if not snaps:
+        print("no snapshot files found", file=sys.stderr)
+        return 1
+    iters = [args.iteration] if args.iteration is not None else sorted(snaps)
+    for it in iters:
+        if it not in snaps:
+            print(f"iteration {it} not found", file=sys.stderr)
+            return 1
+        files = snaps[it]
+        mf = _best_manifest(files) or {}
+        axes = mf.get("axes")
+        print(f"iteration {it}: {len(files)} file(s), "
+              f"world={mf.get('world', '?')}, "
+              f"axes={axes if axes else '?'}")
+        for fn in files:
+            sz = os.path.getsize(fn)
+            tag = " (replica)" if os.sep + "replicas" + os.sep in fn else ""
+            print(f"  {os.path.basename(fn)}  {sz:,} bytes{tag}")
+        if mf.get("layout"):
+            print(f"  layout: {mf['layout'].get('kind', '?')}")
+        for i, rec in sorted(_coverage(files).items()):
+            nshards = len(rec["intervals"])
+            state = "complete" if rec["covered"] else \
+                f"INCOMPLETE ({rec['volume']}/" \
+                f"{int(np.prod(rec['gshape'], dtype=np.int64))} elements)"
+            print(f"  leaf {i}: gshape={rec['gshape']} "
+                  f"{nshards} saved range(s) — {state}")
+    return 0
+
+
+def cmd_verify(args):
+    snaps = _scan(args.dir)
+    if not snaps:
+        print("no snapshot files found", file=sys.stderr)
+        return 1
+    iters = [args.iteration] if args.iteration is not None else sorted(snaps)
+    failures = 0
+    for it in iters:
+        if it not in snaps:
+            print(f"iteration {it} not found", file=sys.stderr)
+            return 1
+        for fn in snaps[it]:
+            mf = _read_manifest(fn)
+            name = os.path.basename(fn)
+            if mf is None:
+                print(f"  {name}: no manifest (pre-hardening snapshot "
+                      "— tolerated)")
+                continue
+            size = os.path.getsize(fn)
+            if mf.get("bytes") not in (None, size):
+                print(f"  {name}: FAIL — size {size} != manifest "
+                      f"{mf.get('bytes')}")
+                failures += 1
+                continue
+            sha = _sha256_file(fn)
+            if sha != mf.get("sha256"):
+                print(f"  {name}: FAIL — sha256 mismatch")
+                failures += 1
+            else:
+                print(f"  {name}: ok ({size:,} bytes, "
+                      f"sha256 {sha[:12]}…)")
+    print(f"verify: {failures} failure(s)")
+    return 1 if failures else 0
+
+
+def _parse_target(spec):
+    axes = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad --target entry {part!r} "
+                             "(expected axis=size)")
+        k, v = part.split("=", 1)
+        axes[k.strip()] = int(v)
+    if not axes:
+        raise ValueError("--target parsed to no axes")
+    return axes
+
+
+def cmd_reshard_dry_run(args):
+    try:
+        target = _parse_target(args.target)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    snaps = _scan(args.dir)
+    it = _pick_iteration(snaps, args.iteration)
+    if it is None:
+        return 1
+    files = snaps[it]
+    mf = _best_manifest(files) or {}
+    saved_axes = mf.get("axes")
+    saved_world = mf.get("world")
+    t_world = 1
+    for v in target.values():
+        t_world *= v
+    print(f"reshard dry run: iteration {it}")
+    print(f"  saved mesh:  axes={saved_axes if saved_axes else '?'} "
+          f"world={saved_world if saved_world is not None else '?'}")
+    print(f"  target mesh: axes={target} world={t_world}")
+    problems = 0
+    for i, rec in sorted(_coverage(files).items()):
+        gshape = rec["gshape"]
+        intervals = rec["intervals"]
+        print(f"  leaf {i}: gshape={gshape}")
+        if not rec["covered"]:
+            total = int(np.prod(gshape, dtype=np.int64))
+            print(f"    INCOMPLETE — saved ranges cover "
+                  f"{rec['volume']}/{total} elements; splice would fail")
+            problems += 1
+            continue
+        # which dims the SAVED layout actually cut
+        cuts = [sorted({iv[d] for iv in intervals})
+                for d in range(len(gshape))]
+        sharded_dims = [d for d in range(len(gshape))
+                        if len(cuts[d]) > 1 or
+                        (cuts[d] and cuts[d][0] != (0, gshape[d]))]
+        # world-stacked EF frame? leading dim == saving world and the
+        # target world differs -> regroup, not splice
+        if (len(gshape) == 2 and saved_world is not None
+                and gshape[0] == saved_world and t_world != saved_world):
+            n_old, n_new = saved_world, t_world
+            if n_old % n_new == 0 or n_new % n_old == 0:
+                how = (f"mean over groups of {n_old // n_new}"
+                       if n_old % n_new == 0
+                       else f"repeat x{n_new // n_old}")
+                print(f"    EF frame ({n_old}, {gshape[1]}): regroup "
+                      f"-> ({n_new}, {gshape[1]}) ({how}, "
+                      "mean-preserving)")
+            else:
+                print(f"    EF frame: CANNOT regroup {n_old} -> "
+                      f"{n_new} ranks (neither divides the other)")
+                problems += 1
+            continue
+        if not sharded_dims:
+            print(f"    replicated — any of {len(intervals)} saved "
+                  "copy(ies) restores it on every target device")
+            continue
+        for d in sharded_dims:
+            n_saved = len(cuts[d])
+            # match the cut count to a saved axis, then read the
+            # target's size for that axis (heuristic; see module doc)
+            axis = None
+            if saved_axes:
+                for a, s in saved_axes.items():
+                    if int(s) == n_saved:
+                        axis = a
+                        break
+            n_target = int(target.get(axis, t_world)) if axis \
+                else t_world
+            print(f"    dim {d}: {n_saved} saved range(s)"
+                  + (f" over axis {axis!r}" if axis else "")
+                  + f" -> {n_target} target range(s)")
+            if gshape[d] % n_target:
+                print(f"      WARNING: dim size {gshape[d]} not "
+                      f"divisible by {n_target} — uneven target tiles")
+            step = max(1, gshape[d] // n_target)
+            for t in range(n_target):
+                lo = t * step
+                hi = (t + 1) * step if t < n_target - 1 else gshape[d]
+                sources = sorted({
+                    os.path.basename(f)
+                    for bounds, fs in intervals.items()
+                    if bounds[d][0] < hi and bounds[d][1] > lo
+                    for f in fs})
+                print(f"      target [{lo}:{hi}] <- "
+                      f"{len(sources)} source file(s): "
+                      + ", ".join(sources[:4])
+                      + (" …" if len(sources) > 4 else ""))
+                if not sources:
+                    problems += 1
+    print(f"dry run: {'OK — splice plan complete' if not problems else str(problems) + ' problem(s)'}")
+    return 1 if problems else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ckpt", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("inspect", cmd_inspect), ("verify", cmd_verify),
+                     ("reshard-dry-run", cmd_reshard_dry_run)):
+        p = sub.add_parser(name)
+        p.add_argument("dir", help="checkpointer job directory "
+                                   "(<path>/<name>)")
+        p.add_argument("--iteration", type=int, default=None)
+        p.set_defaults(fn=fn)
+        if name == "reshard-dry-run":
+            p.add_argument("--target", required=True,
+                           help="target mesh axes, e.g. data=2,model=2")
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
