@@ -321,11 +321,15 @@ def main():
     # serving decode proof (docs/serving.md), folded into the same JSON
     # line: the paged-KV cached decode compiles ONE program where the
     # naive full-recompute loop compiles one PER TOKEN, with identical
-    # greedy streams. The trace counts are structural and hold on any
-    # backend; the wall-clock side stays an honest null off-TPU
+    # greedy streams — and the multi-token decode_k program emits the
+    # same stream from one trace while moving ≤ 8 device→host bytes per
+    # token (on-device sampling, DL110's observable). The trace counts
+    # and byte gate are structural and hold on any backend; the
+    # wall-clock side stays an honest null off-TPU
     # (``serving_honest_null`` — tools/bench_serve.py reports the same).
     try:
-        from tools.bench_serve import measure_cached, measure_recompute
+        from tools.bench_serve import (measure_cached, measure_decode_k,
+                                       measure_recompute)
 
         from chainermn_tpu.models.transformer import TransformerLM
 
@@ -338,16 +342,23 @@ def main():
         n_new = 12
         cached = measure_cached(lm, lp, prompt, n_new, capacity=64)
         recomp = measure_recompute(lm, lp, prompt, n_new)
+        multi = measure_decode_k(lm, lp, prompt, n_new, capacity=64)
         record["serving_honest_null"] = jax.default_backend() != "tpu"
         record["serving_cached_traces"] = cached["traces"]
         record["serving_recompute_traces"] = recomp["traces"]
+        record["serving_decode_k_traces"] = multi["traces"]
         record["serving_cached_tokens_per_s"] = cached["tokens_per_s"]
         record["serving_recompute_tokens_per_s"] = recomp["tokens_per_s"]
+        record["serving_decode_k_tokens_per_s"] = multi["tokens_per_s"]
+        record["serving_host_bytes_per_token"] = (
+            multi["host_bytes_per_token"])
         record["serving_streams_identical"] = (
-            cached["tokens"] == recomp["tokens"])
+            cached["tokens"] == recomp["tokens"] == multi["tokens"])
         record["serving_gate_ok"] = bool(
-            cached["tokens"] == recomp["tokens"]
-            and cached["traces"] == 1 and recomp["traces"] == n_new)
+            cached["tokens"] == recomp["tokens"] == multi["tokens"]
+            and cached["traces"] == 1 and recomp["traces"] == n_new
+            and multi["traces"] == 1
+            and multi["host_bytes_per_token"] <= 8.0)
     except Exception as e:  # never sink the headline metric
         record["serving_error"] = f"{type(e).__name__}: {e}"[:300]
 

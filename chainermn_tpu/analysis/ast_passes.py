@@ -1057,3 +1057,98 @@ def check_blocking_save_in_step_loop(tree, src, path) -> List[Finding]:
 
 register(Rule("DL109", "blocking-save-in-step-loop", f"{_DOC}#dl109",
               check_blocking_save_in_step_loop))
+
+
+# ---------------------------------------------------------------------------
+# DL110 — per-token-host-sync
+# ---------------------------------------------------------------------------
+
+#: host materializers: calling one of these ON decode output pulls the
+#: whole array across the device boundary
+_HOST_PULLS = {"asarray", "device_get", "array"}
+
+#: callee-name fragments that mark a decode dispatch... and the exempt
+#: fixed path: ``decode_k`` returns int32 token IDS (4 bytes/token) —
+#: pulling those is the fix DL110 points at, not the bug
+_DECODE_FRAGMENT = "decode"
+_DECODE_EXEMPT = "decode_k"
+
+
+def _is_decode_dispatch(call: ast.Call) -> bool:
+    name = _callee_name(call)
+    return (name is not None and _DECODE_FRAGMENT in name
+            and _DECODE_EXEMPT not in name)
+
+
+def _strip_subscripts(node: ast.expr) -> ast.expr:
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return node
+
+
+def check_per_token_host_sync(tree, src, path) -> List[Finding]:
+    """Full decode logits pulled to the host inside a token loop.
+
+    The serving invariant DL108's sibling (docs/serving.md): the decode
+    hot loop's device→host traffic must not scale with the vocabulary.
+    ``np.asarray(steps.decode(cur))`` (or ``jax.device_get`` /
+    ``np.array`` of the same) inside a ``for``/``while`` loop ships the
+    ``[n_slots, vocab]`` f32 logits across PCIe once per generated
+    token — the transfer the on-device sampler
+    (``serving/sampling.py``) exists to eliminate. Flagged shapes:
+
+    * a direct pull — ``np.asarray(steps.decode(cur))`` — including
+      through subscripts (``np.asarray(steps.decode(cur)[0])`` pulls
+      the whole buffer before slicing);
+    * a pull of a name assigned from a decode dispatch in the SAME loop
+      (single-assignment taint, as everywhere in this suite).
+
+    NOT flagged: reducing on device first and pulling the result —
+    ``np.asarray(jnp.argmax(steps.decode(cur), -1))`` moves int32 ids
+    only — and any callee whose name contains ``decode_k``: the
+    multi-token program already returns token ids, so materializing its
+    output IS the fixed pattern. Parity oracles that legitimately
+    compare full logit rows (bitwise tests) suppress with
+    ``# dlint: disable=DL110`` plus a rationale.
+    """
+    findings: List[Finding] = []
+    seen: Set[Tuple[int, int]] = set()   # dedup nested-loop double walks
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        tainted: Set[str] = set()
+        for n in _walk_excluding_defs(loop.body):
+            if (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Call)
+                    and _is_decode_dispatch(n.value)):
+                tainted |= {t.id for t in n.targets
+                            if isinstance(t, ast.Name)}
+        for n in _walk_excluding_defs(loop.body):
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            if _callee_name(n) not in _HOST_PULLS:
+                continue
+            arg = _strip_subscripts(n.args[0])
+            direct = isinstance(arg, ast.Call) and _is_decode_dispatch(arg)
+            named = isinstance(arg, ast.Name) and arg.id in tainted
+            if not (direct or named):
+                continue
+            key = (n.lineno, n.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "DL110", path, n.lineno,
+                f"'{_callee_name(n)}' materializes decode output on the "
+                "host inside a token loop — the [n_slots, vocab] f32 "
+                "logits cross PCIe once per generated token (vocab × 4 "
+                "bytes/token; bench.py gates the decode path at ≤ 8). "
+                "Sample on device (serving/sampling.py) and pull int32 "
+                "ids via ServingStep.decode_k, or at least reduce "
+                "on device first — np.asarray(jnp.argmax(...)) moves "
+                f"ids only ({_DOC}#dl110)."))
+    return findings
+
+
+register(Rule("DL110", "per-token-host-sync", f"{_DOC}#dl110",
+              check_per_token_host_sync))

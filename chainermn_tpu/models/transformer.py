@@ -53,12 +53,19 @@ class TransformerBlock(nn.Module):
     """Pre-LN block: causal attention + (dense | MoE) FFN.
 
     ``decode=True`` PRECONDITION: a multi-token apply (l > 1) is a PREFILL
-    and requires an EMPTY cache — it attends only within the slab, so any
-    previously cached tokens would be silently ignored (``pos`` is traced
-    and cannot be asserted). Chunked prefill (a second l > 1 apply at
-    pos > 0) is NOT supported: prefill once from pos 0, then decode
-    token-by-token (l == 1), which reads the full cache. ``generate()``
-    follows this contract.
+    and, by default, requires an EMPTY cache — it attends only within the
+    slab, so any previously cached tokens would be silently ignored
+    (``pos`` is traced and cannot be asserted). ``generate()`` follows
+    this contract.
+
+    ``chunked_prefill=True`` lifts that restriction for the serving
+    layer: an l > 1 apply at pos > 0 writes the slab at its true cache
+    positions and attends over the FULL cache (prefix + slab) under an
+    absolute-position causal mask, so a prompt can stream in as
+    fixed-size chunks (serving/kv_cache.py::prefill_chunk_apply). The
+    chunked contract assumes NO ring wrap during prefill (prompt length
+    <= capacity — cache slot j holds absolute position j); garbage
+    beyond each row's fill level is masked out, not read.
     """
 
     d_model: int
@@ -79,6 +86,9 @@ class TransformerBlock(nn.Module):
     capacity_factor: float = 1.25
     moe_top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
     decode: bool = False               # single-token KV-cache decoding
+    chunked_prefill: bool = False      # l > 1 decode applies may start at
+    #                                    pos > 0 and attend prefix + slab
+    #                                    (serving chunk path; see docstring)
     max_len: int = 2048                # cache capacity when decode=True
     qkv_layout: str = "blhd"           # 'bhld': head-major attention
     #                                    tensors end to end — projection
@@ -169,7 +179,19 @@ class TransformerBlock(nn.Module):
                 q = apply_rope(q, rows, self.rope_theta)
                 k = apply_rope(k, rows, self.rope_theta)
             start = pos % cap
-            if per_slot:
+            if self.chunked_prefill:
+                # per-position scatter, not dynamic_update_slice: a chunk
+                # whose window overhangs the page end would be CLAMPED to
+                # cap - l and land at the wrong offset. Overhanging rows
+                # (final-chunk padding — no wrap during prefill) drop.
+                wrows = rows if per_slot else rows[None]
+                safe = jnp.where(wrows < cap, wrows, cap)
+                bidx = jnp.arange(b)[:, None]
+                ck.value = ck.value.at[bidx, safe].set(
+                    k.astype(self.dtype), mode="drop")
+                cv.value = cv.value.at[bidx, safe].set(
+                    v.astype(self.dtype), mode="drop")
+            elif per_slot:
                 ck.value = jax.vmap(
                     lambda c, u, s0: jax.lax.dynamic_update_slice(
                         c, u, (s0, 0, 0)))(
@@ -185,15 +207,48 @@ class TransformerBlock(nn.Module):
                     cv.value, v.astype(self.dtype), (0, start, 0, 0))
             idx.value = pos + l
             if l > 1:
-                # PREFILL slab: nothing precedes it (the cache starts
-                # empty), so attention is causal self-attention over the
-                # slab itself. Flash path: no dense [l, max_len] scores
-                # and no full-cache read — a 32k-token prompt prefills at
-                # the training path's memory cost. Reference models keep
-                # the reference kernel so prefill logits are THE SAME
-                # PROGRAM as the full forward (bitwise — the serving
-                # parity tests depend on it).
-                if self.attention == "reference":
+                # PREFILL slab. Default contract: nothing precedes it
+                # (the cache starts empty), so attention is causal
+                # self-attention over the slab itself. Flash path: no
+                # dense [l, max_len] scores and no full-cache read — a
+                # 32k-token prompt prefills at the training path's
+                # memory cost. Reference models keep the reference
+                # kernel so prefill logits are THE SAME PROGRAM as the
+                # full forward (bitwise — the serving parity tests
+                # depend on it).
+                if self.chunked_prefill:
+                    # CHUNKED prefill: the slab (already written above at
+                    # its absolute positions) attends over the FULL cache
+                    # — prefix + itself — under an absolute-position
+                    # causal mask. Same einsum forms, scale, and f32
+                    # casts as local_attention_reference: the only delta
+                    # vs the monolithic slab is extra key lanes that are
+                    # masked to exactly-zero softmax weight, which the
+                    # zero-lane-absorption property (test_decode_bitwise)
+                    # makes bitwise-invisible — chunked == monolithic,
+                    # token for token AND cache byte for cache byte.
+                    kc = ck.value.astype(jnp.float32)
+                    vc = cv.value.astype(jnp.float32)
+                    if hkv != self.n_heads:
+                        kc = jnp.repeat(kc, self.n_heads // hkv, axis=2)
+                        vc = jnp.repeat(vc, self.n_heads // hkv, axis=2)
+                    s = jnp.einsum("bqhd,bkhd->bhqk",
+                                   q.astype(jnp.float32), kc) * dh ** -0.5
+                    keys = jnp.arange(cap)
+                    # no-wrap contract: cache slot j holds absolute
+                    # position j, so causality is just keys <= row; rows
+                    # beyond each slot's fill hold garbage but only
+                    # padding queries (ignored downstream) can see them
+                    visible = keys <= rows[..., None]
+                    if self.attention_window is not None:
+                        visible &= keys > (rows[..., None]
+                                           - self.attention_window)
+                    vis = visible[:, None] if per_slot else visible[None, None]
+                    s = jnp.where(vis, s, -jnp.inf)
+                    att = jnp.einsum("bhqk,bkhd->bqhd",
+                                     jax.nn.softmax(s, -1),
+                                     vc).astype(q.dtype)
+                elif self.attention == "reference":
                     kr, vr = k, v
                     if hkv != self.n_heads:
                         kr = jnp.repeat(kr, self.n_heads // hkv, axis=2)
@@ -380,6 +435,7 @@ class TransformerLM(nn.Module):
     capacity_factor: float = 1.25
     moe_top_k: int = 1                 # 1 = Switch, 2 = GShard top-2
     decode: bool = False               # single-token KV-cache decoding
+    chunked_prefill: bool = False      # serving chunk path (see block)
     qkv_layout: str = "blhd"           # 'bhld': pivot-free head-major
     #                                    attention (see TransformerBlock)
     remat: bool = False                # rematerialize each block's
@@ -407,6 +463,7 @@ class TransformerLM(nn.Module):
             expert_axis=self.expert_axis,
             capacity_factor=self.capacity_factor,
             moe_top_k=self.moe_top_k, decode=self.decode,
+            chunked_prefill=self.chunked_prefill,
             max_len=self.max_len, qkv_layout=self.qkv_layout)
 
     @nn.compact

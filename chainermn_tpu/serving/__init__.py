@@ -2,9 +2,11 @@
 training mesh, resilience, and reporting layers.
 
 Layered exactly like the training side: ``kv_cache`` is the compiled
-numerics core (paged ring cache + jit prefill/decode with donation),
-``engine`` is the single-threaded scheduler (slots, admission,
-retirement), ``frontend`` is the thread-safe client face (futures,
+numerics core (paged ring cache + jit prefill/decode_k/chunk programs
+with donation), ``sampling`` is the on-device token sampler those
+programs compile in, ``engine`` is the single-threaded scheduler
+(slots, token-budget admission, retirement), ``frontend`` is the
+thread-safe client face (futures,
 RpcPolicy deadlines, watchdog-bounded aborts), ``reports`` is the
 telemetry sibling of ``training/reports.py``, and ``weights`` is the
 warm-restart snapshot plane. See docs/serving.md.
@@ -15,8 +17,12 @@ from chainermn_tpu.serving.engine import (Engine, EngineConfig, Request,
 from chainermn_tpu.serving.frontend import DeadlineExceeded, Frontend
 from chainermn_tpu.serving.kv_cache import (ServingStep, cache_bytes,
                                             cache_spec, decode_apply,
-                                            init_cache, prefill_apply)
+                                            decode_k_apply, init_cache,
+                                            prefill_apply,
+                                            prefill_chunk_apply)
 from chainermn_tpu.serving.reports import ServingReport
+from chainermn_tpu.serving.sampling import (init_keys, request_key,
+                                            sample_tokens, split_keys)
 from chainermn_tpu.serving.weights import (WeightsError, load_weights,
                                            publish_weights, pull_weights,
                                            weight_candidates)
@@ -25,8 +31,10 @@ __all__ = [
     "Engine", "EngineConfig", "Request", "default_buckets",
     "Frontend", "DeadlineExceeded",
     "ServingStep", "cache_bytes", "cache_spec", "decode_apply",
-    "init_cache", "prefill_apply",
+    "decode_k_apply", "init_cache", "prefill_apply",
+    "prefill_chunk_apply",
     "ServingReport",
+    "init_keys", "request_key", "sample_tokens", "split_keys",
     "WeightsError", "load_weights", "publish_weights", "pull_weights",
     "weight_candidates",
 ]

@@ -2,25 +2,37 @@
 paged KV cache.
 
 The engine owns a fixed grid of ``n_slots`` decode slots. Every
-``step()`` is one scheduler iteration:
+``step()`` is one scheduler iteration under a shared per-iteration
+TOKEN BUDGET (``EngineConfig.token_budget``; ``None`` → unbounded):
 
-1. **Admission** — if slots are free and requests are queued, one
-   prefill cohort runs: up to ``prefill_cohort`` same-bucket prompts,
-   right-padded to the bucket length, scattered into free slots
-   (sentinel rows fill the cohort — fixed shapes, so the compile count
-   is bounded by the bucket table, never by traffic).
-2. **Decode** — ONE ``[n_slots]`` decode step advances every live slot
-   together. Free slots ride along as garbage rows; row independence
-   keeps them from touching live logits (tested bitwise).
-3. **Retirement** — slots whose request sampled ``eos_id`` or reached
+1. **Budget** — the iteration reserves ``len(active) × decode_k``
+   tokens for decode first; prefill spends what is left.
+2. **Prefill** — either one monolithic same-bucket cohort (the classic
+   path: up to ``prefill_cohort`` prompts right-padded to the bucket
+   length, sentinel rows filling the fixed shape), or — with
+   ``prefill_chunk`` set — fixed-size ``[S, C]`` prompt CHUNKS written
+   incrementally at each slot's cursor, so a long prompt streams in
+   across iterations instead of head-of-line-blocking every active
+   decode slot. A deferral cap (``max_prefill_defer``) guarantees
+   prefill still happens under sustained decode pressure, and a wrap
+   guard force-finishes any prefill within ``decode_k`` tokens of the
+   page end before decode may run again.
+3. **Decode** — ONE ``decode_k`` dispatch advances every live slot up
+   to ``k`` tokens: sampling runs ON DEVICE (serving/sampling.py, keyed
+   by per-slot PRNG state the engine threads), EOS/budget stop masks
+   are evaluated in the compiled scan, and the host pulls a single
+   ``[n_slots, k]`` int32 array — 4 bytes/token instead of
+   ``vocab × 4`` (dlint DL110 polices the old full-logits pull).
+4. **Retirement** — slots whose request emitted ``eos_id`` or reached
    its token budget are freed for the next admission.
 
-Prefill and decode therefore co-exist without recompilation — the
-DL108 invariant: after warmup, serving any traffic mix executes exactly
-one compiled decode program plus one compiled prefill program per
-bucket. ``resilience/chaos.py::on_step`` fires at the top of every
-iteration, so ``$CHAINERMN_TPU_CHAOS='kill@step=N'`` kills a replica
-mid-decode — the supervisor drill in tests/serving_tests.
+Prefill and decode co-exist without recompilation — the DL108
+invariant: after warmup, serving any traffic mix executes exactly one
+compiled ``decode_k`` program plus one prefill program per bucket (or
+ONE chunk program total in chunked mode). ``resilience/chaos.py::
+on_step`` fires at the top of every iteration, so
+``$CHAINERMN_TPU_CHAOS='kill@step=N'`` kills a replica mid-decode — the
+supervisor drill in tests/serving_tests.
 """
 
 from __future__ import annotations
@@ -35,6 +47,7 @@ import numpy as np
 from chainermn_tpu.resilience import chaos
 from chainermn_tpu.serving.kv_cache import ServingStep
 from chainermn_tpu.serving.reports import ServingReport
+from chainermn_tpu.serving.sampling import init_keys, request_key
 
 __all__ = ["Engine", "EngineConfig", "Request", "default_buckets"]
 
@@ -59,6 +72,16 @@ class EngineConfig:
     prefill_cohort: int = 2           # S — cohort width (fixed shape)
     buckets: Optional[Sequence[int]] = None  # None → default_buckets()
     cache_dtype: object = None
+    decode_k: int = 4                 # tokens per decode dispatch (the
+    #                                   on-device scan length; 1 = the
+    #                                   classic one-token step)
+    prefill_chunk: Optional[int] = None  # chunk width C; None → the
+    #                                      monolithic per-bucket path
+    token_budget: Optional[int] = None   # per-iteration token budget
+    #                                      shared by decode + prefill;
+    #                                      None → unbounded
+    max_prefill_defer: int = 4        # iterations prefill may yield to
+    #                                   decode before it runs anyway
 
     def bucket_table(self) -> Tuple[int, ...]:
         return (tuple(sorted(self.buckets)) if self.buckets
@@ -68,29 +91,26 @@ class EngineConfig:
 @dataclasses.dataclass(eq=False)   # identity semantics (prompt is an array)
 class Request:
     """One generation stream. ``tokens`` grows as the engine emits;
-    terminal states are 'done' (eos or budget) and 'aborted'."""
+    terminal states are 'done' (eos or budget) and 'aborted'.
+
+    Sampling happens ON DEVICE (serving/sampling.py): ``temperature``
+    ``None``/``0`` → greedy argmax (bit-identical to the old host
+    ``np.argmax`` path), ``top_k`` ``None``/``0`` → full vocabulary,
+    ``seed`` keys the per-slot PRNG stream — one split per sampled
+    token, so a fixed seed replays the same stream under any scheduler
+    interleaving.
+    """
     request_id: int
     prompt: np.ndarray                # int32 [L]
     max_new_tokens: int
     eos_id: Optional[int] = None
     temperature: Optional[float] = None   # None → greedy argmax
+    top_k: Optional[int] = None           # None → full vocab
     seed: int = 0
     tokens: List[int] = dataclasses.field(default_factory=list)
     state: str = "queued"             # queued|running|done|aborted
     slot: Optional[int] = None
-    _rng: Optional[np.random.Generator] = None
-
-    def sample(self, logits: np.ndarray) -> int:
-        if self.temperature is None:
-            # first-index ties, same rule as jnp.argmax — greedy engine
-            # streams match serial generate() token for token
-            return int(np.argmax(logits))
-        if self._rng is None:
-            self._rng = np.random.default_rng(self.seed)
-        z = logits.astype(np.float64) / max(self.temperature, 1e-6)
-        z -= z.max()
-        p = np.exp(z)
-        return int(self._rng.choice(logits.shape[0], p=p / p.sum()))
+    prefill_pos: int = 0              # chunked prefill: tokens written
 
     @property
     def finished(self) -> bool:
@@ -106,21 +126,40 @@ class Engine:
                  *, mesh=None, axis=None, report: Optional[ServingReport] = None,
                  time_fn=None):
         self.config = config
+        if config.decode_k < 1:
+            raise ValueError("decode_k must be >= 1")
+        if config.prefill_chunk is not None and config.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self.steps = ServingStep(
             model, params, config.n_slots, config.capacity,
             cache_dtype=config.cache_dtype, mesh=mesh, axis=axis)
         self.report = report or (ServingReport(time_fn) if time_fn
                                  else ServingReport())
         self.queue: deque[Request] = deque()
-        self.active: Dict[int, Request] = {}          # slot → request
+        self.active: Dict[int, Request] = {}          # slot → decoding
+        self.prefilling: Dict[int, Request] = {}      # slot → mid-chunk
         self.free_slots: List[int] = list(range(config.n_slots))
         self.cur_tokens = np.zeros(config.n_slots, np.int32)
-        self.last_logits: Optional[np.ndarray] = None  # debug/parity hook
+        # per-slot sampling state, threaded through the compiled
+        # programs (sampling.py encoding: temp<=0 greedy, top_k<=0 full)
+        self._keys = init_keys(config.n_slots)
+        self._temps = np.zeros(config.n_slots, np.float32)
+        self._topks = np.zeros(config.n_slots, np.int32)
+        self._eos = np.full(config.n_slots, -1, np.int32)
+        self._prefill_defer = 0
         self.iteration = 0
         self._ids = itertools.count()
         self._buckets = config.bucket_table()
         if self._buckets[-1] < config.capacity:
             raise ValueError("largest bucket must reach capacity")
+
+    @property
+    def last_logits(self) -> Optional[np.ndarray]:
+        """Final decode-step logits ``[n_slots, vocab]`` — materialized
+        from device ONLY when read (debug/parity hook; the serving hot
+        loop itself never pulls them — that's the point of DL110)."""
+        dev = self.steps.last_decode_logits
+        return None if dev is None else np.asarray(dev)
 
     # ----------------------------------------------------------------
     # request lifecycle
@@ -129,11 +168,19 @@ class Engine:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_id: Optional[int] = None,
                temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
                seed: int = 0) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
-        if prompt.size > self._buckets[-1]:
+        if self.config.prefill_chunk is not None:
+            # chunked prefill is bucket-free; the page (and the no-wrap
+            # chunk contract) is the only length limit
+            if prompt.size > self.config.capacity:
+                raise ValueError(
+                    f"prompt length {prompt.size} exceeds the page "
+                    f"capacity ({self.config.capacity})")
+        elif prompt.size > self._buckets[-1]:
             raise ValueError(
                 f"prompt length {prompt.size} exceeds the largest prefill "
                 f"bucket ({self._buckets[-1]})")
@@ -141,7 +188,8 @@ class Engine:
                       max_new_tokens=(max_new_tokens
                                       if max_new_tokens is not None
                                       else self.config.max_new_tokens),
-                      eos_id=eos_id, temperature=temperature, seed=seed)
+                      eos_id=eos_id, temperature=temperature,
+                      top_k=top_k, seed=seed)
         self.queue.append(req)
         self.report.record_submit(req.request_id)
         return req
@@ -151,6 +199,16 @@ class Engine:
             if b >= length:
                 return b
         raise ValueError(f"no bucket covers prompt length {length}")
+
+    def _install(self, req: Request, slot: int) -> None:
+        """Bind a request to a slot: sampling state rows + PRNG key."""
+        req.slot = slot
+        req.state = "running"
+        self._temps[slot] = (req.temperature
+                             if req.temperature is not None else 0.0)
+        self._topks[slot] = req.top_k if req.top_k is not None else 0
+        self._eos[slot] = req.eos_id if req.eos_id is not None else -1
+        self._keys = self._keys.at[slot].set(request_key(req.seed))
 
     def _emit(self, req: Request, token: int) -> None:
         req.tokens.append(int(token))
@@ -166,6 +224,7 @@ class Engine:
         if req.slot is not None:
             self.free_slots.append(req.slot)
             self.active.pop(req.slot, None)
+            self.prefilling.pop(req.slot, None)
             req.slot = None
         self.report.record_retire(req.request_id, aborted=aborted)
 
@@ -174,13 +233,17 @@ class Engine:
         requeues for a warm restart) and every queued request drains back
         to the caller. Returns the affected requests."""
         hit = []
-        for req in list(self.active.values()):
+        inflight = (list(self.active.values())
+                    + list(self.prefilling.values()))
+        for req in inflight:
             if requeue:
                 req.state = "queued"
                 req.tokens = []
+                req.prefill_pos = 0
                 if req.slot is not None:
                     self.free_slots.append(req.slot)
                     self.active.pop(req.slot, None)
+                    self.prefilling.pop(req.slot, None)
                     req.slot = None
                 self.queue.appendleft(req)
             else:
@@ -198,18 +261,25 @@ class Engine:
     # scheduler iterations
     # ----------------------------------------------------------------
 
-    def _admit(self) -> int:
-        """One prefill cohort: same-bucket FIFO prompts into free slots."""
+    def _admit(self, avail: float) -> int:
+        """One monolithic prefill cohort: same-bucket FIFO prompts into
+        free slots, first token sampled on device."""
         if not self.queue or not self.free_slots:
             return 0
         s = self.config.prefill_cohort
         bucket = self._bucket_for(self.queue[0].prompt.size)
+        if (bucket > avail and self.active
+                and self._prefill_defer < self.config.max_prefill_defer):
+            # over budget: let decode keep the iteration, try again next
+            # time (the defer cap bounds prefill starvation)
+            self._prefill_defer += 1
+            return 0
+        self._prefill_defer = 0
         cohort: List[Request] = []
         while (self.queue and self.free_slots and len(cohort) < s
                and self._bucket_for(self.queue[0].prompt.size) == bucket):
             req = self.queue.popleft()
-            req.slot = self.free_slots.pop(0)
-            req.state = "running"
+            self._install(req, self.free_slots.pop(0))
             self.active[req.slot] = req
             cohort.append(req)
         tokens = np.zeros((s, bucket), np.int32)
@@ -219,32 +289,153 @@ class Engine:
             tokens[i, :req.prompt.size] = req.prompt
             lengths[i] = req.prompt.size
             slot_ids[i] = req.slot
-        logits = np.asarray(self.steps.prefill(tokens, lengths, slot_ids))
+        tok, self._keys = self.steps.prefill_sampled(
+            tokens, lengths, slot_ids, self._keys, self._temps,
+            self._topks)
+        first = np.asarray(tok)                 # [S] int32 — ids, never logits
+        self.report.record_host_bytes(first.nbytes)
         for i, req in enumerate(cohort):
-            self._emit(req, req.sample(logits[i]))
+            self._emit(req, int(first[i]))
         return len(cohort)
 
+    def _advance_prefill_chunks(self, avail: float) -> int:
+        """Chunked prefill scheduling: spend the iteration's leftover
+        token budget on fixed-size chunk cohorts — in-flight prefills
+        first (oldest request first), fresh admissions filling the rest
+        of each cohort. Two overrides beat the budget: the WRAP GUARD
+        (a prefilling slot within ``decode_k`` of the page end must
+        finish before decode's garbage rows can wrap its cursor over
+        real prefix tokens) and the livelock guard (if nothing else can
+        make progress this iteration, one cohort runs regardless)."""
+        cfg = self.config
+        c = cfg.prefill_chunk
+        s = cfg.prefill_cohort
+        admitted = 0
+        spent = 0
+        dispatched = False
+        while True:
+            forced = sorted(
+                slot for slot, r in self.prefilling.items()
+                if r.prefill_pos + cfg.decode_k > self.steps.capacity)
+            if not forced:
+                if not (self.prefilling
+                        or (self.queue and self.free_slots)):
+                    break
+                if dispatched and cfg.token_budget is None:
+                    break       # unbudgeted: one cohort per iteration
+                over = spent + c > avail
+                starved = self._prefill_defer >= cfg.max_prefill_defer
+                if over and not starved and (self.active or dispatched):
+                    self._prefill_defer += 1
+                    break
+            cohort = [(slot, self.prefilling[slot])
+                      for slot in forced[:s]]
+            for slot, req in sorted(self.prefilling.items(),
+                                    key=lambda kv: kv[1].request_id):
+                if len(cohort) >= s:
+                    break
+                if all(slot != s0 for s0, _ in cohort):
+                    cohort.append((slot, req))
+            while len(cohort) < s and self.queue and self.free_slots:
+                req = self.queue.popleft()
+                slot = self.free_slots.pop(0)
+                self._install(req, slot)
+                self.prefilling[slot] = req
+                admitted += 1
+                cohort.append((slot, req))
+            if not cohort:
+                break
+            self._prefill_defer = 0
+            spent += len(cohort) * c
+            self._dispatch_chunk(cohort)
+            dispatched = True
+        return admitted
+
+    def _dispatch_chunk(self, cohort) -> None:
+        """One fixed-shape ``[S, C]`` chunk dispatch; completing rows
+        sample their first token on device and move to decode."""
+        cfg = self.config
+        c = cfg.prefill_chunk
+        s = cfg.prefill_cohort
+        tokens = np.zeros((s, c), np.int32)
+        starts = np.zeros(s, np.int32)
+        valid = np.ones(s, np.int32)            # sentinel rows: 1 token
+        sids = np.full(s, self.steps.n_slots, np.int32)
+        final = np.zeros(s, bool)
+        for i, (slot, req) in enumerate(cohort):
+            pos = req.prefill_pos
+            v = min(c, req.prompt.size - pos)
+            tokens[i, :v] = req.prompt[pos:pos + v]
+            starts[i] = pos
+            valid[i] = v
+            sids[i] = slot
+            final[i] = pos + v == req.prompt.size
+        tok, self._keys = self.steps.prefill_chunk(
+            tokens, starts, valid, sids, final, self._keys, self._temps,
+            self._topks)
+        first = np.asarray(tok)                 # [S] int32 ids (-1 = not final)
+        self.report.record_host_bytes(first.nbytes)
+        for i, (slot, req) in enumerate(cohort):
+            req.prefill_pos += int(valid[i])
+            if final[i]:
+                del self.prefilling[slot]
+                self.active[slot] = req
+                self._emit(req, int(first[i]))
+
+    def _decode(self) -> int:
+        """One ``decode_k`` dispatch for the whole grid; the host pulls
+        a single ``[n_slots, k]`` int32 array (validity in-band as -1)
+        and replays the device's EOS/budget retirement decisions."""
+        cfg = self.config
+        n = cfg.n_slots
+        live = np.zeros(n, bool)
+        remaining = np.ones(n, np.int32)
+        for slot, req in self.active.items():
+            live[slot] = True
+            remaining[slot] = req.max_new_tokens - len(req.tokens)
+        park = np.zeros(n, np.int32)
+        for slot, req in self.prefilling.items():
+            park[slot] = req.prefill_pos
+        toks_dev, self._keys = self.steps.decode_k(
+            self.cur_tokens, self._keys, self._temps, self._topks,
+            self._eos, remaining, live, park, cfg.decode_k)
+        toks = np.asarray(toks_dev)             # [n, k] int32 — the ONLY
+        #                                         per-token host transfer
+        self.report.record_host_bytes(toks.nbytes)
+        emitted = 0
+        for slot, req in list(self.active.items()):
+            for j in range(cfg.decode_k):
+                t = int(toks[slot, j])
+                if t < 0:
+                    break
+                self._emit(req, t)
+                emitted += 1
+                if req.finished:
+                    break
+        return emitted
+
     def step(self) -> dict:
-        """One scheduler iteration: chaos hook → admission → decode →
-        retirement. Returns counters for the caller's loop policy."""
+        """One scheduler iteration: chaos hook → token budget → prefill
+        (chunked or monolithic) → decode_k → retirement. Returns
+        counters for the caller's loop policy."""
         chaos.on_step(self.iteration)
         self.iteration += 1
-        admitted = self._admit()
-        emitted = 0
-        if self.active:
-            logits = np.asarray(self.steps.decode(self.cur_tokens))
-            self.last_logits = logits
-            for slot, req in list(self.active.items()):
-                self._emit(req, req.sample(logits[slot]))
-                emitted += 1
+        budget = self.config.token_budget
+        avail = (float("inf") if budget is None
+                 else budget - len(self.active) * self.config.decode_k)
+        if self.config.prefill_chunk is not None:
+            admitted = self._advance_prefill_chunks(avail)
+        else:
+            admitted = self._admit(avail)
+        emitted = self._decode() if self.active else 0
         self.report.record_step(
             len(self.queue),
-            len(self.active) / self.config.n_slots)
+            (len(self.active) + len(self.prefilling)) / self.config.n_slots)
         return {"admitted": admitted, "emitted": emitted,
                 "active": len(self.active), "queued": len(self.queue)}
 
     def idle(self) -> bool:
-        return not self.queue and not self.active
+        return not self.queue and not self.active and not self.prefilling
 
     def run_until_drained(self, max_steps: int = 100_000) -> int:
         """Step until no queued or active work remains; returns the
@@ -254,7 +445,7 @@ class Engine:
             if n >= max_steps:
                 raise RuntimeError(
                     f"engine failed to drain within {max_steps} steps")
-            # step() syncs internally: np.asarray pulls every logit row
+            # step() syncs internally: one [n_slots, k] int32 pull
             self.step()  # dlint: disable=DL104
             n += 1
         return n

@@ -67,7 +67,11 @@ class Frontend:
 
     def submit(self, prompt, **kw) -> Future:
         """Queue one generation request; the Future resolves to the
-        engine's Request (``.tokens`` holds the emitted ids)."""
+        engine's Request (``.tokens`` holds the emitted ids). Keyword
+        arguments pass straight through to ``Engine.submit`` — per-
+        request ``max_new_tokens``, ``eos_id``, and the on-device
+        sampling knobs ``temperature``/``top_k``/``seed``
+        (serving/sampling.py)."""
         if self._stop.is_set():
             raise RuntimeError("frontend is closed")
         fut: Future = Future()
@@ -159,8 +163,8 @@ class Frontend:
                 pass
             with self._lock:
                 if not self.engine.idle():
-                    # Engine.step() syncs internally (np.asarray pulls
-                    # every logit row before sampling)
+                    # Engine.step() syncs internally (one [n_slots, k]
+                    # int32 token pull — sampling stays on device)
                     self.engine.step()  # dlint: disable=DL104
                     worked = True
                     for rid, (fut, req) in list(self._futures.items()):

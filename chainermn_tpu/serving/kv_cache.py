@@ -27,6 +27,25 @@ Two compiled entry points, following the SNIPPETS Partitioner shape
   shapes by construction: traced once, reused forever (the DL108
   trap this module exists to avoid).
 
+On top of the pair, the multi-token dispatches the engine actually
+serves with (ISSUE 10):
+
+* ``decode_k`` — ``k`` decode steps under one ``jax.lax.scan`` with
+  on-device sampling (``serving/sampling.py``) feeding each step's
+  token to the next, plus per-slot EOS/budget stop masks. One host
+  dispatch commits up to ``k`` tokens and transfers ``O(n_slots)``
+  int32 ids (4 bytes/token) instead of ``O(n_slots × vocab)`` f32
+  logits. Mid-prefill slots ride along PARKED: their cursors are
+  pinned to the host-supplied fill level around the scan so decode
+  garbage never walks them toward a ring wrap.
+* ``prefill_chunk`` — a fixed ``[S, C]`` window of prompt tokens
+  written incrementally at each slot's ``pos_offset`` cursor
+  (``chunked_prefill=True`` model twin: the slab attends prefix +
+  itself under an absolute-position mask). ONE compiled program for
+  any prompt length — long prompts stream in without head-of-line
+  blocking decode, and chunked == monolithic bitwise (same tokens,
+  same cache bytes — tests/serving_tests/test_sampling.py).
+
 Numerics contract (tested bitwise): with ``capacity`` ≥ the full stream
 length and ``attention='reference'``, cached decode logits equal the
 corresponding full-forward column BITWISE — the decode branch uses
@@ -42,9 +61,11 @@ import jax
 import jax.numpy as jnp
 
 from chainermn_tpu.models.transformer import bhld_to_blhd_params
+from chainermn_tpu.serving.sampling import sample_tokens
 
 __all__ = ["init_cache", "cache_bytes", "cache_spec", "decode_apply",
-           "prefill_apply", "ServingStep"]
+           "prefill_apply", "decode_k_apply", "prefill_chunk_apply",
+           "ServingStep"]
 
 
 def _check_servable(model):
@@ -154,6 +175,115 @@ def prefill_apply(model, params, cache, tokens, lengths, slot_ids):
     return last, new_cache
 
 
+def prefill_chunk_apply(model, params, cache, tokens, starts, valid,
+                        slot_ids):
+    """PURE chunk prefill against the PAGED cache: tokens int32
+    ``[S, C]`` (right-padded), starts ``[S]`` (absolute write offsets =
+    each slot's current fill), valid ``[S]`` (real tokens in this
+    chunk), slot_ids ``[S]`` (sentinel ``n_slots`` = padding row).
+
+    Gathers the cohort's pages, runs the chunk forward with
+    ``chunked_prefill=True`` (the slab attends the cached prefix plus
+    itself — models/transformer.py), scatters the chunk's K/V back at
+    ``[start, start+valid)`` (padding columns and sentinel rows drop),
+    advances the cursors to ``start + valid``, and returns
+    (last-real-position logits ``[S, vocab]``, new cache). No-wrap
+    contract: prompts must fit the page (``prompt_len <= capacity``) —
+    the engine enforces it at submit.
+    """
+    dm = (model if (model.decode and model.chunked_prefill)
+          else model.clone(decode=True, chunked_prefill=True))
+    s, c = tokens.shape
+    n_slots, capacity = cache["block_0"]["k"].shape[:2]
+    if c > capacity:
+        raise ValueError(
+            f"prefill chunk length {c} exceeds page capacity {capacity}")
+    sid = jnp.asarray(slot_ids, jnp.int32)
+    gid = jnp.clip(sid, 0, n_slots - 1)   # sentinels borrow row 0 (reads
+    #                                       only — their writes drop)
+    starts = jnp.asarray(starts, jnp.int32)
+    valid = jnp.asarray(valid, jnp.int32)
+    sub = {name: {"k": page["k"][gid], "v": page["v"][gid], "idx": starts}
+           for name, page in cache.items()}
+    logits, upd = dm.apply(
+        {"params": params, "cache": sub}, tokens, pos_offset=starts,
+        mutable=["cache"])
+    last = jnp.take_along_axis(
+        logits, jnp.clip(valid - 1, 0, c - 1)[:, None, None], axis=1)[:, 0]
+    rows_i = jnp.arange(s)[:, None]
+    cols = starts[:, None] + jnp.arange(c)[None]
+    # padding columns point past the page end → mode='drop' eats them,
+    # exactly like the sentinel slot id on the row axis
+    cols = jnp.where(jnp.arange(c)[None] < valid[:, None], cols, capacity)
+    gather_cols = jnp.clip(cols, 0, capacity - 1)
+    new_cache = {}
+    for name, page in cache.items():
+        uk = upd["cache"][name]["k"][rows_i, gather_cols]
+        uv = upd["cache"][name]["v"][rows_i, gather_cols]
+        new_cache[name] = {
+            "k": page["k"].at[sid[:, None], cols].set(uk, mode="drop"),
+            "v": page["v"].at[sid[:, None], cols].set(uv, mode="drop"),
+            "idx": page["idx"].at[sid].set(starts + valid, mode="drop"),
+        }
+    return last, new_cache
+
+
+def decode_k_apply(model, params, cache, tokens, keys, temps, top_ks,
+                   eos_ids, remaining, live, park, k):
+    """PURE multi-token decode: ``k`` grid steps under one scan, sampling
+    on device each step and feeding the result to the next.
+
+    tokens ``[n]`` int32 (each live slot's latest token); keys
+    ``[n, 2]`` uint32 per-slot PRNG state; temps/top_ks ``[n]`` sampling
+    knobs (sampling.py encoding); eos_ids ``[n]`` int32 (< 0 → no eos);
+    remaining ``[n]`` int32 token budget; live ``[n]`` bool; park
+    ``[n]`` int32 — the real fill level of each NON-live slot (mid-
+    prefill slots especially), pinned around the scan so the k garbage
+    steps those rows ride along for cannot advance their cursors into a
+    ring wrap over real prefix tokens.
+
+    Returns ``(toks [n, k] int32 — -1 where the slot was not live,
+    last_logits [n, vocab] f32, keys, cache)``. The -1 convention lets
+    the host pull ONE int32 array per dispatch: validity is in-band.
+    """
+    dm = model if model.decode else model.clone(decode=True)
+    tokens = jnp.asarray(tokens, jnp.int32)
+    live = jnp.asarray(live, bool)
+    park = jnp.asarray(park, jnp.int32)
+    remaining = jnp.asarray(remaining, jnp.int32)
+    eos_ids = jnp.asarray(eos_ids, jnp.int32)
+    temps = jnp.asarray(temps, jnp.float32)
+    top_ks = jnp.asarray(top_ks, jnp.int32)
+
+    def pin(c):
+        return {name: {**page, "idx": jnp.where(live, page["idx"], park)}
+                for name, page in c.items()}
+
+    cache = pin(cache)
+    zeros = jnp.zeros((tokens.shape[0], dm.vocab), jnp.float32)
+
+    def body(carry, _):
+        cache, tok, keys, rem, alive, _last = carry
+        logits, cache = decode_apply(dm, params, cache, tok)
+        nxt, keys2 = sample_tokens(logits, keys, temps, top_ks)
+        # only rows that really sampled consume a key split — the
+        # per-request stream position is independent of k and neighbours
+        keys = jnp.where(alive[:, None], keys2, keys)
+        valid = alive
+        rem = rem - valid.astype(jnp.int32)
+        hit_eos = (nxt == eos_ids) & (eos_ids >= 0)
+        alive = alive & ~hit_eos & (rem > 0)
+        tok = jnp.where(valid, nxt, tok)
+        out = jnp.where(valid, nxt, jnp.int32(-1))
+        return (cache, tok, keys, rem, alive, logits), out
+
+    (cache, _, keys, _, _, last), toks = jax.lax.scan(
+        body, (cache, tokens, keys, remaining, live, zeros), None,
+        length=k)
+    cache = pin(cache)   # non-live cursors back to their real fill
+    return toks.T, last, keys, cache
+
+
 class ServingStep:
     """The compiled prefill/decode pair, owning the paged cache.
 
@@ -177,13 +307,21 @@ class ServingStep:
             model = model.clone(qkv_layout="blhd")
         self.model = model
         self.dm = model.clone(decode=True)
+        self.dm_chunk = self.dm.clone(chunked_prefill=True)
         self.params = params
         self.n_slots = int(n_slots)
         self.capacity = int(capacity)
         self.cache = init_cache(model, n_slots, capacity, cache_dtype)
         self.decode_traces = 0
+        self.decode_k_traces = 0
         self.prefill_traces: Dict[tuple, int] = {}
+        self.prefill_chunk_traces: Dict[tuple, int] = {}
         self._prefill_jits: Dict[tuple, Any] = {}
+        self._prefill_sampled_jits: Dict[tuple, Any] = {}
+        self._prefill_chunk_jits: Dict[tuple, Any] = {}
+        self._decode_k_jits: Dict[int, Any] = {}
+        self.last_decode_logits = None   # device [n_slots, vocab] —
+        #                                  engine's lazy debug/parity hook
         self._mesh = mesh
         self._axis = axis
         donate_args = (1,) if donate else ()
@@ -261,6 +399,134 @@ class ServingStep:
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(slot_ids, jnp.int32))
         return logits
+
+    def decode_k(self, tokens, keys, temps, top_ks, eos_ids, remaining,
+                 live, park, k: int):
+        """``k`` decode steps + on-device sampling in ONE dispatch (see
+        :func:`decode_k_apply`). Compiled once per ``k`` with the cache
+        donated — ``decode_k_traces`` counts compiles (the DL108
+        invariant extends here: any traffic mix at fixed ``k`` runs one
+        program). Returns ``(toks [n, k] int32 device, new keys)``;
+        the step's final logits stay ON DEVICE in
+        ``self.last_decode_logits`` until somebody actually reads them.
+        """
+        kk = int(k)
+        if kk not in self._decode_k_jits:
+            def _decode_k(params, cache, tokens, keys, temps, top_ks,
+                          eos_ids, remaining, live, park, _k=kk):
+                self.decode_k_traces += 1   # trace-time only
+                return decode_k_apply(self.dm, params, cache, tokens,
+                                      keys, temps, top_ks, eos_ids,
+                                      remaining, live, park, _k)
+
+            kw = {}
+            if self._mesh is not None:
+                repl, cache_sh = self._shardings(self._mesh, self._axis)
+                kw = dict(
+                    in_shardings=(repl, cache_sh) + (repl,) * 8,
+                    out_shardings=(repl, repl, repl, cache_sh))
+            self._decode_k_jits[kk] = jax.jit(
+                _decode_k, donate_argnums=self._donate, **kw)
+        toks, last, keys, self.cache = self._decode_k_jits[kk](
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+            keys, jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32),
+            jnp.asarray(eos_ids, jnp.int32),
+            jnp.asarray(remaining, jnp.int32),
+            jnp.asarray(live, bool), jnp.asarray(park, jnp.int32))
+        self.last_decode_logits = last
+        return toks, keys
+
+    def prefill_sampled(self, tokens, lengths, slot_ids, keys, temps,
+                        top_ks):
+        """Cohort prefill + on-device first-token sampling: one dispatch
+        returns ``(tok [S] int32 device, new keys)`` instead of shipping
+        ``[S, vocab]`` logits to the host. Greedy rows are bit-identical
+        to ``np.argmax`` over :meth:`prefill`'s logits (sampling.py).
+        Compiled per (S, L) shape, counted in ``prefill_traces`` under
+        the same (S, L) keys as the logits path — one program per
+        bucket either way (the DL108 trace-table assertions carry over
+        unchanged)."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        key = tokens.shape
+        if key not in self._prefill_sampled_jits:
+            def _pf(params, cache, tokens, lengths, slot_ids, keys,
+                    temps, top_ks, _key=key):
+                self.prefill_traces[_key] = (
+                    self.prefill_traces.get(_key, 0) + 1)
+                last, cache = prefill_apply(self.dm, params, cache,
+                                            tokens, lengths, slot_ids)
+                sid = jnp.asarray(slot_ids, jnp.int32)
+                gid = jnp.clip(sid, 0, self.n_slots - 1)
+                tok, newk = sample_tokens(last, keys[gid], temps[gid],
+                                          top_ks[gid])
+                # sentinel rows (sid == n_slots) drop out of the key
+                # scatter — their splits never touch a live slot's stream
+                keys = keys.at[sid].set(newk, mode="drop")
+                return tok, keys, cache
+
+            kw = {}
+            if self._mesh is not None:
+                repl, cache_sh = self._shardings(self._mesh, self._axis)
+                kw = dict(in_shardings=(repl, cache_sh) + (repl,) * 6,
+                          out_shardings=(repl, repl, cache_sh))
+            self._prefill_sampled_jits[key] = jax.jit(
+                _pf, donate_argnums=self._donate, **kw)
+        tok, keys, self.cache = self._prefill_sampled_jits[key](
+            self.params, self.cache, tokens,
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(slot_ids, jnp.int32), keys,
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32))
+        return tok, keys
+
+    def prefill_chunk(self, tokens, starts, valid, slot_ids, final, keys,
+                      temps, top_ks):
+        """One fixed-shape prompt chunk for up to S slots (see
+        :func:`prefill_chunk_apply`), sampling the first token on device
+        for rows whose chunk is ``final``. Returns ``(tok [S] int32
+        device — -1 for non-final rows, new keys)``. ONE compiled
+        program per (S, C) shape regardless of prompt length — counted
+        in ``prefill_chunk_traces``."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        key = tokens.shape
+        if key not in self._prefill_chunk_jits:
+            def _pc(params, cache, tokens, starts, valid, slot_ids,
+                    final, keys, temps, top_ks, _key=key):
+                self.prefill_chunk_traces[_key] = (
+                    self.prefill_chunk_traces.get(_key, 0) + 1)
+                last, cache = prefill_chunk_apply(
+                    self.dm_chunk, params, cache, tokens, starts, valid,
+                    slot_ids)
+                sid = jnp.asarray(slot_ids, jnp.int32)
+                gid = jnp.clip(sid, 0, self.n_slots - 1)
+                tok, newk = sample_tokens(last, keys[gid], temps[gid],
+                                          top_ks[gid])
+                # only a COMPLETING chunk consumes its slot's key split:
+                # the stream position depends on tokens sampled, never
+                # on how many chunks the prompt was carved into
+                adv = final & (sid < self.n_slots)
+                keys = keys.at[sid].set(
+                    jnp.where(adv[:, None], newk, keys[gid]), mode="drop")
+                tok = jnp.where(final, tok, jnp.int32(-1))
+                return tok, keys, cache
+
+            kw = {}
+            if self._mesh is not None:
+                repl, cache_sh = self._shardings(self._mesh, self._axis)
+                kw = dict(in_shardings=(repl, cache_sh) + (repl,) * 8,
+                          out_shardings=(repl, repl, cache_sh))
+            self._prefill_chunk_jits[key] = jax.jit(
+                _pc, donate_argnums=self._donate, **kw)
+        tok, keys, self.cache = self._prefill_chunk_jits[key](
+            self.params, self.cache, tokens,
+            jnp.asarray(starts, jnp.int32),
+            jnp.asarray(valid, jnp.int32),
+            jnp.asarray(slot_ids, jnp.int32),
+            jnp.asarray(final, bool), keys,
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32))
+        return tok, keys
 
     def load_params(self, params):
         """Swap weights in place (warm restart — serving/weights.py)."""

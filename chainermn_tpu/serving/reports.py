@@ -48,6 +48,7 @@ class ServingReport:
         self.completed = 0
         self.aborted = 0
         self.tokens_emitted = 0
+        self.host_bytes = 0           # device→host bytes on the emit path
         self.ttft_s: List[float] = []
         self.token_gap_s: List[float] = []
         self.queue_depth_samples: List[int] = []
@@ -93,6 +94,15 @@ class ServingReport:
         self.queue_depth_samples.append(int(queue_depth))
         self.occupancy_samples.append(float(occupancy))
 
+    def record_host_bytes(self, nbytes: int) -> None:
+        """Device→host transfer on the token-emit path (the engine calls
+        this per dispatch with the pulled array's ``nbytes``). With
+        on-device sampling this is int32 token ids only — the
+        ``host_bytes_per_token`` summary key is the observable DL110
+        exists to keep small (bench.py gates decode traffic at
+        ≤ 8 bytes/token; the old full-logits pull was ``vocab × 4``)."""
+        self.host_bytes += int(nbytes)
+
     # ----------------------------------------------------------------
     # output
     # ----------------------------------------------------------------
@@ -118,7 +128,13 @@ class ServingReport:
             "tokens_emitted": self.tokens_emitted,
             "tokens_per_s": (self.tokens_emitted / span if span > 0
                              else float("nan")),
+            "host_bytes_per_token": (self.host_bytes / self.tokens_emitted
+                                     if self.tokens_emitted
+                                     else float("nan")),
             "ttft_ms": self._dist_ms(self.ttft_s),
+            # inter-token latency — the standard serving-benchmark name
+            # for the same per-request token-gap distribution
+            "itl_ms": self._dist_ms(self.token_gap_s),
             "token_latency_ms": self._dist_ms(self.token_gap_s),
             "queue_depth": {"mean": (sum(qd) / len(qd) if qd
                                      else float("nan")),

@@ -1,0 +1,87 @@
+"""On-device token sampling — the piece that lets decode stop shipping
+logits across the host boundary.
+
+The host-side loop this replaces (``np.asarray`` of the full
+``[n_slots, vocab]`` logits + a Python ``np.argmax``/softmax per slot —
+dlint DL110's target) moved the one array that grows with vocabulary
+over PCIe once per generated token. Here sampling compiles INTO the
+decode program: :func:`sample_tokens` is pure jax, takes the per-slot
+PRNG keys/temperatures/top-k the engine threads as state, and returns
+int32 token ids — so a ``decode_k`` dispatch transfers ``O(n_slots)``
+ids instead of ``O(n_slots × vocab)`` floats (gated ≤ 8 bytes/token in
+bench.py).
+
+Encoding conventions (the engine's ``None`` → array mapping):
+
+* ``temperature <= 0``  → greedy ``jnp.argmax`` (first-index ties —
+  bit-identical to the host ``np.argmax`` path it replaces);
+* ``top_k <= 0``        → no truncation (full vocabulary);
+* keys are RAW uint32 ``[n, 2]`` PRNG keys (``jax.random.PRNGKey``
+  layout) so they scan/scatter as plain arrays.
+
+Determinism contract: one key split per SAMPLED token, per slot —
+independent of ``decode_k``, chunk size, or neighbouring traffic — so a
+fixed per-request ``seed`` replays the same stream under any scheduler
+interleaving (tested in tests/serving_tests/test_sampling.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["request_key", "init_keys", "split_keys", "sample_tokens"]
+
+
+def request_key(seed: int):
+    """Raw uint32 ``[2]`` key for one request (set into the engine's
+    per-slot key matrix at admission)."""
+    return jax.random.PRNGKey(seed)
+
+
+def init_keys(n: int):
+    """The engine's resting key state: ``[n, 2]`` zeros (free slots
+    sample garbage rows nobody reads — row independence, as everywhere
+    in the serving grid)."""
+    return jnp.zeros((n, 2), jnp.uint32)
+
+
+def split_keys(keys):
+    """Per-row key split: ``[n, 2]`` → (advanced keys, subkeys)."""
+    both = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    return both[:, 0], both[:, 1]
+
+
+def sample_tokens(logits, keys, temperature, top_k):
+    """One sampled token per row, entirely on device.
+
+    logits ``[n, vocab]`` f32; keys ``[n, 2]`` uint32; temperature
+    ``[n]`` f32 (``<= 0`` → greedy); top_k ``[n]`` int32 (``<= 0`` → full
+    vocab). Returns ``(tokens [n] int32, new_keys [n, 2])``.
+
+    Every row consumes exactly one split — greedy rows too — so the key
+    stream position depends only on how many tokens a slot has sampled,
+    never on its neighbours' sampling modes. Callers freeze keys for
+    rows that didn't really sample (dead/pad rows) with a ``where`` on
+    the returned keys.
+    """
+    logits = logits.astype(jnp.float32)
+    n, v = logits.shape
+    temperature = jnp.asarray(temperature, jnp.float32)
+    top_k = jnp.asarray(top_k, jnp.int32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    new_keys, sub = split_keys(keys)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # top-k truncation with a TRACED k: sort descending, gather the
+    # k-th value per row, mask everything strictly below it (same
+    # >=-kth tie rule as generate()'s static-k lax.top_k path)
+    kth_idx = jnp.clip(top_k - 1, 0, v - 1)
+    srt = -jnp.sort(-scaled, axis=-1)
+    kth = jnp.take_along_axis(srt, kth_idx[:, None], axis=-1)
+    truncated = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    scaled = jnp.where((top_k > 0)[:, None], truncated, scaled)
+    sampled = jax.vmap(jax.random.categorical)(sub, scaled).astype(jnp.int32)
+
+    tokens = jnp.where(temperature > 0, sampled, greedy)
+    return tokens, new_keys

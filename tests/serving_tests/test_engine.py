@@ -1,6 +1,8 @@
 """Continuous-batching engine: stream parity, retirement, admission
 isolation, and the no-recompile invariant."""
 
+import functools
+
 import numpy as np
 import pytest
 
@@ -21,8 +23,9 @@ def _model(**kw):
     return TransformerLM(**base)
 
 
-def _setup(seed=0, **kw):
-    model = _model(**kw)
+@functools.lru_cache(maxsize=None)
+def _setup(seed=0):
+    model = _model()
     params = model.init(jax.random.PRNGKey(seed),
                         jnp.zeros((1, 4), jnp.int32))["params"]
     return model, params
@@ -134,7 +137,10 @@ def test_no_recompilation_under_mixed_traffic():
         eng.submit(rng.randint(0, 43, (l,)).astype(np.int32))
         eng.step()  # dlint: disable=DL104 — syncs via np.asarray
     eng.run_until_drained()
-    assert eng.steps.decode_traces == 1
+    # the multi-token program inherits the invariant: ONE decode_k
+    # trace under any traffic mix (the single-step program never runs)
+    assert eng.steps.decode_k_traces == 1
+    assert eng.steps.decode_traces == 0
     # buckets 4 and 8 were exercised, each compiled exactly once
     assert set(eng.steps.prefill_traces) == {(2, 4), (2, 8)}
     assert all(v == 1 for v in eng.steps.prefill_traces.values())

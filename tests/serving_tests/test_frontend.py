@@ -1,5 +1,6 @@
 """Frontend: thread-safe futures, RpcPolicy deadlines, watchdog aborts."""
 
+import functools
 import threading
 from concurrent.futures import Future
 
@@ -16,12 +17,20 @@ from chainermn_tpu.serving.engine import Engine, EngineConfig
 from chainermn_tpu.serving.frontend import DeadlineExceeded, Frontend
 
 
-def _engine(**cfg_kw):
+@functools.lru_cache(maxsize=None)
+def _setup():
+    # shared across tests: only model/params are cached — each test gets
+    # a fresh Engine so slot/report state stays isolated
     model = TransformerLM(vocab=43, d_model=32, n_heads=4, n_layers=1,
                           d_ff=48, max_len=64, attention="reference",
                           pos_emb="rope")
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(**cfg_kw):
+    model, params = _setup()
     base = dict(n_slots=2, capacity=16, max_new_tokens=4,
                 prefill_cohort=1, buckets=[4, 16])
     base.update(cfg_kw)

@@ -89,8 +89,10 @@ def test_per_slot_cursors_advance_independently():
         logits = step.decode(tok)
         refs = [s.decode(tok[i:i + 1]) for i, s in enumerate(solo)]
         for i, r in enumerate(refs):
-            np.testing.assert_array_equal(np.asarray(logits[i]),
-                                          np.asarray(r[0]))
+            # bitwise parity oracle: comparing FULL logit rows is the
+            # point here, not a serving hot loop
+            np.testing.assert_array_equal(  # dlint: disable=DL110
+                np.asarray(logits[i]), np.asarray(r[0]))
         tok = jnp.argmax(logits, -1).astype(jnp.int32)
 
 
@@ -125,7 +127,8 @@ def test_ring_wrap_is_a_sliding_window():
     logits = step.prefill(prompt, [4], [0])
     toks = [int(t) for t in prompt[0]]
     for _ in range(total - 4):
-        nxt = int(np.argmax(np.asarray(logits)[0]))
+        # argmax on device, pull the id — the DL110-clean loop shape
+        nxt = int(jnp.argmax(logits[0]))
         toks.append(nxt)
         logits = step.decode([nxt])
     # suffix recompute: the last cap tokens, rope offset to their global
@@ -167,7 +170,9 @@ def test_explicit_mesh_shardings(comm):
     for _ in range(2):
         da = plain.decode(tok)
         db = sharded.decode(tok)
-        np.testing.assert_array_equal(np.asarray(da), np.asarray(db))
+        # bitwise parity oracle — full rows on purpose
+        np.testing.assert_array_equal(  # dlint: disable=DL110
+            np.asarray(da), np.asarray(db))
         tok = jnp.argmax(da, -1).astype(jnp.int32)
 
 

@@ -1,0 +1,328 @@
+"""On-device sampling, decode_k, and chunked prefill: the bitwise
+contracts ISSUE 10 promises.
+
+Three families of pins:
+
+* **Greedy parity** — on-device argmax sampling is bit-identical to the
+  host ``np.argmax`` path it replaced, and one ``decode_k`` dispatch
+  equals ``k`` single-step decodes token-for-token.
+* **Chunked == monolithic** — prefilling a prompt in fixed-size chunks
+  leaves the SAME cache bytes and samples the SAME first token as one
+  monolithic prefill, for every chunk size (including sizes that don't
+  divide the prompt and chunks crossing bucket boundaries).
+* **Seed determinism** — a fixed per-request seed replays the same
+  sampled stream under any scheduler shape (``decode_k``, chunking,
+  neighbouring traffic), because each slot consumes exactly one key
+  split per sampled token.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.models.transformer import TransformerLM, generate
+from chainermn_tpu.serving.engine import Engine, EngineConfig
+from chainermn_tpu.serving.kv_cache import ServingStep
+from chainermn_tpu.serving.sampling import init_keys, sample_tokens
+
+
+# single layer keeps compiles cheap — the contracts here are about
+# scheduling and sampling, not depth (the cache-bytes test opts into 2)
+@functools.lru_cache(maxsize=None)
+def _setup(seed=0, n_layers=1):
+    model = TransformerLM(vocab=43, d_model=32, n_heads=4,
+                          n_layers=n_layers, d_ff=48, max_len=64,
+                          attention="reference", pos_emb="rope")
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _prompts(seed, lens, vocab=43):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, (l,)).astype(np.int32) for l in lens]
+
+
+def _stream_with_fresh_id(model, params, plen, n_new):
+    """(prompt, greedy stream, i) where ref[i] does NOT occur earlier in
+    the stream — an eos candidate whose stop mask can only fire at step
+    i. Tiny-vocab greedy streams repeat values quickly, so probe prompt
+    seeds until one qualifies (generate() is cached per prompt length)."""
+    for ps in range(32):
+        p = _prompts(ps, [plen])[0]
+        ref = np.asarray(generate(model, params, p[None], n_new))[0, plen:]
+        i = next((j for j in range(2, len(ref)) if ref[j] not in ref[:j]),
+                 None)
+        if i is not None:
+            return p, ref, i
+    raise AssertionError("no greedy stream with a fresh mid-stream id")
+
+
+# --------------------------------------------------------------------
+# greedy parity: device sampling == host argmax
+# --------------------------------------------------------------------
+
+def test_greedy_sampling_matches_host_argmax_bitwise():
+    """temperature <= 0 rows are a plain jnp.argmax — identical ids to
+    np.argmax over the same logits, ties resolved to the first index."""
+    rng = np.random.RandomState(0)
+    logits = rng.randn(5, 43).astype(np.float32)
+    logits[2, 7] = logits[2, 11] = logits[2].max() + 1.0   # forced tie
+    toks, _ = jax.jit(sample_tokens)(
+        jnp.asarray(logits), init_keys(5),
+        np.zeros(5, np.float32), np.zeros(5, np.int32))
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  np.argmax(logits, axis=-1))
+    assert int(np.asarray(toks)[2]) == 7      # first-index tie rule
+
+
+def test_decode_k_equals_k_single_steps_greedy():
+    """One decode_k dispatch == k single-step decodes, token for token,
+    against an identically prefilled grid (same params, same cache)."""
+    model, params = _setup()
+    prompts = _prompts(1, [4, 4])
+    k = 5
+
+    # reference: prefill + k host-argmax single steps (the old hot loop)
+    ref = ServingStep(model, params, n_slots=2, capacity=32)
+    last = np.asarray(ref.prefill(np.stack(prompts), [4, 4], [0, 1]))
+    cur = np.argmax(last, axis=-1).astype(np.int32)
+    t0 = cur.copy()
+    want = []
+    for _ in range(k):
+        logits = ref.decode(cur)
+        cur = np.asarray(jnp.argmax(logits, axis=-1), dtype=np.int32)
+        want.append(cur.copy())
+    want = np.stack(want, axis=1)              # [2, k]
+
+    dev = ServingStep(model, params, n_slots=2, capacity=32)
+    tok0, keys = dev.prefill_sampled(
+        np.stack(prompts), [4, 4], [0, 1], init_keys(2),
+        np.zeros(2, np.float32), np.zeros(2, np.int32))
+    np.testing.assert_array_equal(np.asarray(tok0), t0)
+    toks, _ = dev.decode_k(
+        np.asarray(tok0), keys, np.zeros(2, np.float32),
+        np.zeros(2, np.int32), np.full(2, -1, np.int32),
+        np.full(2, 100, np.int32), np.ones(2, bool),
+        np.zeros(2, np.int32), k)
+    np.testing.assert_array_equal(np.asarray(toks), want)
+    assert dev.decode_k_traces == 1
+
+
+def test_decode_k_eos_and_budget_masks():
+    """The in-scan stop masks: a slot that emits eos_id stops (later
+    columns are -1), and `remaining` caps emissions exactly."""
+    model, params = _setup()
+    p, ref, i = _stream_with_fresh_id(model, params, plen=4, n_new=6)
+    eos = int(ref[i])
+    st = ServingStep(model, params, n_slots=1, capacity=32)
+    tok0, keys = st.prefill_sampled(
+        p[None], [4], [0], init_keys(1), np.zeros(1, np.float32),
+        np.zeros(1, np.int32))
+    toks, _ = st.decode_k(
+        np.asarray(tok0), keys, np.zeros(1, np.float32),
+        np.zeros(1, np.int32), np.asarray([eos], np.int32),
+        np.full(1, 100, np.int32), np.ones(1, bool),
+        np.zeros(1, np.int32), 5)
+    got = np.asarray(toks)[0]
+    assert int(got[i - 1]) == eos              # ref[i] is decode_k col i-1
+    assert all(int(t) == -1 for t in got[i:])  # stopped after eos
+    # budget mask: remaining=2 emits exactly 2 then parks
+    st2 = ServingStep(model, params, n_slots=1, capacity=32)
+    tok0, keys = st2.prefill_sampled(
+        p[None], [4], [0], init_keys(1), np.zeros(1, np.float32),
+        np.zeros(1, np.int32))
+    toks, _ = st2.decode_k(
+        np.asarray(tok0), keys, np.zeros(1, np.float32),
+        np.zeros(1, np.int32), np.full(1, -1, np.int32),
+        np.asarray([2], np.int32), np.ones(1, bool),
+        np.zeros(1, np.int32), 5)
+    got = np.asarray(toks)[0]
+    assert int(got[0]) >= 0 and int(got[1]) >= 0
+    assert all(int(t) == -1 for t in got[2:])
+
+
+# --------------------------------------------------------------------
+# chunked prefill == monolithic, bitwise (tokens AND cache bytes)
+# --------------------------------------------------------------------
+
+def test_chunked_prefill_matches_monolithic_cache_bitwise():
+    """Every chunk size — dividing, non-dividing, and full-prompt —
+    writes byte-identical K/V pages and cursors to one monolithic
+    prefill, and samples the same first token."""
+    model, params = _setup(n_layers=2)     # every block's page checked
+    p = _prompts(3, [13])[0]
+    mono = ServingStep(model, params, n_slots=2, capacity=32)
+    tok_m, _ = mono.prefill_sampled(
+        p[None], [13], [0], init_keys(2), np.zeros(2, np.float32),
+        np.zeros(2, np.int32))
+    want = int(np.asarray(tok_m)[0])
+    ref_cache = jax.device_get(mono.cache)
+
+    for c in (3, 5, 13):
+        st = ServingStep(model, params, n_slots=2, capacity=32)
+        keys = init_keys(2)
+        pos = 0
+        while pos < 13:
+            v = min(c, 13 - pos)
+            toks = np.zeros((1, c), np.int32)
+            toks[0, :v] = p[pos:pos + v]
+            tok, keys = st.prefill_chunk(
+                toks, [pos], [v], [0], [pos + v == 13], keys,
+                np.zeros(2, np.float32), np.zeros(2, np.int32))
+            pos += v
+            if pos < 13:
+                assert int(np.asarray(tok)[0]) == -1   # not final yet
+        assert int(np.asarray(tok)[0]) == want, f"chunk={c}"
+        got_cache = jax.device_get(st.cache)
+        for name in ref_cache:
+            np.testing.assert_array_equal(
+                got_cache[name]["k"][0, :13], ref_cache[name]["k"][0, :13],
+                err_msg=f"chunk={c} {name} K")
+            np.testing.assert_array_equal(
+                got_cache[name]["v"][0, :13], ref_cache[name]["v"][0, :13],
+                err_msg=f"chunk={c} {name} V")
+            assert got_cache[name]["idx"][0] == 13
+        assert len(st.prefill_chunk_traces) == 1      # ONE (S, C) program
+
+
+def test_engine_chunked_streams_match_generate():
+    """End to end: the chunked+budgeted scheduler emits exactly the
+    serial generate() streams — chunk sizes straddling the old bucket
+    boundaries, prompts longer than any single chunk, mixed lengths
+    queueing behind a 2-slot grid."""
+    model, params = _setup()
+    prompts = _prompts(4, [3, 9, 13, 6])
+    n_new = 6
+    refs = [np.asarray(generate(model, params, p[None],
+                                n_new))[0, len(p):] for p in prompts]
+    for c, budget in ((4, 16), (16, 12)):
+        cfg = EngineConfig(n_slots=2, capacity=32, max_new_tokens=n_new,
+                           prefill_cohort=2, prefill_chunk=c,
+                           token_budget=budget)
+        eng = Engine(model, params, cfg)
+        reqs = [eng.submit(p) for p in prompts]
+        eng.run_until_drained()
+        for ref, req in zip(refs, reqs):
+            assert req.tokens == ref.tolist(), (c, budget)
+            assert req.state == "done"
+        # the DL108 invariant in chunked mode: ONE chunk program, ONE
+        # decode_k program, regardless of prompt lengths
+        assert set(eng.steps.prefill_chunk_traces) == {(2, c)}
+        assert all(v == 1
+                   for v in eng.steps.prefill_chunk_traces.values())
+        assert eng.steps.decode_k_traces == 1
+
+
+def test_engine_chunked_eos_retirement():
+    model, params = _setup()
+    n_new = 8
+    p, ref, i = _stream_with_fresh_id(model, params, plen=9, n_new=n_new)
+    eos = int(ref[i])
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=n_new,
+                       prefill_cohort=1, prefill_chunk=4, token_budget=8)
+    eng = Engine(model, params, cfg)
+    req = eng.submit(p, eos_id=eos)
+    eng.run_until_drained()
+    assert req.tokens == list(ref[:i + 1])      # ends WITH the eos token
+    assert req.state == "done"
+
+
+# --------------------------------------------------------------------
+# sampled-decode determinism under a fixed seed
+# --------------------------------------------------------------------
+
+def _run_sampled(model, params, prompts, seeds, cfg, n_new=7, temp=0.8,
+                 top_k=5):
+    eng = Engine(model, params, cfg)
+    reqs = [eng.submit(p, temperature=temp, top_k=top_k, seed=s)
+            for p, s in zip(prompts, seeds)]
+    eng.run_until_drained()
+    assert all(r.state == "done" for r in reqs)
+    return [r.tokens for r in reqs]
+
+
+def test_sampled_decode_deterministic_across_scheduler_shapes():
+    """Same per-request seed → same sampled stream, no matter how the
+    scheduler carves the work: decode_k 1 vs 4, monolithic vs chunked
+    prefill (two chunk sizes), budgeted vs not. One key split per
+    sampled token makes the stream a function of (seed, #tokens) only."""
+    model, params = _setup()
+    prompts = _prompts(6, [4, 9, 6])
+    seeds = [11, 22, 33]
+    n_new = 7
+    base = dict(n_slots=2, capacity=32, max_new_tokens=n_new,
+                prefill_cohort=2)
+    shapes = [
+        EngineConfig(**base, decode_k=1, buckets=[4, 16, 32]),
+        EngineConfig(**base, decode_k=4, prefill_chunk=4,
+                     token_budget=16),
+        EngineConfig(**base, decode_k=2, prefill_chunk=5,
+                     token_budget=None),
+    ]
+    ref = _run_sampled(model, params, prompts, seeds, shapes[0],
+                       n_new=n_new)
+    assert any(len(set(t)) > 1 for t in ref)    # actually sampling
+    for cfg in shapes[1:]:
+        got = _run_sampled(model, params, prompts, seeds, cfg,
+                           n_new=n_new)
+        assert got == ref, (cfg.decode_k, cfg.prefill_chunk,
+                            cfg.token_budget)
+
+
+def test_sampled_stream_independent_of_neighbours():
+    """A request's sampled stream is identical whether it runs alone or
+    sharing the grid — neighbouring slots never consume its key splits."""
+    model, params = _setup()
+    prompts = _prompts(7, [4, 4, 4])
+    cfg = EngineConfig(n_slots=2, capacity=32, max_new_tokens=6,
+                       prefill_cohort=1, buckets=[4, 32], decode_k=3)
+    solo = _run_sampled(model, params, prompts[:1], [99], cfg, n_new=6)
+    crowd = _run_sampled(model, params, prompts, [99, 5, 6], cfg, n_new=6)
+    assert crowd[0] == solo[0]
+
+
+def test_different_seeds_give_different_streams():
+    model, params = _setup()
+    prompts = _prompts(8, [6, 6])
+    cfg = EngineConfig(n_slots=2, capacity=32, max_new_tokens=8,
+                       prefill_cohort=2, buckets=[8, 32])
+    a, b = _run_sampled(model, params, prompts, [1, 2], cfg, n_new=8,
+                        temp=1.5, top_k=0)
+    assert a != b
+
+
+def test_greedy_engine_ignores_seed():
+    """temperature None → the stream is the argmax stream, whatever the
+    seed (the greedy path never reads the PRNG). generate() is the
+    seed-independent reference, so one non-default seed suffices."""
+    model, params = _setup()
+    p = _prompts(9, [5])[0]
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=5,
+                       prefill_cohort=1, buckets=[8, 32])
+    ref = np.asarray(generate(model, params, p[None], 5))[0, 5:]
+    eng = Engine(model, params, cfg)
+    req = eng.submit(p, seed=123)
+    eng.run_until_drained()
+    assert req.tokens == ref.tolist()
+
+
+def test_host_bytes_per_token_is_4():
+    """The report's observable for DL110: with on-device sampling the
+    emit path moves exactly one int32 per token — padding rows included
+    still lands ≤ 8 bytes/token (the bench.py gate)."""
+    model, params = _setup()
+    prompts = _prompts(10, [4, 4])
+    cfg = EngineConfig(n_slots=2, capacity=32, max_new_tokens=6,
+                       prefill_cohort=2, buckets=[4, 32], decode_k=2)
+    eng = Engine(model, params, cfg)
+    for p in prompts:
+        eng.submit(p)
+    eng.run_until_drained()
+    s = eng.report.summary()
+    assert s["tokens_emitted"] == 12
+    assert s["host_bytes_per_token"] <= 8.0
+    assert "itl_ms" in s

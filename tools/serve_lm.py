@@ -8,7 +8,11 @@ re-initialise), queues a deterministic batch of prompts, and drains the
 engine while exposed to ``$CHAINERMN_TPU_CHAOS``. Completed streams are
 appended to a JSONL file *idempotently*: a restarted incarnation skips
 request ids already on disk, so a chaos kill mid-decode heals to the
-same final output the unkilled run would have produced.
+same final output the unkilled run would have produced. That replay
+guarantee survives sampling too: each request's PRNG seed is derived
+from its id (``--seed + request_id``), so ``--temperature``/``--top-k``
+streams are as replayable as greedy ones (serving/sampling.py's
+one-split-per-token contract).
 
 Wrap it in the per-host restart loop for the fleet drill::
 
@@ -77,7 +81,10 @@ def serve(args):
                  EngineConfig(n_slots=args.slots, capacity=args.capacity,
                               max_new_tokens=args.max_new_tokens,
                               prefill_cohort=1,
-                              buckets=[args.prompt_len, args.capacity]),
+                              buckets=[args.prompt_len, args.capacity],
+                              decode_k=args.decode_k,
+                              prefill_chunk=args.prefill_chunk,
+                              token_budget=args.token_budget),
                  report=ServingReport())
 
     done = _done_ids(args.out)
@@ -88,7 +95,9 @@ def serve(args):
                              (args.prompt_len,)).astype(np.int32)
         if i in done:
             continue                   # drained by a prior incarnation
-        reqs[i] = (eng.submit(prompt), prompt)
+        reqs[i] = (eng.submit(prompt, temperature=args.temperature,
+                              top_k=args.top_k, seed=args.seed + i),
+                   prompt)
     _log(f"queued {len(reqs)} of {args.requests} requests "
          f"({len(done)} already drained)")
 
@@ -128,6 +137,21 @@ def main(argv=None):
     ap.add_argument("--max-new-tokens", type=int, default=8)
     ap.add_argument("--slots", type=int, default=2)
     ap.add_argument("--capacity", type=int, default=32)
+    # decode-k defaults to 1: the chaos drill's kill@step=N timing
+    # counts scheduler iterations, and one token per iteration keeps a
+    # mid-decode kill meaning what the drill scripts expect
+    ap.add_argument("--decode-k", type=int, default=1,
+                    help="tokens committed per decode dispatch")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked prefill width (default: monolithic "
+                         "per-bucket prefill)")
+    ap.add_argument("--token-budget", type=int, default=None,
+                    help="per-iteration token budget shared by decode "
+                         "and prefill (default: unbounded)")
+    ap.add_argument("--temperature", type=float, default=None,
+                    help="sampling temperature (default: greedy argmax)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="top-k truncation for sampled decode")
     ap.add_argument("--vocab", type=int, default=43)
     ap.add_argument("--d-model", type=int, default=32)
     ap.add_argument("--n-heads", type=int, default=4)
