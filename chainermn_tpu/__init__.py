@@ -37,6 +37,7 @@ from chainermn_tpu.iterators import (
 from chainermn_tpu.links import MultiNodeBatchNormalization, MultiNodeChainList
 from chainermn_tpu.optimizers import create_multi_node_optimizer
 from chainermn_tpu import checkpointing
+from chainermn_tpu import fleet
 from chainermn_tpu import resilience
 from chainermn_tpu import serving
 
@@ -61,6 +62,7 @@ __all__ = [
     "MultiNodeBatchNormalization",
     "MultiNodeChainList",
     "checkpointing",
+    "fleet",
     "resilience",
     "serving",
     "__version__",

@@ -1152,3 +1152,102 @@ def check_per_token_host_sync(tree, src, path) -> List[Finding]:
 
 register(Rule("DL110", "per-token-host-sync", f"{_DOC}#dl110",
               check_per_token_host_sync))
+
+
+# ---------------------------------------------------------------------------
+# DL111 — blocking-rpc-in-router-loop
+# ---------------------------------------------------------------------------
+
+#: blocking-wait methods a dispatch loop can wedge on
+_WAIT_METHODS = {"result", "get", "wait"}
+
+#: receiver-name fragments that mark a future/mailbox wait (``fut``
+#: covers ``future``/``futures``; ``mail`` covers ``mailbox``); plain
+#: ``dict.get(key)``-style calls don't match because they carry a
+#: positional argument, and ``os.path.join``-alikes use other methods
+_WAIT_RECEIVER_HINTS = ("queue", "mail", "fut", "inbox", "mbox")
+
+
+def _wait_receiver_name(call: ast.Call) -> Optional[str]:
+    """Terminal receiver name of ``<recv>.result()/.get()/.wait()``:
+    ``fut.result`` → ``fut``, ``self._mail.get`` → ``_mail``."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    if call.func.attr not in _WAIT_METHODS:
+        return None
+    recv = call.func.value
+    if isinstance(recv, ast.Name):
+        return recv.id
+    if isinstance(recv, ast.Attribute):
+        return recv.attr
+    return None
+
+
+def _is_unbounded_wait(call: ast.Call) -> bool:
+    """Unbounded = no positional deadline and no ``timeout=`` kwarg (or
+    an explicit ``timeout=None``). ``get_nowait()``, ``result(
+    timeout=probe)``, and ``join(timeout=30)`` all pass."""
+    if call.args:
+        return False
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None)
+    return True
+
+
+def check_blocking_rpc_in_router_loop(tree, src, path) -> List[Finding]:
+    """Unbounded future/mailbox wait inside a dispatch loop.
+
+    The fleet-router discipline (docs/serving.md): every wait inside a
+    ``for``/``while`` dispatch loop must carry a deadline, because the
+    thing being waited on is another replica — and replicas die. One
+    ``inbox.get()`` or ``fut.result()`` with no timeout turns a single
+    replica death into a frozen fleet: the loop never comes back to the
+    health sweep that would have re-queued the dead replica's work.
+    Flagged shape: ``<recv>.result()/.get()/.wait()`` where the
+    receiver name names a future or mailbox (``queue``/``mail``/
+    ``fut``/``inbox``/``mbox``) and the call is unbounded — no
+    positional deadline, no ``timeout=`` kwarg, or an explicit
+    ``timeout=None``.
+
+    NOT flagged: ``get_nowait()`` (never blocks), any wait with a
+    finite ``timeout=``, waits on receivers that aren't futures or
+    mailboxes, and waits outside loops (a one-shot join at teardown is
+    not a dispatch loop). The fixed patterns are ``fleet/router.py``'s:
+    drain mailboxes with ``get_nowait()`` + idle sleep, and slice
+    future waits at ``RpcPolicy.probe_ms``.
+    """
+    findings: List[Finding] = []
+    seen: Set[Tuple[int, int]] = set()   # dedup nested-loop double walks
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        for n in _walk_excluding_defs(loop.body):
+            if not isinstance(n, ast.Call):
+                continue
+            recv = _wait_receiver_name(n)
+            if recv is None:
+                continue
+            if not any(h in recv.lower() for h in _WAIT_RECEIVER_HINTS):
+                continue
+            if not _is_unbounded_wait(n):
+                continue
+            key = (n.lineno, n.col_offset)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                "DL111", path, n.lineno,
+                f"'{recv}.{n.func.attr}()' blocks without a deadline "
+                "inside a dispatch loop — if the producer is a dead "
+                "replica this wait never returns and the loop never "
+                "reaches the health sweep that would re-queue its "
+                "work. Bound it: get_nowait() + idle sleep for "
+                "mailboxes, or slice the wait at RpcPolicy.probe_ms "
+                f"like fleet.Router.result ({_DOC}#dl111)."))
+    return findings
+
+
+register(Rule("DL111", "blocking-rpc-in-router-loop", f"{_DOC}#dl111",
+              check_blocking_rpc_in_router_loop))

@@ -377,7 +377,10 @@ class MultiNodeCheckpointer:
 
     def _writer_loop(self):
         while True:
-            item = self._queue.get()
+            # same-process producer, sentinel-terminated: close() always
+            # delivers the None wake-up, so an unbounded get can't wedge
+            # on a dead REMOTE peer (the hazard DL111 polices)
+            item = self._queue.get()  # dlint: disable=DL111
             try:
                 if item is None:
                     return

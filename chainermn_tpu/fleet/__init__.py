@@ -1,0 +1,35 @@
+"""chainermn_tpu.fleet — many serving engines, one front door.
+
+Two composition patterns over ``serving.Engine``:
+
+* **Replicated** (``router.Router``): N identical engines behind a
+  load-aware, session-affine router with queue-depth backpressure and
+  heartbeat-driven replica health — a dead replica's in-flight work
+  re-queues onto survivors with client futures intact.
+* **Disaggregated** (``pools.DisaggregatedFleet``): a prefill pool
+  runs ``prefill_chunk`` to completion and hands populated KV slots to
+  a decode pool through the manifest-versioned ``handoff`` codec (raw
+  f32 — bitwise — or blockwise int8 at ~0.254× the wire bytes).
+
+``reports.FleetReport`` aggregates per-replica telemetry honestly
+(pooled-sample percentiles, token-weighted ratios); ``health.
+FleetHealth`` is the per-replica liveness verdict. See docs/serving.md.
+"""
+
+from chainermn_tpu.fleet.handoff import (HANDOFF_WIRE_FORMATS,
+                                         HandoffError, decode_handoff,
+                                         encode_handoff,
+                                         handoff_payload_bytes)
+from chainermn_tpu.fleet.health import FleetHealth
+from chainermn_tpu.fleet.pools import (DecodePool, DisaggregatedFleet,
+                                       PrefillPool, Stream)
+from chainermn_tpu.fleet.reports import FleetReport
+from chainermn_tpu.fleet.router import EngineReplica, Router
+
+__all__ = [
+    "HandoffError", "encode_handoff", "decode_handoff",
+    "handoff_payload_bytes", "HANDOFF_WIRE_FORMATS",
+    "FleetHealth", "FleetReport",
+    "Stream", "PrefillPool", "DecodePool", "DisaggregatedFleet",
+    "EngineReplica", "Router",
+]

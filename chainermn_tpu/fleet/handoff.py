@@ -1,0 +1,201 @@
+"""KVHandoff — the prefill→decode wire codec for disaggregated serving.
+
+A prefill replica finishes a prompt (``prefill_chunk`` to completion,
+first token sampled on device) and must move the populated slot to a
+decode replica: per-block KV rows ``[fill, n_kv_heads, d_head]``, the
+cursor, the post-sampling PRNG key, the emitted token(s), and the
+sampling knobs — exactly what ``Engine.export_handoff`` packages. This
+module turns that dict into ``(manifest, blob)`` and back:
+
+* the **blob** is the concatenated C-order bytes of every array — no
+  container framing, so wire accounting is exact (``manifest["bytes"]``
+  is what actually crosses the interconnect, the number the bench gate
+  prices);
+* the **manifest** is a JSON-able dict under the same versioned grammar
+  as ``serving/weights.py``: ``format`` 1 (raw) or 2 (blockwise
+  quantized), ``sha256`` + ``bytes`` over the blob, an ``arrays`` table
+  (name/dtype/shape/offset), a ``codec`` block for format 2, and the
+  scalar ``meta`` (cursor, tokens, knobs).
+
+Wire formats:
+
+* ``f32`` (format 1) — raw cache bytes. Decode from an imported slot is
+  BITWISE the exporting engine continuing; the fleet's raw-format
+  streams therefore pin exactly to single-engine ``generate()``.
+* ``int8-block`` (format 2) — each KV leaf through the collectives'
+  per-256-element blockwise codec (``collectives.quantized``,
+  EQuARX): int8 codes + one f32 scale per block, ~0.254× the raw f32
+  bytes (``wire_ratio``). Logit error after the handoff is bounded by
+  the per-block scale — calibrated in tests/fleet_tests.
+
+Decode REFUSES anything it cannot verify — unknown format, byte-count
+mismatch (truncation), digest mismatch (corruption), or a structurally
+broken manifest all raise :class:`HandoffError` — so a damaged handoff
+becomes a clean re-prefill on the decode pool, never a poisoned slot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["HandoffError", "encode_handoff", "decode_handoff",
+           "handoff_payload_bytes", "HANDOFF_FORMAT_RAW",
+           "HANDOFF_FORMAT_QUANT", "HANDOFF_WIRE_FORMATS"]
+
+HANDOFF_FORMAT_RAW = 1
+HANDOFF_FORMAT_QUANT = 2
+_ACCEPTED_FORMATS = (HANDOFF_FORMAT_RAW, HANDOFF_FORMAT_QUANT)
+
+#: wire formats encode_handoff accepts (f32 = raw bytes, bitwise)
+HANDOFF_WIRE_FORMATS = ("f32", "int8-block")
+
+#: meta keys every manifest must carry (decode validates the set)
+_META_KEYS = ("cursor", "tokens", "prompt_len", "eos_id", "temperature",
+              "top_k", "seed")
+
+
+class HandoffError(RuntimeError):
+    """The handoff could not be verified/decoded — re-prefill instead."""
+
+
+def _dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import jax.numpy as jnp
+        return jnp.dtype(name)     # ml_dtypes names (bfloat16, ...)
+
+
+class _Packer:
+    def __init__(self):
+        self.arrays: List[Dict[str, Any]] = []
+        self.chunks: List[bytes] = []
+        self.offset = 0
+
+    def put(self, name: str, arr) -> None:
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        self.arrays.append({"name": name, "dtype": arr.dtype.name,
+                            "shape": list(arr.shape),
+                            "offset": self.offset, "nbytes": len(raw)})
+        self.chunks.append(raw)
+        self.offset += len(raw)
+
+
+def encode_handoff(handoff: dict,
+                   wire_format: str = "f32") -> Tuple[dict, bytes]:
+    """Serialize ``Engine.export_handoff``'s dict. Returns
+    ``(manifest, blob)``; the manifest alone decides whether the blob is
+    trustworthy at the other end."""
+    if wire_format not in HANDOFF_WIRE_FORMATS:
+        raise ValueError(
+            f"unknown handoff wire_format {wire_format!r} — known: "
+            + ", ".join(HANDOFF_WIRE_FORMATS))
+    pk = _Packer()
+    codec_leaves: Dict[str, dict] = {}
+    for block in sorted(handoff["pages"]):
+        for leaf in ("k", "v"):
+            name = f"{block}/{leaf}"
+            arr = np.asarray(handoff["pages"][block][leaf])
+            if wire_format == "f32":
+                pk.put(name, arr)
+            else:
+                from chainermn_tpu.collectives.quantized import \
+                    block_quantize
+                q, s = block_quantize(arr.reshape(-1), wire_format)
+                pk.put(name + "::q", np.asarray(q))
+                pk.put(name + "::scale", np.asarray(s, np.float32))
+                codec_leaves[name] = {"shape": list(arr.shape),
+                                      "dtype": arr.dtype.name,
+                                      "size": int(arr.size)}
+    pk.put("key", np.asarray(handoff["key"], np.uint32))
+    blob = b"".join(pk.chunks)
+    manifest: Dict[str, Any] = {
+        "format": (HANDOFF_FORMAT_RAW if wire_format == "f32"
+                   else HANDOFF_FORMAT_QUANT),
+        "bytes": len(blob),
+        "sha256": hashlib.sha256(blob).hexdigest(),
+        "arrays": pk.arrays,
+        "meta": {k: handoff[k] for k in _META_KEYS if k != "cursor"}
+                | {"cursor": int(handoff["cursor"])},
+    }
+    if wire_format != "f32":
+        from chainermn_tpu.collectives.quantized import QUANT_BLOCK
+        manifest["codec"] = {"wire_format": wire_format,
+                             "block": QUANT_BLOCK,
+                             "leaves": codec_leaves}
+    return manifest, blob
+
+
+def handoff_payload_bytes(manifest: dict) -> int:
+    """Exact wire bytes of the encoded handoff (the blob length the
+    manifest vouches for — what the bench gate prices)."""
+    return int(manifest["bytes"])
+
+
+def decode_handoff(manifest: dict, blob: bytes) -> dict:
+    """Verify + decode back to the ``Engine.import_handoff`` dict.
+
+    Raises :class:`HandoffError` on ANY defect — unknown format, torn
+    blob, digest mismatch, or a manifest missing its structure. Callers
+    (fleet/pools.py) answer with a clean re-prefill."""
+    try:
+        fmt = manifest["format"]
+        if fmt not in _ACCEPTED_FORMATS:
+            raise HandoffError(
+                f"unknown handoff manifest format {fmt!r} — accepted: "
+                f"{_ACCEPTED_FORMATS}")
+        if len(blob) != int(manifest["bytes"]):
+            raise HandoffError(
+                f"truncated handoff: blob is {len(blob)} bytes, "
+                f"manifest says {manifest['bytes']}")
+        digest = hashlib.sha256(blob).hexdigest()
+        if digest != manifest["sha256"]:
+            raise HandoffError("corrupt handoff: sha256 mismatch")
+        flat: Dict[str, np.ndarray] = {}
+        for ent in manifest["arrays"]:
+            dt = _dtype(ent["dtype"])
+            raw = blob[ent["offset"]:ent["offset"] + ent["nbytes"]]
+            flat[ent["name"]] = np.frombuffer(
+                raw, dtype=dt).reshape(ent["shape"])
+        meta = manifest["meta"]
+        pages: Dict[str, Dict[str, np.ndarray]] = {}
+        if fmt == HANDOFF_FORMAT_RAW:
+            for name, arr in flat.items():
+                if name == "key":
+                    continue
+                block, leaf = name.rsplit("/", 1)
+                pages.setdefault(block, {})[leaf] = arr
+        else:
+            from chainermn_tpu.collectives.quantized import \
+                block_dequantize
+            codec = manifest["codec"]
+            blk = int(codec.get("block", 256))
+            for base, spec in codec["leaves"].items():
+                deq = np.asarray(block_dequantize(
+                    flat[base + "::q"], flat[base + "::scale"],
+                    int(spec["size"]), codec["wire_format"],
+                    _dtype(spec["dtype"]), blk))
+                block, leaf = base.rsplit("/", 1)
+                pages.setdefault(block, {})[leaf] = deq.reshape(
+                    spec["shape"])
+        return {
+            "pages": pages,
+            "cursor": int(meta["cursor"]),
+            "tokens": list(meta["tokens"]),
+            "key": flat["key"],
+            "prompt_len": int(meta["prompt_len"]),
+            "eos_id": meta["eos_id"],
+            "temperature": meta["temperature"],
+            "top_k": meta["top_k"],
+            "seed": meta["seed"],
+        }
+    except HandoffError:
+        raise
+    except Exception as e:   # broken manifest structure → same contract
+        raise HandoffError(
+            f"undecodable handoff manifest: {type(e).__name__}: {e}"
+        ) from e

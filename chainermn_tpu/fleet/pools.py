@@ -1,0 +1,225 @@
+"""Disaggregated prefill/decode pools over two serving engines.
+
+The disaggregation argument (DistServe/Splitwise, PAPERS.md): prefill
+is compute-bound and bursty, decode is memory-bandwidth-bound and
+steady — co-locating them makes every long prompt a head-of-line stall
+for every active decode stream. Here the split is explicit:
+
+* :class:`PrefillPool` owns an engine that runs ``prefill_chunk`` to
+  completion with ``max_new_tokens=1, hold=True`` — the first token is
+  sampled on device and the finished slot PARKS (``Engine.held``: KV
+  rows, cursor, and post-split PRNG key stay bound) instead of
+  retiring.
+* :class:`DecodePool` owns an engine that adopts exported slots
+  (``Engine.import_handoff``) and decodes them to termination.
+* :class:`DisaggregatedFleet` is the synchronous conveyor between
+  them: every held prefill slot is exported, serialized through the
+  :mod:`~chainermn_tpu.fleet.handoff` codec (``wire_format`` — ``f32``
+  raw or ``int8-block``), passed through the chaos fault plane
+  (``corrupt_handoff`` mutates the wire bytes exactly like a torn
+  interconnect), and placed on the decode engine.
+
+Contracts the tests pin: raw-format streams are BITWISE-identical to
+the single-engine path (export → import is exact f32 bytes and the PRNG
+key continues, never re-derives); a handoff that fails verification
+(:class:`~chainermn_tpu.fleet.handoff.HandoffError`) falls back to a
+CLEAN re-prefill of the full prompt on the decode engine — same seed,
+so the one-split-per-token contract replays the identical stream — and
+never a poisoned slot.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from chainermn_tpu.fleet.handoff import (HandoffError, decode_handoff,
+                                         encode_handoff)
+from chainermn_tpu.fleet.reports import FleetReport
+from chainermn_tpu.resilience import chaos
+
+__all__ = ["Stream", "PrefillPool", "DecodePool", "DisaggregatedFleet"]
+
+
+class Stream:
+    """One client stream crossing the prefill→decode boundary. The
+    terminal ``tokens`` list is the SAME sequence a single engine's
+    ``generate()`` would emit for this prompt/seed (bitwise under the
+    raw wire format)."""
+
+    def __init__(self, stream_id: int, prompt, max_new_tokens: int,
+                 kw: dict):
+        self.stream_id = stream_id
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.kw = dict(kw)            # eos_id / temperature / top_k / seed
+        self.tokens: List[int] = []
+        self.state = "queued"         # queued|prefill|decode|done
+        self.fell_back = False        # handoff failed → re-prefilled
+
+    @property
+    def finished(self) -> bool:
+        return self.state == "done"
+
+
+class PrefillPool:
+    """Prefill-side engine wrapper: prompts in, held slots out."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._by_id: Dict[int, Stream] = {}   # request_id → stream
+
+    def submit(self, stream: Stream) -> None:
+        req = self.engine.submit(stream.prompt, max_new_tokens=1,
+                                 hold=True, **stream.kw)
+        self._by_id[req.request_id] = stream
+        stream.state = "prefill"
+
+    def step(self) -> bool:
+        """Advance iff there is prefill work (held slots alone are not
+        work — they pin their cursors and wait for export)."""
+        if self.engine.idle():
+            return False
+        self.engine.step()  # dlint: disable=DL104
+        return True
+
+    def ready(self) -> List[Tuple[Stream, object]]:
+        """Held (stream, request) pairs awaiting export, oldest first."""
+        reqs = sorted(self.engine.held.values(),
+                      key=lambda r: r.request_id)
+        return [(self._by_id[r.request_id], r) for r in reqs]
+
+    def export(self, req) -> dict:
+        """Export + release one held slot; returns the handoff dict."""
+        handoff = self.engine.export_handoff(req)
+        self.engine.release_held(req)
+        self._by_id.pop(req.request_id, None)
+        return handoff
+
+
+class DecodePool:
+    """Decode-side engine wrapper: adopts handoffs, drains streams."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._inflight: List[Tuple[object, Stream]] = []
+
+    def has_room(self) -> bool:
+        return bool(self.engine.free_slots)
+
+    def place(self, stream: Stream, handoff: dict) -> None:
+        """Adopt a VERIFIED handoff: the imported slot resumes the
+        exporting engine's exact stream."""
+        req = self.engine.import_handoff(
+            handoff, stream.prompt, max_new_tokens=stream.max_new_tokens)
+        stream.state = "decode"
+        self._inflight.append((req, stream))
+
+    def fallback(self, stream: Stream) -> None:
+        """Handoff failed verification → CLEAN re-prefill of the full
+        prompt on this engine. Same seed, so the per-token key-split
+        contract replays the identical stream; the suspect bytes never
+        touch a slot."""
+        req = self.engine.submit(stream.prompt,
+                                 max_new_tokens=stream.max_new_tokens,
+                                 **stream.kw)
+        stream.state = "decode"
+        stream.fell_back = True
+        self._inflight.append((req, stream))
+
+    def step(self) -> bool:
+        worked = False
+        if not self.engine.idle():
+            self.engine.step()  # dlint: disable=DL104
+            worked = True
+        still = []
+        for req, stream in self._inflight:
+            if req.finished:
+                stream.tokens = list(req.tokens)
+                stream.state = "done"
+            else:
+                still.append((req, stream))
+        self._inflight = still
+        return worked
+
+
+class DisaggregatedFleet:
+    """The synchronous conveyor: submit → prefill → handoff → decode.
+
+    ``wire_format`` picks the handoff codec (``"f32"`` raw/bitwise,
+    ``"int8-block"`` quantized at ~0.254× the wire bytes); ``report``
+    accumulates the fleet counters (handoffs, wire bytes by format,
+    fallbacks) that ``bench.py``'s fleet gate reads.
+    """
+
+    def __init__(self, prefill_engine, decode_engine, *,
+                 wire_format: str = "f32",
+                 report: Optional[FleetReport] = None):
+        self.prefill = PrefillPool(prefill_engine)
+        self.decode = DecodePool(decode_engine)
+        self.wire_format = wire_format
+        self.report = report or FleetReport()
+        self._ids = itertools.count()
+        self.streams: List[Stream] = []
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               **kw) -> Stream:
+        mnt = (max_new_tokens if max_new_tokens is not None
+               else self.prefill.engine.config.max_new_tokens)
+        stream = Stream(next(self._ids), prompt, mnt, kw)
+        self.streams.append(stream)
+        self.prefill.submit(stream)
+        return stream
+
+    def _transfer(self) -> bool:
+        """Move every exportable held slot the decode pool has room
+        for: export → encode → (chaos fault plane) → verify → place,
+        with :class:`HandoffError` answered by a clean re-prefill."""
+        moved = False
+        for stream, req in self.prefill.ready():
+            if not self.decode.has_room():
+                break
+            handoff = self.prefill.export(req)
+            manifest, blob = encode_handoff(handoff, self.wire_format)
+            self.report.record_handoff(self.wire_format, len(blob))
+            # the wire: corrupt_handoff faults tear/flip bytes HERE,
+            # between the sender's digest and the receiver's check
+            blob = chaos.on_handoff(blob)
+            try:
+                self.decode.place(stream, decode_handoff(manifest, blob))
+            except HandoffError:
+                self.report.record_fallback()
+                self.decode.fallback(stream)
+            moved = True
+        return moved
+
+    def step(self) -> bool:
+        """One conveyor iteration; returns whether anything advanced."""
+        worked = self.prefill.step()
+        worked = self._transfer() or worked
+        worked = self.decode.step() or worked
+        return worked
+
+    def idle(self) -> bool:
+        return (self.prefill.engine.idle()
+                and not self.prefill.engine.held
+                and self.decode.engine.idle())
+
+    def run_until_drained(self, max_steps: int = 100_000) -> int:
+        n = 0
+        while not self.idle():
+            if n >= max_steps:
+                raise RuntimeError(
+                    f"fleet failed to drain within {max_steps} steps")
+            # each engine step syncs internally (int32 token pulls)
+            self.step()  # dlint: disable=DL104
+            n += 1
+        return n
+
+    def reports(self):
+        return [self.prefill.engine.report, self.decode.engine.report]
+
+    def summary(self) -> dict:
+        return self.report.summary(self.reports())

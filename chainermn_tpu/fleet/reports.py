@@ -1,0 +1,140 @@
+"""FleetReport — honest cross-replica aggregation + fleet counters.
+
+Aggregating per-replica ``ServingReport`` summaries the lazy way is
+WRONG in two specific, quantifiable ways:
+
+* **percentiles do not average.** The mean of per-replica p99s is not
+  the fleet p99 — a single slow replica's tail disappears into the
+  average. ``merge`` therefore pools the RAW samples (``ServingReport.
+  raw()``) and takes nearest-rank percentiles over the pooled list, so
+  every token gap and TTFT sample carries exactly its own weight.
+* **ratios do not average.** ``host_bytes_per_token`` is a quotient;
+  the mean of per-replica quotients weights a replica that served 10
+  tokens the same as one that served 10k. ``merge`` computes
+  ``sum(host_bytes) / sum(tokens_emitted)`` — token-weighted by
+  construction — and the pooled ``itl_ms`` distribution is likewise
+  token-weighted because each gap sample IS one token.
+
+The fleet-level counters (admission rejections, re-queues after a
+replica death, handoffs by wire format and their exact wire bytes,
+handoff fallbacks) live here because no single engine can see them —
+they are properties of the routing layer. ``summary()`` emits the JSON
+block ``tools/fleet_lm.py`` and the ``bench.py`` fleet gate read.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List
+
+from chainermn_tpu.serving.reports import ServingReport, percentile
+
+__all__ = ["FleetReport"]
+
+
+def _dist_ms(samples: List[float]) -> Dict[str, float]:
+    out = {f"p{q}": percentile(samples, q) * 1e3
+           for q in ServingReport.PERCENTILES}
+    out["mean"] = (sum(samples) / len(samples) * 1e3 if samples
+                   else float("nan"))
+    out["n"] = len(samples)
+    return out
+
+
+class FleetReport:
+    """Routing-layer counters + pooled-sample replica aggregation."""
+
+    def __init__(self):
+        self.rejected = 0             # AdmissionRejected at the router
+        self.requeued = 0             # requests moved off a dead replica
+        self.replicas_dead = 0
+        self.handoffs = 0
+        self.handoff_fallbacks = 0    # HandoffError → clean re-prefill
+        self.handoff_wire_bytes: Dict[str, int] = {}   # wire_format → B
+
+    # ----------------------------------------------------------------
+    # router / pool hooks
+    # ----------------------------------------------------------------
+
+    def record_rejected(self) -> None:
+        self.rejected += 1
+
+    def record_requeue(self, n: int = 1) -> None:
+        self.requeued += int(n)
+
+    def record_replica_dead(self) -> None:
+        self.replicas_dead += 1
+
+    def record_handoff(self, wire_format: str, nbytes: int) -> None:
+        self.handoffs += 1
+        self.handoff_wire_bytes[wire_format] = (
+            self.handoff_wire_bytes.get(wire_format, 0) + int(nbytes))
+
+    def record_fallback(self) -> None:
+        self.handoff_fallbacks += 1
+
+    # ----------------------------------------------------------------
+    # aggregation
+    # ----------------------------------------------------------------
+
+    @staticmethod
+    def merge(reports: Iterable[ServingReport]) -> dict:
+        """Fold N replicas' raw telemetry into one fleet summary.
+
+        Pools raw samples for every distribution (so percentiles are
+        exact over the fleet, not averaged-of-averages) and computes
+        ratio metrics from summed numerators/denominators (so
+        ``host_bytes_per_token`` and ``itl_ms`` are weighted by actual
+        token counts). The fleet wall span is the max replica span —
+        replicas run concurrently, so spans overlap rather than add."""
+        raws = [r.raw() for r in reports]
+        ttft: List[float] = []
+        gaps: List[float] = []
+        qd: List[int] = []
+        occ: List[float] = []
+        submitted = completed = aborted = tokens = host_bytes = 0
+        span = 0.0
+        for raw in raws:
+            ttft.extend(raw["ttft_s"])
+            gaps.extend(raw["token_gap_s"])
+            qd.extend(raw["queue_depth_samples"])
+            occ.extend(raw["occupancy_samples"])
+            submitted += raw["submitted"]
+            completed += raw["completed"]
+            aborted += raw["aborted"]
+            tokens += raw["tokens_emitted"]
+            host_bytes += raw["host_bytes"]
+            span = max(span, raw["wall_s"])
+        return {
+            "replicas": len(raws),
+            "requests": {"submitted": submitted, "completed": completed,
+                         "aborted": aborted},
+            "tokens_emitted": tokens,
+            "tokens_per_s": tokens / span if span > 0 else float("nan"),
+            "host_bytes_per_token": (host_bytes / tokens if tokens
+                                     else float("nan")),
+            "ttft_ms": _dist_ms(ttft),
+            "itl_ms": _dist_ms(gaps),
+            "queue_depth": {"mean": (sum(qd) / len(qd) if qd
+                                     else float("nan")),
+                            "max": max(qd) if qd else 0},
+            "slot_occupancy": {"mean": (sum(occ) / len(occ) if occ
+                                        else float("nan")),
+                               "max": max(occ) if occ else 0.0},
+            "wall_s": span,
+        }
+
+    def summary(self, reports: Iterable[ServingReport] = ()) -> dict:
+        out = self.merge(reports)
+        out["fleet"] = {
+            "rejected": self.rejected,
+            "requeued": self.requeued,
+            "replicas_dead": self.replicas_dead,
+            "handoffs": self.handoffs,
+            "handoff_fallbacks": self.handoff_fallbacks,
+            "handoff_wire_bytes": dict(self.handoff_wire_bytes),
+        }
+        return out
+
+    def json(self, reports: Iterable[ServingReport] = ()) -> str:
+        return json.dumps(self.summary(reports), sort_keys=True)

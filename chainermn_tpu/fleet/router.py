@@ -1,0 +1,450 @@
+"""Replica router: one front door over N serving engines.
+
+Each :class:`EngineReplica` owns a single-threaded ``serving.Engine``
+behind its own worker thread and inbox (the same ownership discipline
+as ``serving.Frontend``, multiplied). The :class:`Router` fronts them
+with:
+
+* **load-aware + session-affine placement** — least queue depth
+  (inbox + engine queue + occupied slots) among live replicas, except
+  that a ``session=`` tag STICKS to the replica already serving it
+  (in-flight conversational streams keep their locality; the sticky
+  mapping survives only while its replica does);
+* **queue-depth backpressure** — with ``max_queue_depth`` set, a
+  submission finding EVERY live replica at its bound raises
+  ``serving.AdmissionRejected`` with the ``RpcPolicy`` backoff base as
+  its retry-after hint, exactly like a single ``Frontend``;
+* **health-driven re-queue** — replica workers heartbeat
+  :class:`~chainermn_tpu.fleet.health.FleetHealth` every iteration; a
+  silent or dead-threaded replica (chaos ``kill_replica``, a raise, a
+  real SIGKILL in the supervised drill) is declared dead and its
+  unfinished work re-queues onto survivors WITHOUT dropping client
+  futures. A re-queued request re-runs from its seed, and the
+  one-key-split-per-token contract (serving/sampling.py) makes the
+  replayed stream identical — zero dropped, zero duplicated tokens,
+  which the chaos drill asserts literally.
+
+The router's dispatch loop and ``result()`` keep every wait BOUNDED
+(``get_nowait`` + idle sleep, probe-sliced future waits) — dlint DL111
+polices exactly this loop shape, because one ``inbox.get()`` with no
+timeout here turns a replica death into a frozen fleet.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Dict, List, Optional
+
+from chainermn_tpu.fleet.health import FleetHealth
+from chainermn_tpu.fleet.reports import FleetReport
+from chainermn_tpu.resilience import chaos
+from chainermn_tpu.resilience.policy import RpcPolicy, policy
+from chainermn_tpu.resilience.watchdog import current_watchdog
+from chainermn_tpu.serving.frontend import (AdmissionRejected,
+                                            DeadlineExceeded)
+
+__all__ = ["EngineReplica", "Router"]
+
+_IDLE_WAIT_S = 0.002
+
+
+class _FleetItem:
+    """One routed request: prompt + kwargs + the client future the
+    router owns end-to-end (a replica death re-queues the item; the
+    future only ever resolves once, on whichever replica finishes)."""
+
+    __slots__ = ("item_id", "prompt", "kw", "future", "session")
+
+    def __init__(self, item_id: int, prompt, kw: dict,
+                 session: Optional[str]):
+        self.item_id = item_id
+        self.prompt = prompt
+        self.kw = kw
+        self.future: Future = Future()
+        self.session = session
+
+
+class EngineReplica:
+    """One engine + worker thread + inbox. The worker: admit from the
+    inbox, step the engine when it has work, resolve finished futures,
+    heartbeat. The chaos ``kill_replica`` fault is checked per WORKING
+    iteration (idle polls don't advance the counter, so
+    ``kill_replica@step=N,replica=R`` is deterministic at any poll
+    rate) and kills the thread mid-state — inflight slots and queued
+    items stay exactly where they were, which is the point."""
+
+    def __init__(self, replica_id: int, engine,
+                 health: Optional[FleetHealth] = None):
+        self.replica_id = int(replica_id)
+        self.engine = engine
+        self.inbox: _queue.Queue = _queue.Queue()
+        self.inflight: Dict[int, tuple] = {}      # item_id → (item, req)
+        self.lock = threading.Lock()
+        self._health = health
+        self._stop = threading.Event()
+        self._killed = False
+        self._clean_exit = False
+        self._work_iter = 0
+        self.thread = threading.Thread(
+            target=self._run, name=f"fleet-replica-{replica_id}",
+            daemon=True)
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def depth(self) -> int:
+        """Placement load: inbox + engine queue + occupied slots."""
+        return (self.inbox.qsize() + len(self.engine.queue)
+                + len(self.engine.active) + len(self.engine.prefilling))
+
+    def kill(self) -> None:
+        """Die DIRTY (test hook, same observable as the chaos fault):
+        the worker exits without the clean flag, abandoning its state
+        for the router's health sweep to re-queue."""
+        self._killed = True
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.thread.join(timeout=30)
+
+    def dead(self) -> bool:
+        return not self.thread.is_alive() and not self._clean_exit
+
+    def drain_unfinished(self) -> List[_FleetItem]:
+        """After death: every item whose future is still open, in
+        submission order (inflight first — they were admitted first —
+        then the never-admitted inbox backlog).
+
+        The join makes the common deaths (chaos kill, ``kill()``, a
+        raise) fully race-free — the worker is gone before we touch its
+        state. A wedged-but-alive worker (heartbeat death) is blocked
+        INSIDE a dispatch, past admission, so it holds no item in hand;
+        snapshotting under the replica lock (bounded acquire — a wedged
+        dispatch may hold it forever) and clearing ``inflight`` fences
+        it off these futures, and the ``done()`` guard on resolution
+        makes any residual overlap harmless."""
+        self.thread.join(timeout=5.0)
+        got = self.lock.acquire(timeout=1.0)
+        try:
+            items = [item for _iid, (item, _req)
+                     in sorted(self.inflight.items())]
+            if got:
+                self.inflight.clear()
+            try:
+                while True:
+                    items.append(self.inbox.get_nowait())
+            except _queue.Empty:
+                pass
+        finally:
+            if got:
+                self.lock.release()
+        return [it for it in items if not it.future.done()]
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._killed:
+                return                       # dirty exit: state abandoned
+            if self._health is not None:
+                self._health.beat(self.replica_id)
+            worked = False
+            try:
+                while True:
+                    item = self.inbox.get_nowait()
+                    with self.lock:
+                        try:
+                            req = self.engine.submit(item.prompt,
+                                                     **item.kw)
+                            self.inflight[item.item_id] = (item, req)
+                        except Exception as e:   # bad request, not fatal
+                            item.future.set_exception(e)
+                    worked = True
+            except _queue.Empty:
+                pass
+            with self.lock:
+                if not self.engine.idle():
+                    if chaos.on_replica_step(self.replica_id,
+                                             self._work_iter):
+                        self._killed = True
+                        return               # chaos kill: dirty exit
+                    self._work_iter += 1
+                    # one [n_slots, k] int32 pull per dispatch
+                    self.engine.step()  # dlint: disable=DL104
+                    worked = True
+                    for iid, (item, req) in list(self.inflight.items()):
+                        if req.finished:
+                            del self.inflight[iid]
+                            if not item.future.done():
+                                item.future.set_result(req)
+            if not worked:
+                time.sleep(_IDLE_WAIT_S)
+        self._clean_exit = True
+
+
+class Router:
+    """The fleet front door. Construct with engines, submit from any
+    thread, ``result()`` with deadline-bounded waits, ``close()`` when
+    done (context manager supported)."""
+
+    def __init__(self, engines, *, rpc_policy: Optional[RpcPolicy] = None,
+                 watchdog=None, max_queue_depth: Optional[int] = None,
+                 health_timeout_ms: Optional[int] = None,
+                 report: Optional[FleetReport] = None):
+        if not engines:
+            raise ValueError("Router needs at least one engine")
+        self._policy = rpc_policy
+        self._watchdog = watchdog
+        self.max_queue_depth = max_queue_depth
+        self.report = report or FleetReport()
+        self.health = FleetHealth(range(len(engines)),
+                                  timeout_ms=health_timeout_ms)
+        self.replicas: Dict[int, EngineReplica] = {
+            i: EngineReplica(i, eng, self.health)
+            for i, eng in enumerate(engines)}
+        self._pending: deque = deque()
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, int] = {}       # session → replica_id
+        self._handled_dead: set = set()
+        self._ids = itertools.count()
+        self._stop = threading.Event()
+        for rep in self.replicas.values():
+            rep.start()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-router", daemon=True)
+        self._thread.start()
+
+    # ----------------------------------------------------------------
+    # client face (any thread)
+    # ----------------------------------------------------------------
+
+    def _alive(self) -> List[EngineReplica]:
+        return [self.replicas[r] for r in self.health.alive()
+                if not self.replicas[r].dead()]
+
+    def submit(self, prompt, *, session: Optional[str] = None,
+               **kw) -> Future:
+        """Route one request; kwargs pass through to ``Engine.submit``.
+        ``session`` opts into sticky placement. Raises
+        :class:`~chainermn_tpu.serving.frontend.AdmissionRejected` when
+        every live replica sits at ``max_queue_depth`` — shed at the
+        door with a retry-after hint, not a timeout ten layers in."""
+        if self._stop.is_set():
+            raise RuntimeError("router is closed")
+        if self.max_queue_depth is not None:
+            alive = self._alive()
+            with self._lock:
+                backlog = len(self._pending)
+            # the not-yet-placed router backlog counts against the
+            # fleet's headroom too — otherwise a burst outruns the
+            # dispatch loop and sails past the bound unrejected
+            total = sum(r.depth() for r in alive) + backlog
+            if alive and total >= self.max_queue_depth * len(alive):
+                pol = self._policy or policy()
+                self.report.record_rejected()
+                raise AdmissionRejected(
+                    f"fleet backlog {total} at the bound "
+                    f"({self.max_queue_depth} × {len(alive)} live "
+                    f"replicas); retry after {pol.backoff_base_ms} ms",
+                    retry_after_ms=pol.backoff_base_ms)
+        item = _FleetItem(next(self._ids), prompt, kw, session)
+        with self._lock:
+            self._pending.append(item)
+        return item.future
+
+    def result(self, future: Future, timeout_ms: Optional[int] = None):
+        """Deadline-bounded wait sliced at ``probe_ms`` (the DL111-clean
+        shape: every slice is a bounded wait, and a dead router thread
+        surfaces on the next probe, not after the full budget)."""
+        pol = self._policy or policy()
+        budget_ms = timeout_ms if timeout_ms is not None else pol.timeout_ms
+        deadline = time.monotonic() + budget_ms / 1e3
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise DeadlineExceeded(
+                    f"no result within {budget_ms} ms "
+                    f"(probe={pol.probe_ms} ms)")
+            try:
+                return future.result(timeout=min(pol.probe_ms / 1e3, left))
+            except FutureTimeout:
+                if not self._thread.is_alive() and not future.done():
+                    raise RuntimeError(
+                        "router thread died with the request in flight")
+
+    def drain(self, timeout_ms: Optional[int] = None) -> None:
+        """Block until no routed work remains anywhere in the fleet
+        (pending, inboxes, engines, inflight) — replica deaths along
+        the way re-queue through the health sweep and still drain."""
+        pol = self._policy or policy()
+        budget_ms = timeout_ms if timeout_ms is not None else pol.timeout_ms
+        deadline = time.monotonic() + budget_ms / 1e3
+        while time.monotonic() < deadline:
+            with self._lock:
+                quiet = not self._pending
+            if quiet and all(
+                    rep.dead() or (rep.inbox.qsize() == 0
+                                   and not rep.inflight
+                                   and rep.engine.idle())
+                    for rep in self.replicas.values()):
+                return
+            time.sleep(_IDLE_WAIT_S)
+        raise DeadlineExceeded(f"fleet not drained within {budget_ms} ms")
+
+    def close(self) -> None:
+        self._stop.set()
+        for rep in self.replicas.values():
+            rep.stop()
+        self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def reports(self):
+        return [rep.engine.report for rep in self.replicas.values()]
+
+    def summary(self) -> dict:
+        return self.report.summary(self.reports())
+
+    # ----------------------------------------------------------------
+    # dispatch loop (router thread)
+    # ----------------------------------------------------------------
+
+    def _place(self, item: _FleetItem) -> Optional[EngineReplica]:
+        """Session-affine, else least-depth, among live replicas with
+        headroom. Returns None when nothing can take the item yet."""
+        alive = self._alive()
+        if not alive:
+            return None
+        if item.session is not None:
+            rid = self._sessions.get(item.session)
+            if rid is not None and self.health.is_alive(rid) \
+                    and not self.replicas[rid].dead():
+                return self.replicas[rid]
+        candidates = alive
+        if self.max_queue_depth is not None:
+            candidates = [r for r in alive
+                          if r.depth() < self.max_queue_depth]
+            if not candidates:
+                return None
+        return min(candidates, key=lambda r: (r.depth(), r.replica_id))
+
+    def _handle_dead(self, rid: int) -> None:
+        """Re-queue a dead replica's unfinished work at the FRONT of
+        pending, futures intact — the replay-from-seed contract makes
+        the survivor's stream identical to the one that died."""
+        rep = self.replicas[rid]
+        # FENCE first: a heartbeat-declared death may be a wedged-but-
+        # running worker (e.g. a stalled dispatch) — shoot it in the
+        # head so it cannot race the survivor for these futures
+        rep.kill()
+        items = rep.drain_unfinished()
+        self.report.record_replica_dead()
+        self.report.record_requeue(len(items))
+        for session, mapped in list(self._sessions.items()):
+            if mapped == rid:
+                del self._sessions[session]
+        with self._lock:
+            for item in reversed(items):
+                self._pending.appendleft(item)
+
+    def _sweep_dead(self) -> bool:
+        """Two death signals, one verdict: heartbeat silence past the
+        probe deadline (FleetHealth) and worker-thread death observed
+        directly (a chaos kill or a raise stops beats AND the thread —
+        the thread check notices within one loop pass instead of one
+        probe period)."""
+        worked = False
+        for rid, rep in self.replicas.items():
+            if rep.dead() and self.health.is_alive(rid):
+                self.health.mark_dead(rid, "worker thread died")
+        newly = set(self.health.check()) | {
+            rid for rid in self.health.dead
+            if rid not in self._handled_dead}
+        for rid in sorted(newly):
+            self._handled_dead.add(rid)
+            self._handle_dead(rid)
+            worked = True
+        return worked
+
+    def _poll_watchdog(self) -> None:
+        from chainermn_tpu.comm.object_plane import JobAbortedError
+
+        wd = self._watchdog or current_watchdog()
+        if wd is None:
+            return
+        try:
+            wd.check()
+        except JobAbortedError as e:
+            # bounded abortion, fleet-wide: fail every open future now
+            items = []
+            with self._lock:
+                items.extend(self._pending)
+                self._pending.clear()
+            for rep in self.replicas.values():
+                with rep.lock:
+                    rep.engine.abort_all()
+                items.extend(it for it, _r in rep.inflight.values())
+                rep.inflight.clear()
+                items.extend(rep.drain_unfinished())
+            for item in items:
+                if not item.future.done():
+                    item.future.set_exception(JobAbortedError(str(e)))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._poll_watchdog()
+            worked = self._sweep_dead()
+            if not self._alive():
+                # no survivor can ever take these — fail fast rather
+                # than letting clients ride out the full deadline
+                stranded = []
+                with self._lock:
+                    stranded.extend(self._pending)
+                    self._pending.clear()
+                for item in stranded:
+                    if not item.future.done():
+                        item.future.set_exception(RuntimeError(
+                            "no live replicas left in the fleet"))
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        break
+                    item = self._pending[0]
+                if item.future.done():       # resolved while re-queued
+                    with self._lock:
+                        if self._pending and self._pending[0] is item:
+                            self._pending.popleft()
+                    continue
+                rep = self._place(item)
+                if rep is None:
+                    break                    # no headroom/survivor yet
+                with self._lock:
+                    if not self._pending or self._pending[0] is not item:
+                        continue
+                    self._pending.popleft()
+                if item.session is not None:
+                    self._sessions[item.session] = rep.replica_id
+                rep.inbox.put(item)
+                worked = True
+            if not worked:
+                time.sleep(_IDLE_WAIT_S)
+        # teardown: replicas were stopped by close(); fail what's left
+        leftovers = []
+        with self._lock:
+            leftovers.extend(self._pending)
+            self._pending.clear()
+        for rep in self.replicas.values():
+            leftovers.extend(it for it, _r in rep.inflight.values())
+            leftovers.extend(rep.drain_unfinished())
+        for item in leftovers:
+            if not item.future.done():
+                item.future.set_exception(
+                    RuntimeError("router closed mid-request"))

@@ -18,7 +18,13 @@ deterministic schedule, so the suite can prove the stack survives them:
   before any byte is written (a full disk — the save raises, nothing is
   published, the election must fall back);
 * ``slow_disk`` — sleep before a matching snapshot publish (a
-  overloaded/slow disk stretching the write window).
+  overloaded/slow disk stretching the write window);
+* ``kill_replica`` — abruptly kill ONE fleet replica's scheduler loop at
+  a given working iteration (the in-process SIGKILL analogue the router
+  drill uses: futures stay unresolved, survivors must absorb the work);
+* ``corrupt_handoff`` — damage a prefill→decode KV handoff blob on the
+  wire (flip or truncate), which the decode pool's manifest verification
+  must catch and answer with a clean re-prefill.
 
 Faults can be pinned to one supervised incarnation with ``run=K``: the
 supervisor (:mod:`chainermn_tpu.resilience.supervisor`) exports
@@ -94,11 +100,22 @@ FAULT_KINDS: Dict[str, str] = {
                      "serialize+publish (stretches the offload→publish "
                      "window): ms=M,match=SUBSTRING[,rank=R|*][,after=K]"
                      "[,prob=P][,seed=S]"),
+    "kill_replica": ("kill ONE serving replica's scheduler (the fleet "
+                     "router's SIGKILL analogue: the loop dies abruptly, "
+                     "futures unresolved, and the router must re-queue): "
+                     "step=N[,replica=R|*][,rank=R|*]"),
+    "corrupt_handoff": ("damage a prefill→decode KV handoff on the "
+                        "wire (flip 64 bytes at offset, or truncate "
+                        "when keep= is given — the decode pool must "
+                        "fall back to a clean re-prefill): "
+                        "[offset=O][,keep=BYTES][,after=K][,prob=P]"
+                        "[,seed=S][,rank=R|*]"),
 }
 
 #: every fault kind also accepts ``run=K`` — fire only in supervised
 #: incarnation K ($CHAINERMN_TPU_RESTART_COUNT, 0 when unsupervised)
-_INT_KEYS = {"step", "ms", "offset", "keep", "after", "seed", "run"}
+_INT_KEYS = {"step", "ms", "offset", "keep", "after", "seed", "run",
+             "replica"}
 _FLOAT_KEYS = {"prob"}
 
 
@@ -117,6 +134,7 @@ class Fault:
     keep: Optional[int] = None
     after: int = 0
     run: Optional[int] = None           # None = every incarnation
+    replica: Optional[int] = None       # None = every replica ('*')
     fired: int = field(default=0, repr=False)
     _rng: Optional[random.Random] = field(default=None, repr=False)
     _skipped: int = field(default=0, repr=False)
@@ -146,7 +164,8 @@ class Fault:
         --dry-run listing)."""
         parts = []
         for name in ("step", "signal", "op", "ms", "prob", "seed",
-                     "match", "offset", "keep", "after", "run"):
+                     "match", "offset", "keep", "after", "run",
+                     "replica"):
             val = getattr(self, name)
             if val is None:
                 continue
@@ -183,8 +202,8 @@ def parse_spec(spec: str) -> List[Fault]:
                     "(expected key=value)")
             key = key.strip()
             val = val.strip()
-            if key == "rank" and val == "*":
-                kv["rank"] = None
+            if key in ("rank", "replica") and val == "*":
+                kv[key] = None
             elif key in _INT_KEYS or key == "rank":
                 kv[key] = int(val)
             elif key in _FLOAT_KEYS:
@@ -196,8 +215,9 @@ def parse_spec(spec: str) -> List[Fault]:
         except TypeError as e:
             raise ValueError(
                 f"bad field in chaos clause {clause!r}: {e}") from e
-        if fault.kind == "kill" and fault.step is None:
-            raise ValueError(f"kill fault needs step=N: {clause!r}")
+        if fault.kind in ("kill", "kill_replica") and fault.step is None:
+            raise ValueError(
+                f"{fault.kind} fault needs step=N: {clause!r}")
         if (fault.kind in ("corrupt", "truncate", "enospc", "slow_disk",
                            "slow_offload", "stall_writer")
                 and not fault.match):
@@ -354,6 +374,59 @@ class ChaosPlan:
                     errno.ENOSPC,
                     f"No space left on device (chaos enospc: {base})")
 
+    def on_replica_step(self, replica: int, iteration: int,
+                        rank: Optional[int] = None) -> bool:
+        """Fleet-replica hook: the router's per-replica scheduler loop
+        calls this before each WORKING iteration (idle spins don't
+        count, so ``step=N`` means the same thing under any poll rate).
+        Returns True when a matching ``kill_replica`` fault fires — the
+        caller must die abruptly (no drain, no future resolution), the
+        in-process analogue of SIGKILLing that replica's host."""
+        rank = _own_rank() if rank is None else rank
+        for f in self.faults:
+            if f.kind != "kill_replica" or f.step != iteration:
+                continue
+            if f.replica is not None and f.replica != replica:
+                continue
+            if not f.applies_to_rank(rank) or not f.applies_to_run():
+                continue
+            f.fired += 1
+            self.log.append(
+                f"kill_replica replica={replica} step={iteration}")
+            return True
+        return False
+
+    def on_handoff(self, data: bytes,
+                   rank: Optional[int] = None) -> bytes:
+        """KV-handoff wire hook (fleet/pools.py, between encode and
+        decode): ``corrupt_handoff`` returns a damaged copy — 64 bytes
+        XOR-flipped at ``offset``, or the blob truncated to ``keep``
+        bytes. The decode side's manifest verification must catch it
+        and fall back to a clean re-prefill."""
+        rank = _own_rank() if rank is None else rank
+        for f in self.faults:
+            if f.kind != "corrupt_handoff":
+                continue
+            if not f.applies_to_rank(rank) or not f.applies_to_run():
+                continue
+            if f._skipped < f.after:
+                f._skipped += 1
+                continue
+            if not f.roll():
+                continue
+            f.fired += 1
+            if f.keep is not None:
+                self.log.append(f"corrupt_handoff keep={f.keep}")
+                data = data[:max(0, f.keep)]
+            else:
+                self.log.append(f"corrupt_handoff offset={f.offset}")
+                buf = bytearray(data)
+                end = min(len(buf), f.offset + 64)
+                for i in range(f.offset, end):
+                    buf[i] ^= 0xFF
+                data = bytes(buf)
+        return data
+
     #: pipeline stage → fault kind for :meth:`on_offload`
     _OFFLOAD_STAGES = {"offload": "slow_offload", "writer": "stall_writer"}
 
@@ -439,3 +512,19 @@ def on_offload(path: str, stage: str) -> None:
         plan = chaos_from_env()
         if plan is not None:
             plan.on_offload(path, stage)
+
+
+def on_replica_step(replica: int, iteration: int) -> bool:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            return plan.on_replica_step(replica, iteration)
+    return False
+
+
+def on_handoff(data: bytes) -> bytes:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            return plan.on_handoff(data)
+    return data

@@ -14,7 +14,8 @@ warm-restart snapshot plane. See docs/serving.md.
 
 from chainermn_tpu.serving.engine import (Engine, EngineConfig, Request,
                                           default_buckets)
-from chainermn_tpu.serving.frontend import DeadlineExceeded, Frontend
+from chainermn_tpu.serving.frontend import (AdmissionRejected,
+                                            DeadlineExceeded, Frontend)
 from chainermn_tpu.serving.kv_cache import (ServingStep, cache_bytes,
                                             cache_spec, decode_apply,
                                             decode_k_apply, init_cache,
@@ -29,7 +30,7 @@ from chainermn_tpu.serving.weights import (WeightsError, load_weights,
 
 __all__ = [
     "Engine", "EngineConfig", "Request", "default_buckets",
-    "Frontend", "DeadlineExceeded",
+    "Frontend", "DeadlineExceeded", "AdmissionRejected",
     "ServingStep", "cache_bytes", "cache_spec", "decode_apply",
     "decode_k_apply", "init_cache", "prefill_apply",
     "prefill_chunk_apply",

@@ -42,6 +42,7 @@ import itertools
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from chainermn_tpu.resilience import chaos
@@ -108,9 +109,11 @@ class Request:
     top_k: Optional[int] = None           # None → full vocab
     seed: int = 0
     tokens: List[int] = dataclasses.field(default_factory=list)
-    state: str = "queued"             # queued|running|done|aborted
+    state: str = "queued"             # queued|running|held|done|aborted
     slot: Optional[int] = None
     prefill_pos: int = 0              # chunked prefill: tokens written
+    hold: bool = False                # retire → 'held' (slot kept bound
+    #                                   for export_handoff; fleet pools)
 
     @property
     def finished(self) -> bool:
@@ -138,6 +141,8 @@ class Engine:
         self.queue: deque[Request] = deque()
         self.active: Dict[int, Request] = {}          # slot → decoding
         self.prefilling: Dict[int, Request] = {}      # slot → mid-chunk
+        self.held: Dict[int, Request] = {}            # slot → awaiting
+        #                                               export (handoff)
         self.free_slots: List[int] = list(range(config.n_slots))
         self.cur_tokens = np.zeros(config.n_slots, np.int32)
         # per-slot sampling state, threaded through the compiled
@@ -169,7 +174,7 @@ class Engine:
                eos_id: Optional[int] = None,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
-               seed: int = 0) -> Request:
+               seed: int = 0, hold: bool = False) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -189,7 +194,7 @@ class Engine:
                                       if max_new_tokens is not None
                                       else self.config.max_new_tokens),
                       eos_id=eos_id, temperature=temperature,
-                      top_k=top_k, seed=seed)
+                      top_k=top_k, seed=seed, hold=hold)
         self.queue.append(req)
         self.report.record_submit(req.request_id)
         return req
@@ -215,7 +220,10 @@ class Engine:
         self.report.record_token(req.request_id)
         hit_eos = req.eos_id is not None and token == req.eos_id
         if hit_eos or len(req.tokens) >= req.max_new_tokens:
-            self._retire(req)
+            if req.hold:
+                self._hold(req)
+            else:
+                self._retire(req)
         elif req.slot is not None:
             self.cur_tokens[req.slot] = token
 
@@ -225,8 +233,97 @@ class Engine:
             self.free_slots.append(req.slot)
             self.active.pop(req.slot, None)
             self.prefilling.pop(req.slot, None)
+            self.held.pop(req.slot, None)
             req.slot = None
         self.report.record_retire(req.request_id, aborted=aborted)
+
+    def _hold(self, req: Request) -> None:
+        """Terminal-by-budget request parks in 'held' instead of
+        retiring: the slot stays bound (its KV rows, cursor, and PRNG
+        key intact) until ``export_handoff`` + ``release_held`` — the
+        prefill side of the disaggregated fleet (fleet/pools.py)."""
+        req.state = "held"
+        self.active.pop(req.slot, None)
+        self.prefilling.pop(req.slot, None)
+        self.held[req.slot] = req
+
+    def release_held(self, req: Request, aborted: bool = False) -> None:
+        """Free a held request's slot (after ``export_handoff``)."""
+        if req.state != "held" or self.held.get(req.slot) is not req:
+            raise ValueError(
+                f"request {req.request_id} is not held by this engine")
+        self._retire(req, aborted=aborted)
+
+    def export_handoff(self, req: Request) -> dict:
+        """Package a HELD request's device state for a decode replica:
+        per-block KV rows up to the real fill level, the cursor, the
+        post-sampling PRNG key row, the emitted tokens, and the sampling
+        knobs. ``fleet/handoff.py`` serializes this dict to a
+        manifest-versioned wire blob; raw-format round-trips are
+        bitwise, so the importing engine continues the exact stream."""
+        if req.state != "held" or self.held.get(req.slot) is not req:
+            raise ValueError(
+                f"request {req.request_id} is not held by this engine")
+        slot = req.slot
+        # every emitted token except the newest has been written into
+        # the cache (the newest is the decode input still in flight)
+        fill = int(req.prompt.size + len(req.tokens) - 1)
+        return {
+            "pages": self.steps.export_slot(slot, fill),
+            "cursor": fill,
+            "tokens": list(req.tokens),
+            "key": np.asarray(self._keys[slot]),
+            "prompt_len": int(req.prompt.size),
+            "eos_id": req.eos_id,
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "seed": req.seed,
+        }
+
+    def import_handoff(self, handoff: dict, prompt,
+                       max_new_tokens: Optional[int] = None) -> Request:
+        """Adopt an exported slot: bind a free slot, write the KV rows
+        and cursor, restore the PRNG key and sampling rows, and resume
+        decoding from the handed-off last token. The resumed stream is
+        bitwise-identical to the exporting engine continuing (raw wire
+        format) — the disaggregation contract bench.py gates."""
+        if not self.free_slots:
+            raise RuntimeError("no free slot to import a handoff into")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size != int(handoff["prompt_len"]):
+            raise ValueError(
+                f"handoff prompt_len {handoff['prompt_len']} does not "
+                f"match the supplied prompt ({prompt.size})")
+        if not handoff["tokens"]:
+            raise ValueError("handoff carries no sampled token")
+        req = Request(
+            request_id=next(self._ids), prompt=prompt,
+            max_new_tokens=(max_new_tokens if max_new_tokens is not None
+                            else self.config.max_new_tokens),
+            eos_id=handoff["eos_id"], temperature=handoff["temperature"],
+            top_k=handoff["top_k"], seed=handoff["seed"],
+            tokens=list(handoff["tokens"]), state="running")
+        self.report.record_submit(req.request_id)
+        slot = self.free_slots.pop(0)
+        req.slot = slot
+        self._temps[slot] = (req.temperature
+                             if req.temperature is not None else 0.0)
+        self._topks[slot] = req.top_k if req.top_k is not None else 0
+        self._eos[slot] = req.eos_id if req.eos_id is not None else -1
+        # the handed-off key CONTINUES the stream (one split consumed
+        # per sampled token so far) — never re-derive from the seed
+        self._keys = self._keys.at[slot].set(
+            jnp.asarray(handoff["key"], jnp.uint32))
+        self.steps.import_slot(slot, handoff["pages"],
+                               int(handoff["cursor"]))
+        last = req.tokens[-1]
+        hit_eos = req.eos_id is not None and last == req.eos_id
+        if hit_eos or len(req.tokens) >= req.max_new_tokens:
+            self._retire(req)              # already terminal at handoff
+        else:
+            self.cur_tokens[slot] = last
+            self.active[slot] = req
+        return req
 
     def abort_all(self, requeue: bool = False) -> List[Request]:
         """Watchdog-bounded teardown: every in-flight request aborts (or
@@ -234,7 +331,8 @@ class Engine:
         to the caller. Returns the affected requests."""
         hit = []
         inflight = (list(self.active.values())
-                    + list(self.prefilling.values()))
+                    + list(self.prefilling.values())
+                    + list(self.held.values()))
         for req in inflight:
             if requeue:
                 req.state = "queued"
@@ -244,6 +342,7 @@ class Engine:
                     self.free_slots.append(req.slot)
                     self.active.pop(req.slot, None)
                     self.prefilling.pop(req.slot, None)
+                    self.held.pop(req.slot, None)
                     req.slot = None
                 self.queue.appendleft(req)
             else:
@@ -396,6 +495,10 @@ class Engine:
         park = np.zeros(n, np.int32)
         for slot, req in self.prefilling.items():
             park[slot] = req.prefill_pos
+        for slot, req in self.held.items():
+            # a held slot's rows await export: pin its cursor to the
+            # real fill so the ride-along garbage steps can't wrap it
+            park[slot] = req.prompt.size + len(req.tokens) - 1
         toks_dev, self._keys = self.steps.decode_k(
             self.cur_tokens, self._keys, self._temps, self._topks,
             self._eos, remaining, live, park, cfg.decode_k)

@@ -30,12 +30,25 @@ from typing import Optional
 from chainermn_tpu.resilience.policy import RpcPolicy, policy
 from chainermn_tpu.resilience.watchdog import current_watchdog
 
-__all__ = ["Frontend", "DeadlineExceeded"]
+__all__ = ["Frontend", "DeadlineExceeded", "AdmissionRejected"]
 
 
 class DeadlineExceeded(TimeoutError):
     """The deadline-bounded wait ran out of budget (the replica may
     still be alive — the request is NOT cancelled)."""
+
+
+class AdmissionRejected(RuntimeError):
+    """Queue-depth backpressure: the submission was REFUSED before any
+    engine state changed. ``retry_after_ms`` is the server's hint —
+    re-submit after that long (the ``RpcPolicy`` backoff base, so a
+    polite client and the RPC retry ladder pace identically). Raised by
+    ``Frontend.submit`` (one engine, ``max_queue_depth``) and
+    ``fleet.Router.submit`` (every live replica over its bound)."""
+
+    def __init__(self, msg: str, retry_after_ms: int):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
 
 
 class Frontend:
@@ -48,10 +61,11 @@ class Frontend:
     _IDLE_WAIT_S = 0.005     # mailbox poll while the engine is idle
 
     def __init__(self, engine, *, rpc_policy: Optional[RpcPolicy] = None,
-                 watchdog=None):
+                 watchdog=None, max_queue_depth: Optional[int] = None):
         self.engine = engine
         self._policy = rpc_policy
         self._watchdog = watchdog
+        self.max_queue_depth = max_queue_depth
         self._mail: _queue.Queue = _queue.Queue()
         self._futures = {}           # request_id → Future
         self._lock = threading.Lock()
@@ -71,9 +85,24 @@ class Frontend:
         arguments pass straight through to ``Engine.submit`` — per-
         request ``max_new_tokens``, ``eos_id``, and the on-device
         sampling knobs ``temperature``/``top_k``/``seed``
-        (serving/sampling.py)."""
+        (serving/sampling.py).
+
+        With ``max_queue_depth`` set, a submission that would push the
+        backlog (mailbox + engine queue) past the bound raises
+        :class:`AdmissionRejected` with a ``retry_after_ms`` hint
+        instead of growing an unbounded queue — load sheds at the door,
+        not as a timeout ten layers later."""
         if self._stop.is_set():
             raise RuntimeError("frontend is closed")
+        if self.max_queue_depth is not None:
+            depth = self._mail.qsize() + len(self.engine.queue)
+            if depth >= self.max_queue_depth:
+                pol = self._policy or policy()
+                raise AdmissionRejected(
+                    f"queue depth {depth} at the bound "
+                    f"({self.max_queue_depth}); retry after "
+                    f"{pol.backoff_base_ms} ms",
+                    retry_after_ms=pol.backoff_base_ms)
         fut: Future = Future()
         self._mail.put((prompt, kw, fut))
         return fut

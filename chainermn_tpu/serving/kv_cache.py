@@ -59,6 +59,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from chainermn_tpu.models.transformer import bhld_to_blhd_params
 from chainermn_tpu.serving.sampling import sample_tokens
@@ -527,6 +528,52 @@ class ServingStep:
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(top_ks, jnp.int32))
         return tok, keys
+
+    def export_slot(self, slot: int, fill: int) -> Dict[str, Dict[str, Any]]:
+        """Pull one slot's populated KV rows to the host: ``{"block_i":
+        {"k", "v"}}`` with each leaf ``[fill, n_kv_heads, d_head]`` in
+        the cache dtype — the prefill→decode handoff payload
+        (fleet/handoff.py). ``fill`` must not exceed the page (a wrapped
+        ring has overwritten its prefix; re-prefill instead)."""
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} outside [0, {self.n_slots})")
+        if not 0 < fill <= self.capacity:
+            raise ValueError(
+                f"fill {fill} outside (0, capacity={self.capacity}] — a "
+                "wrapped slot cannot be exported")
+        return {name: {"k": np.asarray(page["k"][slot, :fill]),
+                       "v": np.asarray(page["v"][slot, :fill])}
+                for name, page in self.cache.items()}
+
+    def import_slot(self, slot: int, pages, cursor: int) -> None:
+        """Inverse of :meth:`export_slot`: write handed-off KV rows into
+        ``slot`` and set its cursor to ``cursor``. Raw-format handoffs
+        round-trip BITWISE (same dtype, no value transform), so decode
+        from an imported slot equals decode on the exporting engine."""
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} outside [0, {self.n_slots})")
+        if not 0 < cursor <= self.capacity:
+            raise ValueError(
+                f"cursor {cursor} outside (0, capacity={self.capacity}]")
+        if set(pages) != set(self.cache):
+            raise ValueError(
+                "handoff pages do not match this model's cache layout: "
+                f"got {sorted(pages)}, want {sorted(self.cache)}")
+        new_cache = {}
+        for name, page in self.cache.items():
+            k = jnp.asarray(pages[name]["k"], page["k"].dtype)
+            v = jnp.asarray(pages[name]["v"], page["v"].dtype)
+            want = (cursor,) + page["k"].shape[2:]
+            if k.shape != want or v.shape != want:
+                raise ValueError(
+                    f"handoff rows for {name} have shape {k.shape}, "
+                    f"want {want}")
+            new_cache[name] = {
+                "k": page["k"].at[slot, :cursor].set(k),
+                "v": page["v"].at[slot, :cursor].set(v),
+                "idx": page["idx"].at[slot].set(jnp.int32(cursor)),
+            }
+        self.cache = new_cache
 
     def load_params(self, params):
         """Swap weights in place (warm restart — serving/weights.py)."""

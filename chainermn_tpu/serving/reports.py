@@ -107,6 +107,29 @@ class ServingReport:
     # output
     # ----------------------------------------------------------------
 
+    def raw(self) -> dict:
+        """The UNREDUCED telemetry: raw sample lists + counters + the
+        observed wall span. This is the only honest input to cross-
+        replica aggregation — ``fleet.FleetReport.merge`` pools these
+        and takes percentiles over the pooled samples, because a mean of
+        per-replica p99s is not a fleet p99 (and a mean of per-replica
+        ``host_bytes_per_token`` ratios mis-weights unequal replicas)."""
+        span = ((self._t_last - self._t0)
+                if self._t0 is not None and self._t_last is not None
+                else 0.0)
+        return {
+            "ttft_s": list(self.ttft_s),
+            "token_gap_s": list(self.token_gap_s),
+            "queue_depth_samples": list(self.queue_depth_samples),
+            "occupancy_samples": list(self.occupancy_samples),
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "aborted": self.aborted,
+            "tokens_emitted": self.tokens_emitted,
+            "host_bytes": self.host_bytes,
+            "wall_s": span,
+        }
+
     def _dist_ms(self, samples: List[float]) -> Dict[str, float]:
         out = {f"p{q}": percentile(samples, q) * 1e3
                for q in self.PERCENTILES}

@@ -23,8 +23,8 @@ def _only(findings, rule):
 
 def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
-            "DL107", "DL108", "DL109", "DL110", "DL201", "DL202",
-            "DL203", "DL204"} <= set(RULES)
+            "DL107", "DL108", "DL109", "DL110", "DL111", "DL201",
+            "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
         assert rule.kind in ("ast", "hlo")
@@ -914,3 +914,85 @@ def test_dl110_suppression_with_rationale():
             cur = logits.argmax(-1)
     """
     assert _only(_lint(src), "DL110") == []
+
+
+# ---------------------------------------------------------------------------
+# DL111 — blocking-rpc-in-router-loop
+# ---------------------------------------------------------------------------
+
+
+def test_dl111_flags_unbounded_mailbox_get_in_loop():
+    src = """\
+    def dispatch(inbox, replicas):
+        while True:
+            item = inbox.get()
+            replicas[0].submit(item)
+    """
+    fs = _only(_lint(src), "DL111")
+    assert len(fs) == 1
+    assert fs[0].line == 3
+    assert "inbox.get" in fs[0].message
+    assert "docs/static_analysis.md#dl111" in fs[0].message
+
+
+def test_dl111_flags_unbounded_future_waits():
+    src = """\
+    def route(pending, mail):
+        for fut in pending:
+            fut.result()
+        while True:
+            msg = mail.get(timeout=None)
+    """
+    fs = _only(_lint(src), "DL111")
+    assert [f.line for f in fs] == [3, 5]
+
+
+def test_dl111_clean_on_bounded_and_nonblocking_waits():
+    src = """\
+    import queue
+
+    def dispatch(inbox, futures, pol):
+        while True:
+            try:
+                item = inbox.get_nowait()
+            except queue.Empty:
+                break
+        for fut in futures:
+            fut.result(timeout=pol.probe_ms / 1e3)
+    """
+    assert _only(_lint(src), "DL111") == []
+
+
+def test_dl111_clean_on_non_mailbox_receivers():
+    src = """\
+    import os
+
+    def collect(paths, cfg, threads):
+        out = []
+        for p in paths:
+            out.append(os.path.join(cfg.get("root"), p))
+        for t in threads:
+            t.join(timeout=30)
+        return out
+    """
+    assert _only(_lint(src), "DL111") == []
+
+
+def test_dl111_clean_outside_a_loop():
+    src = """\
+    def one_shot(fut):
+        return fut.result()
+    """
+    assert _only(_lint(src), "DL111") == []
+
+
+def test_dl111_suppression_with_rationale():
+    src = """\
+    def writer(work_queue):
+        while True:
+            # fixture: same-process sentinel-terminated consumer
+            item = work_queue.get()  # dlint: disable=DL111
+            if item is None:
+                return
+    """
+    assert _only(_lint(src), "DL111") == []

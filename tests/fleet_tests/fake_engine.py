@@ -1,0 +1,120 @@
+"""A deterministic, jax-free stand-in for ``serving.Engine``.
+
+The router only needs the engine's scheduling face (submit / step /
+idle / abort_all + the queue/active/prefilling/free_slots attributes),
+so the tier-1 fleet drills run against this fake: one token per
+``step()`` per active request, with the emitted stream a pure function
+of ``(prompt, seed)`` — which makes the router's replay-on-requeue
+contract directly checkable (a re-queued request MUST reproduce the
+exact stream the dead replica was emitting, because the real engine's
+seeded sampler replays identically)."""
+
+import itertools
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from chainermn_tpu.serving.engine import Request
+from chainermn_tpu.serving.reports import ServingReport
+
+
+def expected_tokens(prompt, seed: int, n: int, vocab: int = 43) -> List[int]:
+    """The stream a FakeEngine emits for (prompt, seed) — the oracle."""
+    base = int(np.asarray(prompt, np.int64).sum()) + 7 * seed
+    return [(base + 13 * i) % vocab for i in range(n)]
+
+
+class FakeEngine:
+    """Duck-typed ``serving.Engine`` emitting ``expected_tokens``."""
+
+    def __init__(self, n_slots: int = 2, max_new_tokens: int = 8,
+                 step_delay_s: float = 0.0):
+        self.n_slots = n_slots
+        self.default_max_new = max_new_tokens
+        self.step_delay_s = step_delay_s
+        self.queue: deque = deque()
+        self.active: Dict[int, Request] = {}
+        self.prefilling: Dict[int, Request] = {}
+        self.held: Dict[int, Request] = {}
+        self.free_slots: List[int] = list(range(n_slots))
+        self.report = ServingReport()
+        self.iteration = 0
+        self._ids = itertools.count()
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_id=None, temperature=None, top_k=None, seed: int = 0,
+               hold: bool = False) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        req = Request(request_id=next(self._ids), prompt=prompt,
+                      max_new_tokens=(max_new_tokens
+                                      if max_new_tokens is not None
+                                      else self.default_max_new),
+                      eos_id=eos_id, temperature=temperature,
+                      top_k=top_k, seed=seed, hold=hold)
+        self.queue.append(req)
+        self.report.record_submit(req.request_id)
+        return req
+
+    def step(self) -> dict:
+        if self.step_delay_s:
+            time.sleep(self.step_delay_s)
+        self.iteration += 1
+        admitted = 0
+        while self.queue and self.free_slots:
+            req = self.queue.popleft()
+            req.slot = self.free_slots.pop(0)
+            req.state = "running"
+            self.active[req.slot] = req
+            admitted += 1
+        emitted = 0
+        for slot, req in list(self.active.items()):
+            stream = expected_tokens(req.prompt, req.seed,
+                                     req.max_new_tokens)
+            tok = stream[len(req.tokens)]
+            req.tokens.append(tok)
+            self.report.record_token(req.request_id)
+            self.report.record_host_bytes(4)
+            emitted += 1
+            hit_eos = req.eos_id is not None and tok == req.eos_id
+            if hit_eos or len(req.tokens) >= req.max_new_tokens:
+                req.state = "done"
+                self.free_slots.append(slot)
+                del self.active[slot]
+                req.slot = None
+                self.report.record_retire(req.request_id)
+        self.report.record_step(len(self.queue),
+                                len(self.active) / self.n_slots)
+        return {"admitted": admitted, "emitted": emitted,
+                "active": len(self.active), "queued": len(self.queue)}
+
+    def idle(self) -> bool:
+        return not self.queue and not self.active and not self.prefilling
+
+    def abort_all(self, requeue: bool = False) -> List[Request]:
+        hit = []
+        for req in list(self.active.values()):
+            if requeue:
+                req.state = "queued"
+                req.tokens = []
+                self.free_slots.append(req.slot)
+                del self.active[req.slot]
+                req.slot = None
+                self.queue.appendleft(req)
+            else:
+                req.state = "aborted"
+                self.free_slots.append(req.slot)
+                del self.active[req.slot]
+                req.slot = None
+                self.report.record_retire(req.request_id, aborted=True)
+            hit.append(req)
+        if not requeue:
+            while self.queue:
+                req = self.queue.popleft()
+                req.state = "aborted"
+                self.report.record_retire(req.request_id, aborted=True)
+                hit.append(req)
+        return hit

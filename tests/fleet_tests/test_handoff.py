@@ -1,0 +1,202 @@
+"""KVHandoff codec: raw round-trips are bitwise, int8-block error is
+bounded by the per-block quantization step (the PR-8 codec contract
+applied to KV pages), wire bytes are exact, and every defect —
+truncation, corruption, unknown format, broken manifest — is REFUSED
+with HandoffError instead of poisoning a decode slot."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.collectives.quantized import (QUANT_BLOCK,
+                                                 block_quantize)
+from chainermn_tpu.fleet.handoff import (HandoffError, decode_handoff,
+                                         encode_handoff,
+                                         handoff_payload_bytes)
+from chainermn_tpu.models.transformer import TransformerLM
+from chainermn_tpu.serving.engine import Engine, EngineConfig
+
+VOCAB = 43
+PROMPT_LEN = 8
+
+
+def _model(**kw):
+    # d_head = 8, n_kv = 4: a full-prompt KV leaf is 8×4×8 = 256 f32 —
+    # exactly one quant block, so wire accounting is easy to eyeball
+    base = dict(vocab=VOCAB, d_model=32, n_heads=4, n_layers=1, d_ff=48,
+                max_len=64, attention="reference", pos_emb="rope")
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+@functools.lru_cache(maxsize=None)
+def _setup(seed=0):
+    model = _model()
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _cfg(**kw):
+    base = dict(n_slots=2, capacity=16, max_new_tokens=6,
+                prefill_cohort=1, buckets=[PROMPT_LEN, 16])
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@functools.lru_cache(maxsize=None)
+def _handoff(seed=0, temperature=None, top_k=None):
+    """Prefill one prompt to its first token and export the held slot."""
+    model, params = _setup()
+    eng = Engine(model, params, _cfg())
+    rng = np.random.RandomState(seed)
+    prompt = rng.randint(0, VOCAB, (PROMPT_LEN,)).astype(np.int32)
+    req = eng.submit(prompt, max_new_tokens=1, hold=True,
+                     temperature=temperature, top_k=top_k, seed=seed)
+    while not eng.held:
+        eng.step()  # dlint: disable=DL104
+    handoff = eng.export_handoff(req)
+    eng.release_held(req)
+    assert sorted(eng.free_slots) == [0, 1], "release must free the slot"
+    return handoff, prompt
+
+
+def test_raw_roundtrip_is_bitwise():
+    handoff, _prompt = _handoff()
+    manifest, blob = encode_handoff(handoff, "f32")
+    assert manifest["format"] == 1
+    assert handoff_payload_bytes(manifest) == len(blob)
+    out = decode_handoff(manifest, blob)
+    for blk, page in handoff["pages"].items():
+        for leaf in ("k", "v"):
+            np.testing.assert_array_equal(np.asarray(page[leaf]),
+                                          out["pages"][blk][leaf])
+    np.testing.assert_array_equal(np.asarray(handoff["key"]), out["key"])
+    for key in ("cursor", "tokens", "prompt_len", "eos_id",
+                "temperature", "top_k", "seed"):
+        assert out[key] == handoff[key]
+
+
+def test_int8_block_error_bounded_by_quant_step():
+    """Per element: |kv - deq(q(kv))| <= scale/2 with the PER-BLOCK
+    scale — the exact bound tests/collectives_tests pins for the wire
+    codec, holding through the handoff container."""
+    handoff, _prompt = _handoff()
+    manifest, blob = encode_handoff(handoff, "int8-block")
+    assert manifest["format"] == 2
+    assert manifest["codec"]["wire_format"] == "int8-block"
+    out = decode_handoff(manifest, blob)
+    for blk, page in handoff["pages"].items():
+        for leaf in ("k", "v"):
+            v = np.asarray(page[leaf], np.float32).reshape(-1)
+            _q, s = block_quantize(jnp.asarray(v), "int8-block")
+            step = np.repeat(np.asarray(s), QUANT_BLOCK)[:v.size]
+            deq = np.asarray(out["pages"][blk][leaf],
+                             np.float32).reshape(-1)
+            assert (np.abs(deq - v) <= step / 2 + 1e-7).all()
+
+
+def test_int8_block_logit_error_calibrated():
+    """Decoding from an int8 handoff perturbs the next-step logits by
+    no more than a small multiple of the KV quantization step (the
+    handoff-level observable the wire-level bound buys)."""
+    model, params = _setup()
+    handoff, prompt = _handoff()
+    max_step = 0.0
+    for page in handoff["pages"].values():
+        for leaf in ("k", "v"):
+            v = np.asarray(page[leaf], np.float32).reshape(-1)
+            _q, s = block_quantize(jnp.asarray(v), "int8-block")
+            max_step = max(max_step, float(np.asarray(s).max()) / 2)
+    logits = {}
+    for wf in ("f32", "int8-block"):
+        manifest, blob = encode_handoff(handoff, wf)
+        eng = Engine(model, params, _cfg())
+        req = eng.import_handoff(decode_handoff(manifest, blob), prompt)
+        eng.step()  # dlint: disable=DL104
+        logits[wf] = eng.last_logits[req.slot].copy()
+    dlogit = np.abs(logits["int8-block"] - logits["f32"]).max()
+    assert 0 < dlogit <= 10 * max_step, (dlogit, max_step)
+
+
+def test_wire_bytes_exact_and_quantized_ratio():
+    """manifest["bytes"] is the exact blob length; with one-block
+    leaves the int8-block wire is (256 + 4)/1024 of raw + the shared
+    key tail — comfortably under the 0.27 bench gate."""
+    handoff, _prompt = _handoff()
+    m_raw, b_raw = encode_handoff(handoff, "f32")
+    m_q, b_q = encode_handoff(handoff, "int8-block")
+    key_bytes = np.asarray(handoff["key"]).nbytes
+    page_bytes = sum(np.asarray(p[leaf]).nbytes
+                     for p in handoff["pages"].values()
+                     for leaf in ("k", "v"))
+    assert handoff_payload_bytes(m_raw) == len(b_raw)
+    assert len(b_raw) == page_bytes + key_bytes
+    assert handoff_payload_bytes(m_q) == len(b_q)
+    assert len(b_q) - key_bytes <= 0.27 * page_bytes
+
+
+def test_unknown_wire_format_rejected_at_encode():
+    handoff, _prompt = _handoff()
+    with pytest.raises(ValueError, match="wire_format"):
+        encode_handoff(handoff, "fp8-exotic")
+
+
+def test_truncated_blob_refused():
+    handoff, _prompt = _handoff()
+    manifest, blob = encode_handoff(handoff, "f32")
+    with pytest.raises(HandoffError, match="truncated"):
+        decode_handoff(manifest, blob[:len(blob) - 16])
+
+
+def test_corrupted_blob_refused():
+    handoff, _prompt = _handoff()
+    manifest, blob = encode_handoff(handoff, "f32")
+    torn = bytearray(blob)
+    torn[100] ^= 0x40
+    with pytest.raises(HandoffError, match="sha256"):
+        decode_handoff(manifest, bytes(torn))
+
+
+def test_unknown_manifest_format_refused():
+    handoff, _prompt = _handoff()
+    manifest, blob = encode_handoff(handoff, "f32")
+    manifest = dict(manifest, format=99)
+    with pytest.raises(HandoffError, match="format"):
+        decode_handoff(manifest, blob)
+
+
+def test_structurally_broken_manifest_refused():
+    """A manifest missing its arrays table (or any required key) is a
+    HandoffError too — the caller's fallback contract covers EVERY
+    defect, not just checksum failures."""
+    handoff, _prompt = _handoff()
+    manifest, blob = encode_handoff(handoff, "f32")
+    for broken in (
+            {k: v for k, v in manifest.items() if k != "arrays"},
+            {k: v for k, v in manifest.items() if k != "meta"},
+            {k: v for k, v in manifest.items() if k != "sha256"},
+    ):
+        with pytest.raises(HandoffError):
+            decode_handoff(broken, blob)
+
+
+def test_sampled_handoff_preserves_key_and_knobs():
+    """A temperature/top_k handoff carries the CONTINUED PRNG key (one
+    split already consumed for the prefill token) and the sampling
+    knobs verbatim — the decode side must resume the stream, not
+    restart it."""
+    from chainermn_tpu.serving.sampling import request_key
+
+    handoff, _prompt = _handoff(seed=3, temperature=0.8, top_k=5)
+    manifest, blob = encode_handoff(handoff, "f32")
+    out = decode_handoff(manifest, blob)
+    assert out["temperature"] == 0.8 and out["top_k"] == 5
+    assert out["seed"] == 3
+    # the key must NOT be the fresh request key — a split was consumed
+    fresh = np.asarray(request_key(3))
+    assert not np.array_equal(out["key"], fresh)

@@ -98,6 +98,29 @@ def test_bad_request_fails_future_not_thread():
         assert req.state == "done"
 
 
+def test_admission_rejected_at_queue_depth_bound():
+    """Backpressure at the door: with the engine's backlog at
+    ``max_queue_depth`` a submission is shed immediately with the
+    RpcPolicy backoff base as its retry-after hint — the same contract
+    the fleet Router speaks, one replica wide."""
+    from chainermn_tpu.serving.frontend import AdmissionRejected
+
+    _, _, eng = _engine()
+    pol = RpcPolicy(timeout_ms=60_000, probe_ms=50, backoff_base_ms=40)
+    with Frontend(eng, rpc_policy=pol, max_queue_depth=0) as fe:
+        with pytest.raises(AdmissionRejected) as ei:
+            fe.submit(np.ones((4,), np.int32))
+        assert ei.value.retry_after_ms == 40
+
+
+def test_queue_depth_bound_admits_after_drain():
+    _, _, eng = _engine(max_new_tokens=2)
+    with Frontend(eng, rpc_policy=_POL, max_queue_depth=8) as fe:
+        futs = [fe.submit(np.ones((4,), np.int32)) for _ in range(4)]
+        for f in futs:
+            assert fe.result(f, timeout_ms=60_000).state == "done"
+
+
 class _TrippableWatchdog:
     def __init__(self):
         self.tripped = threading.Event()

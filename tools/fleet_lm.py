@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""fleet_lm — a serving FLEET in one process: N engine replicas behind
+the router, or a disaggregated prefill/decode pair.
+
+Builds one seeded TransformerLM, shares its weights across every
+replica (warm-loading from a published snapshot when one exists, like
+serve_lm.py), queues a deterministic batch of prompts, and drains:
+
+* default — ``fleet.Router`` over ``--replicas`` engines, each in its
+  own worker thread: load-aware + session-affine placement, queue-depth
+  backpressure, and heartbeat-driven replica health. With
+  ``$CHAINERMN_TPU_CHAOS='kill_replica@step=N,replica=R'`` the targeted
+  worker dies mid-stream and the router re-queues its slots onto
+  survivors — the drill asserts every stream still completes with zero
+  dropped or duplicated tokens (seeded replay, serving/sampling.py).
+* ``--disaggregate`` — ``fleet.DisaggregatedFleet``: prefill engine →
+  KVHandoff wire (``--wire-format`` f32 | int8-block) → decode engine,
+  exposed to ``corrupt_handoff`` faults (fallback = clean re-prefill).
+
+Completed streams append to ``--out`` idempotently (request ids already
+on disk are skipped), so a supervised restart heals to the same final
+JSONL the unkilled run would have produced — per-request seeds are
+``--seed + request_id``, making sampled streams as replayable as greedy
+ones. Exit status follows the supervisor contract: 0 clean, 75 on a
+watchdog abort, anything else is a crash.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def _log(msg):
+    print(f"fleet_lm: {msg}", file=sys.stderr, flush=True)
+
+
+def _done_ids(path):
+    """Request ids already drained to the JSONL (prior incarnations)."""
+    done = set()
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    done.add(json.loads(line)["request_id"])
+    return done
+
+
+def _emit(out, i, prompt, tokens):
+    out.write(json.dumps({"request_id": i, "prompt": prompt.tolist(),
+                          "tokens": list(tokens)}) + "\n")
+    out.flush()
+    os.fsync(out.fileno())
+
+
+def serve(args):
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu.fleet import DisaggregatedFleet, FleetReport, Router
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving import (Engine, EngineConfig,
+                                       load_weights, publish_weights)
+    from chainermn_tpu.serving.weights import WeightsError
+
+    model = TransformerLM(vocab=args.vocab, d_model=args.d_model,
+                          n_heads=args.n_heads, n_layers=args.n_layers,
+                          d_ff=2 * args.d_model, max_len=args.capacity,
+                          attention="reference", pos_emb="rope")
+    init = model.init(jax.random.PRNGKey(args.seed),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+    if args.weights:
+        try:
+            params, src = load_weights(args.weights, like=init)
+            _log(f"warm weights loaded from {src}")
+        except WeightsError:
+            params = init
+            publish_weights(params, args.weights)
+            _log(f"cold boot: published weights to {args.weights}")
+    else:
+        params = init
+
+    def engine():
+        # decode_k=1 so kill_replica@step=N counts one token per
+        # working iteration — the drill timing contract (serve_lm.py)
+        return Engine(model, params,
+                      EngineConfig(n_slots=args.slots,
+                                   capacity=args.capacity,
+                                   max_new_tokens=args.max_new_tokens,
+                                   prefill_cohort=1,
+                                   buckets=[args.prompt_len,
+                                            args.capacity],
+                                   decode_k=args.decode_k,
+                                   prefill_chunk=args.prefill_chunk))
+
+    done = _done_ids(args.out)
+    rng = np.random.RandomState(args.seed)
+    prompts = {}
+    for i in range(args.requests):
+        prompt = rng.randint(0, args.vocab,
+                             (args.prompt_len,)).astype(np.int32)
+        if i not in done:
+            prompts[i] = prompt
+    _log(f"queued {len(prompts)} of {args.requests} requests "
+         f"({len(done)} already drained)")
+
+    report = FleetReport()
+    kw = dict(max_new_tokens=args.max_new_tokens,
+              temperature=args.temperature, top_k=args.top_k)
+
+    if args.disaggregate:
+        fleet = DisaggregatedFleet(engine(), engine(),
+                                   wire_format=args.wire_format,
+                                   report=report)
+        streams = {i: fleet.submit(p, seed=args.seed + i, **kw)
+                   for i, p in emit_order(prompts)}
+        with open(args.out, "a") as out:
+            emitted = set()
+            while not fleet.idle():
+                # each engine step syncs internally (int32 token pulls)
+                fleet.step()  # dlint: disable=DL104
+                for i, s in streams.items():
+                    if s.finished and i not in emitted:
+                        emitted.add(i)
+                        _emit(out, i, prompts[i], s.tokens)
+        summary = fleet.summary()
+    else:
+        with Router([engine() for _ in range(args.replicas)],
+                    max_queue_depth=args.max_queue_depth,
+                    report=report) as router:
+            futs = {i: router.submit(p, seed=args.seed + i, **kw)
+                    for i, p in emit_order(prompts)}
+            with open(args.out, "a") as out:
+                for i, fut in futs.items():
+                    req = router.result(fut)
+                    _emit(out, i, prompts[i], req.tokens)
+            summary = router.summary()
+
+    _log(f"drained; fleet report: {json.dumps(summary, sort_keys=True)}")
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(json.dumps(summary, sort_keys=True))
+    return None
+
+
+def emit_order(prompts):
+    return sorted(prompts.items())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="fleet_lm", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", required=True,
+                    help="JSONL of completed streams (append, idempotent)")
+    ap.add_argument("--weights", default=None,
+                    help="published-weights path: warm-load when present, "
+                         "publish on cold boot")
+    ap.add_argument("--report", default=None,
+                    help="write the merged FleetReport JSON here on drain")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="engine replicas behind the router")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="prefill/decode pools + KVHandoff instead of "
+                         "the replicated router")
+    ap.add_argument("--wire-format", default="f32",
+                    choices=["f32", "int8-block"],
+                    help="KVHandoff wire format (disaggregated mode)")
+    ap.add_argument("--max-queue-depth", type=int, default=None,
+                    help="per-replica admission bound (router mode)")
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--prompt-len", type=int, default=4)
+    ap.add_argument("--max-new-tokens", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--capacity", type=int, default=32)
+    ap.add_argument("--decode-k", type=int, default=1,
+                    help="tokens committed per decode dispatch")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked prefill width (default: monolithic)")
+    ap.add_argument("--temperature", type=float, default=None,
+                    help="sampling temperature (default: greedy argmax)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="top-k truncation for sampled decode")
+    ap.add_argument("--vocab", type=int, default=43)
+    ap.add_argument("--d-model", type=int, default=32)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from chainermn_tpu.resilience.supervisor import main_exit_code
+
+    return main_exit_code(lambda: serve(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
