@@ -318,6 +318,38 @@ def main():
     except Exception as e:  # never sink the headline metric
         record["tuning_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # synthesized-program gate (docs/tuning.md#from-knobs-to-programs),
+    # folded into the same JSON line. On a factored two-tier view of
+    # this machine the search space includes whole synthesized programs
+    # (chainermn_tpu/synthesis/); the gate asserts the best program's
+    # DL201 overlap fraction is >= the best FIXED reducer's on the same
+    # canned fixtures — the widened space must never lose to its own
+    # subset, and on the scatter-led fixtures it strictly wins. Scoring
+    # is canned + cost-model (no devices), so the gate is NOT TPU-gated.
+    try:
+        from chainermn_tpu.tuning import tune_canned, two_tier
+
+        sg_bytes = record.get("tuning_grad_bytes", 51 << 20)
+        intra = max(1, n_dev // 2)
+        synth_res = tune_canned(two_tier(intra, n_dev // intra), sg_bytes)
+        synth_rows = [r for r in synth_res.rows
+                      if r["candidate"]["strategy"] == "synth"]
+        fixed_rows = [r for r in synth_res.rows
+                      if r["candidate"]["strategy"] != "synth"]
+        best_synth = max(r["overlap_fraction"] for r in synth_rows)
+        best_fixed = max(r["overlap_fraction"] for r in fixed_rows)
+        record["synth_n_programs"] = len(
+            {r["candidate"]["program"]["name"] for r in synth_rows})
+        record["synth_best_overlap_frac"] = best_synth
+        record["synth_best_fixed_overlap_frac"] = best_fixed
+        record["synth_winner"] = synth_res.plan.strategy
+        if synth_res.plan.program is not None:
+            record["synth_winner_program"] = synth_res.plan.program["name"]
+        record["synth_gate_ok"] = bool(synth_rows
+                                       and best_synth >= best_fixed)
+    except Exception as e:  # never sink the headline metric
+        record["synth_gate_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # serving decode proof (docs/serving.md), folded into the same JSON
     # line: the paged-KV cached decode compiles ONE program where the
     # naive full-recompute loop compiles one PER TOKEN, with identical

@@ -1251,3 +1251,92 @@ def check_blocking_rpc_in_router_loop(tree, src, path) -> List[Finding]:
 
 register(Rule("DL111", "blocking-rpc-in-router-loop", f"{_DOC}#dl111",
               check_blocking_rpc_in_router_loop))
+
+
+# ---------------------------------------------------------------------------
+# DL112 — asymmetric-tier-collective
+# ---------------------------------------------------------------------------
+
+#: jax.lax-style collectives whose second argument / ``axis_name=``
+#: kwarg names the mesh axis the traffic moves over
+_AXIS_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pbroadcast",
+}
+
+
+def _declared_tier_names(tree: ast.AST) -> Set[str]:
+    """Names of every ``Tier("<name>", ...)`` declared in the module
+    (string-constant first argument or ``name=`` kwarg only — a
+    variable tier name can't be checked statically)."""
+    out: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) and _callee_name(n) == "Tier":
+            name = _literal(_arg_or_kw(n, 0, "name"))
+            if isinstance(name, str):
+                out.add(name)
+    return out
+
+
+def _axis_name_constants(node: Optional[ast.expr]) -> List[str]:
+    """String-constant axis names in an axis_name argument: a bare
+    string, or every string element of a tuple/list of them."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def check_asymmetric_tier_collective(tree, src, path) -> List[Finding]:
+    """Collective over an axis the module's declared tiers don't name.
+
+    The synthesis/tuning discipline (docs/tuning.md): a module that
+    describes its machine as explicit ``Tier(...)`` levels has promised
+    that ALL collective traffic moves over those tiers — that promise
+    is what makes the per-tier cost model and the synthesized-program
+    wire accounting (``program_wire_bytes``) truthful. A hard-coded
+    ``lax.psum(x, 'dcn2')`` next to ``Tier('ici', ...)``/
+    ``Tier('dcn', ...)`` declarations moves bytes over an axis the
+    topology doesn't know: the tuner prices it at zero, the wire
+    report under-counts, and a program validated against the declared
+    tiers runs asymmetric traffic beside it. Flagged shape: a
+    string-constant axis name (or tuple element) passed to a lax-style
+    collective, in a module that declares at least one
+    ``Tier("<name>", ...)``, where the axis is not a declared tier
+    name.
+
+    NOT flagged: modules with no ``Tier`` declarations (nothing is
+    promised), non-constant axis names (the tier map resolving names
+    at run time is the fixed pattern — synthesis/compiler.py routes
+    every step through its ``_TierMap``), and axis names that match a
+    declared tier.
+    """
+    tiers = _declared_tier_names(tree)
+    if not tiers:
+        return []
+    findings: List[Finding] = []
+    for n in ast.walk(tree):
+        if (not isinstance(n, ast.Call)
+                or _callee_name(n) not in _AXIS_COLLECTIVES):
+            continue
+        for axis in _axis_name_constants(_arg_or_kw(n, 1, "axis_name")):
+            if axis in tiers:
+                continue
+            findings.append(Finding(
+                "DL112", path, n.lineno,
+                f"'{_callee_name(n)}' moves traffic over axis "
+                f"{axis!r} but this module declares tiers "
+                f"{sorted(tiers)} — collectives outside the declared "
+                "topology escape the per-tier cost model and the "
+                "synthesized-program wire accounting. Name the axis "
+                "as a Tier, or resolve axes through the tier map at "
+                "run time like synthesis/compiler.py "
+                f"({_DOC}#dl112)."))
+    return findings
+
+
+register(Rule("DL112", "asymmetric-tier-collective", f"{_DOC}#dl112",
+              check_asymmetric_tier_collective))

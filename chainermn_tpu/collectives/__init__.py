@@ -8,10 +8,12 @@ Public surface::
                                       grad_reducer=reducer)   # or the name
 
 Strategies: ``flat`` (the numerical reference), ``hierarchical``,
-``quantized`` (error feedback), ``auto`` (cost model). The
-``wire_format=`` knob (``'f32' | 'bf16' | 'int8' | 'int8-block' |
-'int4-block'``) selects what the compressing strategies put on the
-wire — see docs/collectives.md#quantized-wire-formats.
+``quantized`` (error feedback), ``auto`` (cost model), ``synth``
+(a synthesized per-tier program from :mod:`chainermn_tpu.synthesis` —
+needs ``program=``). The ``wire_format=`` knob (``'f32' | 'bf16' |
+'int8' | 'int8-block' | 'int4-block'``) selects what the compressing
+strategies put on the wire — see
+docs/collectives.md#quantized-wire-formats.
 """
 
 from chainermn_tpu.collectives.auto import (  # noqa: F401
@@ -42,6 +44,11 @@ from chainermn_tpu.collectives.quantized import (  # noqa: F401
     unpack_int4,
     wire_ratio,
 )
+# last: registers the 'synth' strategy (imports collectives.base, so it
+# must come after the base import above)
+from chainermn_tpu.synthesis.compiler import (  # noqa: F401  # isort: skip
+    SynthesizedReducer,
+)
 
 __all__ = [
     "GradReducer",
@@ -64,4 +71,5 @@ __all__ = [
     "AutoReducer",
     "CostModel",
     "measure_strategies",
+    "SynthesizedReducer",
 ]

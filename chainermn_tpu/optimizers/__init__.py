@@ -84,6 +84,7 @@ def create_multi_node_optimizer(
     tune: Any = None,
     model_key: Optional[str] = None,
     wire_format: Optional[str] = None,
+    topology: Any = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer with the gradient all-reduce.
 
@@ -130,6 +131,15 @@ def create_multi_node_optimizer(
     tuned plan's recorded format. ``'f32'``/``None`` keep the strategy's
     own default. Refused (ValueError) when the resolved strategy cannot
     compress — same rule as ``make_grad_reducer``.
+
+    ``topology`` supplies the explicit
+    :class:`~chainermn_tpu.tuning.topology.Topology` the ``tune`` plan
+    was produced for, instead of the ``Topology.from_comm`` inference.
+    Required when the plan was tuned for a tier decomposition the mesh
+    does not expose (e.g. a factored ``(inter, intra)`` view of a
+    single-axis mesh — synthesized programs carry their ``tier_sizes``
+    and are rebuilt against this decomposition). Its total rank count
+    must match the communicator.
     """
     from chainermn_tpu.collectives import make_grad_reducer
 
@@ -137,7 +147,14 @@ def create_multi_node_optimizer(
     if tune is not None:
         from chainermn_tpu.tuning import ProfileDB, SchedulePlan, Topology
 
-        topo = Topology.from_comm(communicator)
+        if topology is not None:
+            if topology.n != communicator.size:
+                raise ValueError(
+                    f"explicit topology has {topology.n} ranks but the "
+                    f"communicator has {communicator.size}")
+            topo = topology
+        else:
+            topo = Topology.from_comm(communicator)
         if isinstance(tune, SchedulePlan):
             plan = tune
         else:
@@ -159,11 +176,14 @@ def create_multi_node_optimizer(
                 "tools/schedtune.py here")
         if grad_reducer is None:
             wf = wire_format or getattr(plan, "wire_format", None)
+            extra = {}
+            if getattr(plan, "program", None) is not None:
+                extra["program"] = plan.program  # 'synth' plans only
             grad_reducer = make_grad_reducer(
                 plan.strategy, communicator, op=op,
                 bucket_bytes=plan.bucket_bytes,
                 bucket_order=plan.bucket_order,
-                wire_format=wf)
+                wire_format=wf, **extra)
         double_buffering = bool(double_buffering or plan.double_buffering)
 
     if isinstance(grad_reducer, str):

@@ -1,0 +1,40 @@
+"""synthesis — collective-algorithm synthesis from communication
+sketches over the multi-tier Topology.
+
+schedtune (chainermn_tpu/tuning/) tunes KNOBS over three fixed
+reducers; this package widens the search space to PROGRAMS (the
+ROADMAP's TACCL/GC3 item): a sketch IR of per-tier primitive steps
+(:mod:`.sketch`), a validity checker, a deterministic enumerator, an
+alpha-beta cost walker with exact per-tier wire accounting, and a
+compiler (:mod:`.compiler`) lowering validated programs to the
+shard_map :class:`SynthesizedReducer` — registered as strategy
+``'synth'``, scored by the tuner alongside the fixed reducers, and
+persisted/consumed through the same profile DB →
+``create_multi_node_optimizer(tune=...)`` path. One CLI:
+``tools/synth.py``. See docs/tuning.md#from-knobs-to-programs and
+docs/collectives.md#synthesized-programs.
+"""
+
+from chainermn_tpu.synthesis.compiler import SynthesizedReducer  # noqa: F401
+from chainermn_tpu.synthesis.sketch import (  # noqa: F401
+    QUANT_WIRES,
+    STEP_OPS,
+    Program,
+    Step,
+    check_program,
+    enumerate_programs,
+    program_cost_us,
+    program_wire_bytes,
+)
+
+__all__ = [
+    "Step",
+    "Program",
+    "STEP_OPS",
+    "QUANT_WIRES",
+    "check_program",
+    "enumerate_programs",
+    "program_cost_us",
+    "program_wire_bytes",
+    "SynthesizedReducer",
+]

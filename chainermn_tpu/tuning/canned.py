@@ -42,10 +42,18 @@ DEFAULT_N_BACKWARD = 64
 
 def canned_schedule_hlo(n_buckets: int, bucket_order: str = "emission",
                         double_buffering: bool = False,
-                        n_backward: int = DEFAULT_N_BACKWARD) -> str:
+                        n_backward: int = DEFAULT_N_BACKWARD,
+                        staged: bool = False) -> str:
     """Scheduled-HLO text for ``n_buckets`` gradient all-reduces
     interleaved with ``n_backward`` backward fusions (see module doc
-    for the placement model)."""
+    for the placement model).
+
+    ``staged`` models a reduce-scatter-first program (synthesized
+    schedules with ``has_scatter``): the first wire step moves only a
+    ``1/k``-rank shard instead of the whole bucket, so the scheduler
+    can issue it one backward fusion earlier than a monolithic
+    all-reduce — the latency floor drops from 3 to 2 emission-order
+    ops (2 to 1 size-order)."""
     if n_buckets < 1:
         raise ValueError(f"n_buckets must be >= 1, got {n_buckets}")
     if bucket_order not in ("emission", "size"):
@@ -54,9 +62,10 @@ def canned_schedule_hlo(n_buckets: int, bucket_order: str = "emission",
     if double_buffering:
         ar_after = [0] * k  # prev-step grads: all issue before backward
     else:
-        first = max(math.ceil(b / k), 3)
+        floor = 2 if staged else 3
+        first = max(math.ceil(b / k), floor)
         if bucket_order == "size":
-            first = max(first - 1, 2)
+            first = max(first - 1, floor - 1)
         span = max(b - first, 0)
         ar_after = [min(first + (j * span) // k, b) for j in range(k)]
 
@@ -108,8 +117,11 @@ def canned_compile_fn(total_bytes: int,
 
     def compile_fn(candidate) -> str:
         k = max(1, math.ceil(total_bytes / candidate.bucket_bytes))
+        program = getattr(candidate, "program", None)
+        staged = bool(program is not None
+                      and getattr(program, "has_scatter", False))
         return canned_schedule_hlo(k, candidate.bucket_order,
                                    candidate.double_buffering,
-                                   n_backward)
+                                   n_backward, staged=staged)
 
     return compile_fn

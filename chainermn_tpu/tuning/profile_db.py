@@ -84,6 +84,11 @@ class SchedulePlan:
     #: 'measured' (on-TPU sweep contributed to the cost side)
     source: str = "canned"
     buckets: Tuple[Tuple[str, int], ...] = ()
+    #: for strategy 'synth': the winning Program as its to_dict() form
+    #: ({"name", "tier_sizes", "steps"}) so the plan round-trips through
+    #: JSON and ``create_multi_node_optimizer`` can rebuild the exact
+    #: reducer; None for the fixed strategies (and in older DB records)
+    program: Optional[dict] = None
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)

@@ -166,26 +166,39 @@ class Topology:
         """Modeled time for ONE reduction of ``nbytes`` payload.
 
         ``flat``: one allreduce whose ring crosses the slowest tier.
-        ``hierarchical``: reduce-scatter + all-gather on the innermost
-        tier, then an allreduce per outer tier carrying ``1/intra`` of
-        the bytes. ``quantized``: flat at ``wire_format``'s wire width
+        ``hierarchical``: the canonical cascade — reduce-scatter +
+        all-gather bracketing on every tier but the last, an allreduce
+        on the last, each stage carrying ``1/prod(inner sizes)`` of the
+        bytes. ``quantized``: flat at ``wire_format``'s wire width
         (:data:`WIRE_RATIO` — beta scales with the actual bytes on the
         wire, so the narrower formats genuinely price cheaper) plus the
         (de)quantize kernel overhead. For a two-tier topology these are
-        exactly the ``collectives.auto.CostModel`` formulas.
+        exactly the ``collectives.auto.CostModel`` formulas; for one
+        tier, ``hierarchical`` degenerates to rs + ag on that tier (two
+        launches, same formulas). Beyond two tiers the payload keeps
+        shrinking at every scatter stage — pricing every outer tier at
+        ``nbytes/intra`` (the old behavior) over-charged the slowest
+        tier by the product of the middle tier sizes, making 3-tier
+        synthesized programs (synthesis/) compare unfairly.
         """
         slow = self.tiers[-1]
         if strategy == "flat":
             return slow.latency_us + _xfer_us(
                 _ring_bytes(nbytes, self.n), slow.bw_gbps)
         if strategy == "hierarchical":
-            t0 = self.tiers[0]
-            t = 2 * t0.latency_us + _xfer_us(
-                _ring_bytes(nbytes, t0.size), t0.bw_gbps)  # rs + ag
-            carried = nbytes / t0.size
-            for tier in self.tiers[1:]:
-                t += tier.latency_us + _xfer_us(
+            if len(self.tiers) == 1:
+                t0 = self.tiers[0]
+                return 2 * t0.latency_us + _xfer_us(
+                    _ring_bytes(nbytes, t0.size), t0.bw_gbps)  # rs + ag
+            t, carried = 0.0, float(nbytes)
+            for tier in self.tiers[:-1]:
+                # rs + ag bracket the outer stages: two launches, and
+                # _ring_bytes' 2x factor covers both directions' bytes
+                t += 2 * tier.latency_us + _xfer_us(
                     _ring_bytes(carried, tier.size), tier.bw_gbps)
+                carried /= tier.size
+            t += slow.latency_us + _xfer_us(
+                _ring_bytes(carried, slow.size), slow.bw_gbps)
             return t
         if strategy == "quantized":
             try:

@@ -29,10 +29,15 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from chainermn_tpu.tuning.profile_db import SchedulePlan
 from chainermn_tpu.tuning.topology import Topology
+
+# NOTE: chainermn_tpu.synthesis is imported lazily inside the functions
+# that need it — importing it at module level closes an import cycle
+# (synthesis/__init__ pulls the compiler, which pulls collectives,
+# which registers the 'synth' strategy back through synthesis).
 
 #: default bucket_bytes sweep (1/4/16/64 MiB — brackets the 4 MiB
 #: DEFAULT_DCN_BUCKET_BYTES from both sides, plus the one-bucket regime)
@@ -52,15 +57,21 @@ QUANT_WIRE_SWEEP = ("bf16", "int8-block", "int4-block")
 
 @dataclasses.dataclass(frozen=True)
 class Candidate:
-    """One point in the knob space."""
+    """One point in the search space — a knob setting, or (strategy
+    ``'synth'``) a whole synthesized program."""
 
     strategy: str = "flat"
     bucket_bytes: int = DEFAULT_BUCKET_BYTES
     bucket_order: str = "emission"
     double_buffering: bool = False
     #: quantized-wire format; 'f32' (the non-compressing strategies'
-    #: only wire) is priced as bf16 when the strategy is 'quantized'
+    #: only wire) is priced as bf16 when the strategy is 'quantized'.
+    #: For 'synth' this mirrors the program's own wire (informational)
     wire_format: str = "f32"
+    #: a :class:`chainermn_tpu.synthesis.Program` when strategy is
+    #: 'synth' (frozen, so the candidate stays hashable); None for the
+    #: fixed-reducer strategies
+    program: Any = None
 
 
 def default_flat_candidate() -> Candidate:
@@ -94,6 +105,15 @@ def default_candidates(topology: Topology,
                     if allow_stale:
                         out.append(Candidate(strategy, int(bb), order,
                                              True, wf))
+    if len(topology.tiers) > 1:
+        # program candidates: every enumerator emission, swept over the
+        # same buckets/orders (lazy import — see the module-level note)
+        from chainermn_tpu.synthesis.sketch import enumerate_programs
+        for prog in enumerate_programs(topology, lossy=lossy):
+            for bb in bucket_sweep:
+                for order in ("emission", "size"):
+                    out.append(Candidate("synth", int(bb), order, False,
+                                         prog.wire_format, prog))
     return out
 
 
@@ -117,6 +137,9 @@ def estimate_comm_us(topology: Topology, candidate: Candidate,
                    in measured.items() if s == strategy]
             if pts:
                 return min(pts)[1]
+        if strategy == "synth":
+            from chainermn_tpu.synthesis.sketch import program_cost_us
+            return program_cost_us(candidate.program, topology, nbytes)
         if strategy == "quantized":
             wf = (candidate.wire_format
                   if candidate.wire_format != "f32" else "bf16")
@@ -140,6 +163,8 @@ def bucket_algorithms(topology: Topology, candidate: Candidate,
     out = []
     for nbytes in _bucket_payloads(total_bytes, candidate.bucket_bytes):
         algo = candidate.strategy
+        if algo == "synth" and candidate.program is not None:
+            algo = "synth:" + (candidate.program.name or "unnamed")
         if algo == "auto":
             flat = estimate_comm_us(
                 topology, Candidate("flat", nbytes), nbytes, measured)
@@ -243,6 +268,8 @@ def tune(topology: Topology, total_bytes: int,
         est_exposed_us=round(best_row["score"], 3),
         source=source,
         buckets=bucket_algorithms(topology, best, total_bytes, measured),
+        program=(best.program.to_dict()
+                 if best.program is not None else None),
     )
     return TuningResult(plan=plan, rows=rows, default=default_row)
 

@@ -23,8 +23,8 @@ def _only(findings, rule):
 
 def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
-            "DL107", "DL108", "DL109", "DL110", "DL111", "DL201",
-            "DL202", "DL203", "DL204"} <= set(RULES)
+            "DL107", "DL108", "DL109", "DL110", "DL111", "DL112",
+            "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
         assert rule.kind in ("ast", "hlo")
@@ -996,3 +996,96 @@ def test_dl111_suppression_with_rationale():
                 return
     """
     assert _only(_lint(src), "DL111") == []
+
+
+# ---------------------------------------------------------------------------
+# DL112 — asymmetric-tier-collective
+# ---------------------------------------------------------------------------
+
+
+def test_dl112_flags_collective_over_undeclared_axis():
+    src = """\
+    from chainermn_tpu.tuning.topology import Tier, Topology
+
+    TOPO = Topology((Tier("ici", 4, 1.0, 100.0),
+                     Tier("dcn", 2, 100.0, 25.0)))
+
+    def reduce_block(v):
+        import jax
+        v = jax.lax.psum(v, "ici")
+        return jax.lax.psum(v, "dcn2")
+    """
+    fs = _only(_lint(src), "DL112")
+    assert len(fs) == 1
+    assert fs[0].line == 9
+    assert "'dcn2'" in fs[0].message
+    assert "docs/static_analysis.md#dl112" in fs[0].message
+
+
+def test_dl112_flags_undeclared_axis_in_tuple_and_kwarg():
+    src = """\
+    from chainermn_tpu.tuning.topology import Tier
+
+    TIERS = (Tier("ici", 8, 1.0, 100.0),)
+
+    def gather(v):
+        import jax
+        v = jax.lax.all_gather(v, axis_name="mdl")
+        return jax.lax.psum(v, ("ici", "pp"))
+    """
+    fs = _only(_lint(src), "DL112")
+    assert [f.line for f in fs] == [7, 8]
+    assert "'mdl'" in fs[0].message
+    assert "'pp'" in fs[1].message
+
+
+def test_dl112_clean_when_axes_match_declared_tiers():
+    src = """\
+    from chainermn_tpu.tuning.topology import Tier
+
+    TIERS = (Tier("ici", 4, 1.0, 100.0), Tier("dcn", 2, 100.0, 25.0))
+
+    def reduce_block(v):
+        import jax
+        v = jax.lax.psum_scatter(v, "ici", scatter_dimension=0)
+        v = jax.lax.psum(v, "dcn")
+        return jax.lax.all_gather(v, "ici")
+    """
+    assert _only(_lint(src), "DL112") == []
+
+
+def test_dl112_clean_without_tier_declarations():
+    src = """\
+    def reduce_block(v):
+        import jax
+        return jax.lax.psum(v, "whatever")
+    """
+    assert _only(_lint(src), "DL112") == []
+
+
+def test_dl112_clean_on_runtime_resolved_axis_names():
+    src = """\
+    from chainermn_tpu.tuning.topology import Tier
+
+    TIERS = (Tier("ici", 4, 1.0, 100.0),)
+
+    def reduce_block(v, tier_map, i):
+        import jax
+        axis = tier_map.axis_of[i]
+        return jax.lax.psum(v, axis)
+    """
+    assert _only(_lint(src), "DL112") == []
+
+
+def test_dl112_suppression_with_rationale():
+    src = """\
+    from chainermn_tpu.tuning.topology import Tier
+
+    TIERS = (Tier("ici", 4, 1.0, 100.0),)
+
+    def probe(v):
+        import jax
+        # fixture: debug probe over the replica axis, not wire traffic
+        return jax.lax.psum(v, "dbg")  # dlint: disable=DL112
+    """
+    assert _only(_lint(src), "DL112") == []

@@ -1,0 +1,218 @@
+"""Sketch-IR unit tests: validity rules, enumerator determinism,
+serialization, and the cost/wire accounting identities. Pure stdlib —
+no jax, no devices (the IR is deliberately leaf-level, like
+tuning/topology.py).
+"""
+
+import pytest
+
+from chainermn_tpu.synthesis import (
+    Program,
+    QUANT_WIRES,
+    Step,
+    check_program,
+    enumerate_programs,
+    program_cost_us,
+    program_wire_bytes,
+)
+from chainermn_tpu.tuning.topology import Tier, Topology, two_tier
+
+
+def three_tier(a=2, b=2, c=2):
+    return Topology((Tier("ici", a, 1.0, 100.0),
+                     Tier("nvl", b, 10.0, 50.0),
+                     Tier("dcn", c, 100.0, 25.0)))
+
+
+# ---------------------------------------------------------------------------
+# validity
+# ---------------------------------------------------------------------------
+
+def test_valid_cascade_passes():
+    p = Program((Step("reduce_scatter", 0), Step("all_reduce", 1),
+                 Step("all_gather", 0)), (4, 2))
+    assert check_program(p) == []
+
+
+def test_unknown_op_and_out_of_range_tier():
+    p = Program((Step("frobnicate", 0), Step("all_reduce", 5)), (4, 2))
+    errs = check_program(p)
+    assert any("unknown op" in e for e in errs)
+    assert any("out of range" in e for e in errs)
+    # tier 0/1 never reduced
+    assert any("tier 0 reduced 0 times" in e for e in errs)
+
+
+def test_tier_reduced_twice_is_invalid():
+    p = Program((Step("all_reduce", 0), Step("all_reduce", 0),
+                 Step("all_reduce", 1)), (4, 2))
+    assert any("tier 0 reduced 2 times" in e for e in check_program(p))
+
+
+def test_unclosed_scatter_is_invalid():
+    p = Program((Step("reduce_scatter", 0), Step("all_reduce", 1)),
+                (4, 2))
+    assert any("never gathered" in e for e in check_program(p))
+
+
+def test_non_lifo_gather_order_is_invalid():
+    p = Program((Step("reduce_scatter", 0), Step("reduce_scatter", 1),
+                 Step("all_gather", 0), Step("all_gather", 1)), (2, 2))
+    assert any("LIFO" in e for e in check_program(p))
+
+
+def test_gather_without_scatter_is_invalid():
+    p = Program((Step("all_reduce", 0), Step("all_reduce", 1),
+                 Step("all_gather", 0)), (4, 2))
+    assert any("no open reduce_scatter" in e for e in check_program(p))
+
+
+def test_quantize_region_rules():
+    # unclosed region
+    p = Program((Step("quantize", wire="int8-block"),
+                 Step("all_reduce", 0), Step("all_reduce", 1)), (4, 2))
+    assert any("never closed" in e for e in check_program(p))
+    # empty region
+    p = Program((Step("quantize", wire="int8-block"), Step("dequantize"),
+                 Step("all_reduce", 0), Step("all_reduce", 1)), (4, 2))
+    assert any("empty quantize region" in e for e in check_program(p))
+    # scatter inside a region
+    p = Program((Step("quantize", wire="int8-block"),
+                 Step("reduce_scatter", 0), Step("all_reduce", 1),
+                 Step("dequantize"), Step("all_gather", 0)), (4, 2))
+    assert any("only all_reduce" in e for e in check_program(p))
+    # unknown wire
+    p = Program((Step("quantize", wire="fp3"), Step("all_reduce", 0),
+                 Step("all_reduce", 1), Step("dequantize")), (4, 2))
+    assert any("unknown wire" in e for e in check_program(p))
+
+
+# ---------------------------------------------------------------------------
+# the enumerator
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("topo", [two_tier(4, 2), three_tier()])
+def test_every_enumerated_program_is_valid(topo):
+    for prog in enumerate_programs(topo, lossy=True):
+        assert check_program(prog) == [], prog.name
+
+
+def test_enumerator_is_deterministic():
+    a = enumerate_programs(two_tier(4, 2), lossy=True)
+    b = enumerate_programs(two_tier(4, 2), lossy=True)
+    assert a == b
+    assert [p.name for p in a] == [p.name for p in b]
+
+
+def test_enumerator_families_and_order():
+    names = [p.name for p in enumerate_programs(three_tier(), lossy=True)]
+    assert names[:4] == ["cascade-0", "cascade-1", "cascade-2",
+                         "scatter-through"]
+    assert "cascade-q@inter-int8-block" in names
+    assert "ladder-q@all-int4-block" in names
+    # lossless enumeration emits no wire steps
+    for p in enumerate_programs(three_tier()):
+        assert p.wire_format == "f32"
+
+
+def test_single_tier_enumeration_is_minimal():
+    from chainermn_tpu.tuning.topology import single_tier
+    progs = enumerate_programs(single_tier(8), lossy=True)
+    names = [p.name for p in progs]
+    assert "cascade-0" in names
+    assert "scatter-through" not in names  # duplicates cascade-0 at m=1
+    assert "cascade-q@inter-int8-block" not in names  # needs an inter
+
+
+def test_program_round_trips_through_dict():
+    for prog in enumerate_programs(three_tier(), lossy=True):
+        assert Program.from_dict(prog.to_dict()) == prog
+
+
+# ---------------------------------------------------------------------------
+# cost + wire accounting
+# ---------------------------------------------------------------------------
+
+def test_canonical_cascade_reproduces_hierarchical_estimate():
+    for topo in (two_tier(4, 2), three_tier()):
+        m = len(topo.tiers)
+        prog = enumerate_programs(topo)[m - 1]  # cascade-(m-1)
+        assert prog.name == f"cascade-{m - 1}"
+        for nbytes in (1 << 20, 4 << 20, 51 << 20):
+            assert program_cost_us(prog, topo, nbytes) == pytest.approx(
+                topo.estimate_us("hierarchical", nbytes), rel=1e-12)
+
+
+def test_cost_refuses_mismatched_tier_sizes():
+    prog = enumerate_programs(two_tier(4, 2))[0]
+    with pytest.raises(ValueError):
+        program_cost_us(prog, two_tier(2, 4), 1 << 20)
+
+
+def test_lossless_wire_bytes_are_ring_counts():
+    # cascade-1 on (4, 2), 4 MiB: rs+ag on ici move 2·b·3/4; the dcn
+    # allreduce moves 2·(b/4)·1/2 of the scattered chunk
+    b = 4 << 20
+    prog = next(p for p in enumerate_programs(two_tier(4, 2))
+                if p.name == "cascade-1")
+    per = program_wire_bytes(prog, b)
+    assert per[0] == pytest.approx(2 * b * 3 / 4)
+    assert per[1] == pytest.approx(2 * (b / 4) * (1 / 2))
+
+
+def test_quantized_placement_wire_bytes_exact():
+    """The tier-aware placement's whole point, in numbers: @inter keeps
+    the fast tier at raw f32 and shrinks only the slow tier; @all
+    shrinks both. Exact blockwise accounting: 1 B/elem codes (int8) or
+    2-per-byte nibbles (int4) + one 4 B scale per 256-element block."""
+    b = 4 << 20  # 1 Mi f32 elements, divides every tier size
+    progs = {p.name: p for p in
+             enumerate_programs(two_tier(4, 2), lossy=True)}
+
+    inter = program_wire_bytes(progs["cascade-q@inter-int8-block"], b)
+    assert inter[0] == pytest.approx(2 * b * 3 / 4)  # raw f32 rs+ag
+    # dcn: chunk b/4 = 262144 elems -> 1 B codes + 1024 blocks × 4 B,
+    # ring factor 2·(k-1)/k = 1 on the 2-ring
+    elems = b // 4 // 4
+    assert inter[1] == pytest.approx(elems + 4 * (elems // 256))
+
+    alln = program_wire_bytes(progs["ladder-q@all-int8-block"], b)
+    full = b // 4  # full bucket stays unscattered on the ladder
+    q_full = full + 4 * (full // 256)
+    assert alln[0] == pytest.approx(2 * q_full * 3 / 4)
+    assert alln[1] == pytest.approx(2 * q_full * 1 / 2)
+
+    # int4 halves the code bytes, same scale sidecar
+    i4 = program_wire_bytes(progs["cascade-q@inter-int4-block"], b)
+    assert i4[1] == pytest.approx(elems / 2 + 4 * (elems // 256))
+
+
+def test_inexact_wire_bytes_use_topology_ratio():
+    from chainermn_tpu.tuning.topology import WIRE_RATIO
+    b = 4 << 20
+    prog = next(p for p in enumerate_programs(two_tier(4, 2), lossy=True)
+                if p.name == "ladder-q@all-int8-block")
+    per = program_wire_bytes(prog, b, exact=False)
+    r = WIRE_RATIO["int8-block"]
+    assert per[0] == pytest.approx(2 * b * r * 3 / 4)
+    assert per[1] == pytest.approx(2 * b * r * 1 / 2)
+
+
+def test_wire_format_and_scatter_properties():
+    progs = {p.name: p for p in
+             enumerate_programs(two_tier(4, 2), lossy=True)}
+    assert progs["cascade-1"].wire_format == "f32"
+    assert progs["cascade-1"].has_scatter
+    assert not progs["cascade-0"].has_scatter
+    assert progs["ladder-q@all-int4-block"].wire_format == "int4-block"
+    assert not progs["ladder-q@all-int4-block"].has_scatter
+    for w in QUANT_WIRES:
+        assert w != "f32"
+
+
+def test_describe_is_readable():
+    prog = next(p for p in enumerate_programs(two_tier(4, 2), lossy=True)
+                if p.name == "cascade-q@inter-int8-block")
+    d = prog.describe()
+    assert d.startswith("cascade-q@inter-int8-block[4x2]:")
+    assert "rs(0)" in d and "q[int8-block]" in d and "dq" in d

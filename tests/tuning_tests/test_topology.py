@@ -129,6 +129,61 @@ def test_unknown_strategy_rejected():
         single_tier(8).estimate_us("psum_scatter", 1 << 20)
 
 
+# ---------------------------------------------------------------------------
+# beyond two tiers: the cascade's payload shrinks at every scatter
+# ---------------------------------------------------------------------------
+
+def _three_tier():
+    return Topology((Tier("ici", 4, 1.0, 100.0),
+                     Tier("nvl", 2, 10.0, 50.0),
+                     Tier("dcn", 2, 100.0, 25.0)))
+
+
+def test_three_tier_hierarchical_is_the_shrinking_cascade():
+    """Hand-computed alpha-beta: each outer stage carries 1/prod(inner
+    sizes) of the payload — rs+ag on ici over b, rs+ag on nvl over b/4,
+    allreduce on dcn over b/8."""
+    t = _three_tier()
+    b = 8 << 20
+
+    def ring_us(nbytes, k, bw):
+        return 2.0 * nbytes * (k - 1) / k / (bw * 1e3)
+
+    want = (2 * 1.0 + ring_us(b, 4, 100.0)
+            + 2 * 10.0 + ring_us(b / 4, 2, 50.0)
+            + 100.0 + ring_us(b / 8, 2, 25.0))
+    assert t.estimate_us("hierarchical", b) == pytest.approx(want,
+                                                             rel=1e-12)
+
+
+def test_three_tier_slow_tier_is_not_overcharged():
+    """The bug this pins: pricing every outer stage at nbytes/intra
+    (the old two-tier formula applied verbatim) over-charges the slow
+    tier by the middle tier's size, making 3-tier programs compare
+    unfairly against flat."""
+    t = _three_tier()
+    b = 8 << 20
+
+    def ring_us(nbytes, k, bw):
+        return 2.0 * nbytes * (k - 1) / k / (bw * 1e3)
+
+    old_overcharged = (2 * 1.0 + ring_us(b, 4, 100.0)
+                       + 2 * 10.0 + ring_us(b / 4, 2, 50.0)
+                       + 100.0 + ring_us(b / 4, 2, 25.0))  # b/4, not b/8
+    assert t.estimate_us("hierarchical", b) < old_overcharged
+    # and across two slow tiers the cascade still beats the flat ring
+    assert (t.estimate_us("hierarchical", b)
+            < t.estimate_us("flat", b))
+
+
+def test_three_tier_flat_crosses_the_slowest_tier():
+    t = _three_tier()
+    b = 4 << 20
+    # flat pays the full 16-ring at DCN bandwidth + one DCN launch
+    want = 100.0 + 2.0 * b * (16 - 1) / 16 / (25.0 * 1e3)
+    assert t.estimate_us("flat", b) == pytest.approx(want, rel=1e-12)
+
+
 def test_describe_mentions_every_tier():
     d = two_tier(4, 2).describe()
     assert "ici[4]" in d and "dcn[2]" in d

@@ -120,7 +120,7 @@ def test_tuner_is_deterministic():
 def test_tuner_exploits_an_outer_tier():
     res = tune_canned(two_tier(4, 2), GRAD_BYTES)
     # across a slow DCN tier the winner must stop paying the flat ring
-    assert res.plan.strategy in ("hierarchical", "auto")
+    assert res.plan.strategy in ("hierarchical", "auto", "synth")
     assert res.improves_overlap
     assert res.plan.fingerprint == two_tier(4, 2).fingerprint()
     assert res.plan.buckets  # per-bucket algorithm record is filled
@@ -132,9 +132,13 @@ def test_candidate_grid_respects_opt_ins():
     assert not any(c.double_buffering for c in flat_only)
     tiered = default_candidates(two_tier(4, 2))
     assert {c.strategy for c in tiered} == {"flat", "hierarchical",
-                                            "auto"}
+                                            "auto", "synth"}
+    assert all(c.program is not None for c in tiered
+               if c.strategy == "synth")
     lossy = default_candidates(two_tier(4, 2), lossy=True)
     assert "quantized" in {c.strategy for c in lossy}
+    assert any(c.strategy == "synth" and c.wire_format != "f32"
+               for c in lossy)
     stale = default_candidates(single_tier(8), allow_stale=True)
     assert any(c.double_buffering for c in stale)
 

@@ -117,11 +117,15 @@ def _aot_compile_fn(topology_name):
         opt = optax.sgd(0.1)
         from chainermn_tpu.collectives import make_grad_reducer
 
+        extra = {}
+        if getattr(cand, "program", None) is not None:
+            extra["program"] = cand.program  # 'synth' candidates
         reducer = make_grad_reducer(
             cand.strategy, comm, bucket_bytes=cand.bucket_bytes,
             bucket_order=cand.bucket_order,
             wire_format=(cand.wire_format
-                         if cand.wire_format != "f32" else None))
+                         if cand.wire_format != "f32" else None),
+            **extra)
         mnopt = chainermn_tpu.create_multi_node_optimizer(
             opt, comm, grad_reducer=reducer,
             double_buffering=cand.double_buffering)
