@@ -527,6 +527,31 @@ def main():
             shutil.rmtree(ckpt_dir, ignore_errors=True)
     except Exception as e:  # never sink the headline metric
         record["ckpt_gate_error"] = f"{type(e).__name__}: {e}"[:300]
+
+    # static-analysis gate (docs/static_analysis.md), folded into the
+    # same JSON line: the library the numbers above exercise must be
+    # dlint-clean — the per-function AST passes AND the whole-program
+    # DL113–DL116 passes (call-graph divergence, send/recv cycles, lock
+    # inversions, blocking waits under locks) over chainermn_tpu/, with
+    # no dead suppressions. Pure host-side parsing, NOT TPU-gated; a
+    # benchmark record from a repo with a known deadlock pattern is not
+    # a record worth keeping.
+    try:
+        from chainermn_tpu.analysis import run_lint
+
+        lint = run_lint([os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "chainermn_tpu")])
+        inter = [f for f in lint.findings
+                 if f.rule in ("DL113", "DL114", "DL115", "DL116")]
+        record["static_analysis_findings"] = len(lint.findings)
+        record["static_analysis_dead_suppressions"] = len(
+            lint.dead_suppressions)
+        record["static_analysis_gate_ok"] = bool(
+            not lint.findings and not lint.dead_suppressions)
+        record["interprocedural_findings"] = len(inter)
+        record["interprocedural_gate_ok"] = not inter
+    except Exception as e:  # never sink the headline metric
+        record["static_analysis_gate_error"] = f"{type(e).__name__}: {e}"[:300]
     print(json.dumps(record))
 
 

@@ -20,6 +20,17 @@ Two pass families, one CLI (``tools/dlint.py``):
   - ``DL103`` root argument from the wrong rank space
   - ``DL104`` step-dispatch loop without a per-iteration sync
 
+* **Project passes** (:mod:`.sequence`, :mod:`.locks`) run ONCE over a
+  whole-program :class:`~.callgraph.Project` (symbol table + call
+  graph built from every file in the run), so they see through call
+  chains the per-file passes cannot:
+
+  - ``DL113`` interprocedural divergent collective (DL101 through any
+    resolved call chain)
+  - ``DL114`` send/recv channel cycles and unmatched endpoints
+  - ``DL115`` lock-order inversion across the threaded planes
+  - ``DL116`` blocking call while holding a lock
+
 * **Compiled-HLO passes** (:mod:`.hlo_passes`) run over scheduled HLO
   text (``compiled.as_text()``) — the generalized form of
   ``tools/check_overlap_schedule.py``, which is now a thin wrapper:
@@ -35,17 +46,38 @@ Every rule has a stable ID, a fix-it message citing the docs
 example), and positive/negative fixture tests under
 ``tests/analysis_tests/``. Findings are suppressed in source with a
 ``# dlint: disable=RULE`` comment on the flagged line (or the line
-directly above it) — suppressions should carry a rationale.
+directly above it; on a statement's first line it covers the whole
+statement, decorators included) — suppressions should carry a
+rationale, and ``tools/dlint.py --report-suppressions`` lists the dead
+ones. ``--format sarif`` / ``--baseline`` / ``--changed`` make the CLI
+CI-grade (:mod:`.output`).
 """
 
 from chainermn_tpu.analysis import ast_passes  # noqa: F401  (registers DL1xx)
+from chainermn_tpu.analysis import locks  # noqa: F401  (DL115/DL116)
+from chainermn_tpu.analysis import sequence  # noqa: F401  (DL113/DL114)
+from chainermn_tpu.analysis.callgraph import (  # noqa: F401
+    DEFAULT_CALL_DEPTH,
+    Project,
+)
 from chainermn_tpu.analysis.core import (  # noqa: F401
     Finding,
+    LintRun,
     RULES,
+    Suppression,
     iter_python_files,
     lint_file,
     lint_paths,
     lint_source,
+    run_lint,
+    run_lint_sources,
+)
+from chainermn_tpu.analysis.output import (  # noqa: F401
+    filter_new,
+    fingerprints,
+    load_baseline,
+    to_sarif,
+    write_baseline,
 )
 from chainermn_tpu.analysis.hlo_passes import (  # noqa: F401
     check_collective_budget,

@@ -1,12 +1,31 @@
 """dlint core: findings, the rule registry, suppressions, and the driver.
 
-The shape every pass shares: a pass is a function
-``(tree, src, path) -> list[Finding]`` registered under a stable rule ID.
+Two pass shapes share one registry:
+
+* **AST passes** (``kind="ast"``) — ``(tree, src, path) ->
+  list[Finding]``, run per file with zero cross-module visibility;
+* **project passes** (``kind="project"``) — ``(Project) ->
+  list[Finding]``, run ONCE over a :class:`~.callgraph.Project` built
+  from every parsed file in the run, for the interprocedural rules
+  (DL113–DL116). ``lint_source`` builds a single-file project so
+  in-string fixtures exercise them too.
+
 The driver parses each file once, collects ``# dlint: disable=RULE``
-comments from the token stream (so string literals containing the marker
-cannot suppress anything), runs every requested pass, and drops findings
-whose line — or the line directly above, for multi-line calls and
-statement-level suppressions — carries a matching disable comment.
+comments from the token stream (so string literals containing the
+marker cannot suppress anything), runs every requested pass, and drops
+suppressed findings. A disable comment covers:
+
+* its own line and the line below (the trailing-comment and
+  comment-above idioms), and
+* when it sits on the FIRST line of a statement — where "first"
+  includes a decorator line — the statement's whole ``end_lineno``
+  range, so one disable on a ``def``/``with``/multi-line call
+  suppresses findings anchored anywhere inside it.
+
+Every suppression records how many findings it absorbed;
+:func:`run_lint` returns them so ``tools/dlint.py
+--report-suppressions`` can list the dead ones (zero hits) before they
+rot.
 """
 
 from __future__ import annotations
@@ -17,10 +36,10 @@ import os
 import re
 import tokenize
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterable, List, Optional, Sequence
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
-# ``# dlint: disable=DL101`` or ``# dlint: disable=DL101,DL104`` or
-# ``# dlint: disable=all``
+# matches ``dlint: disable=DL101``, ``disable=DL101,DL104``, and
+# ``disable=all`` comment markers (hash prefix implied by the token)
 _DISABLE_RE = re.compile(r"#\s*dlint:\s*disable=([\w,\s]+)")
 
 
@@ -44,13 +63,14 @@ class Rule:
     rule_id: str
     name: str
     doc: str           # docs/static_analysis.md anchor for the fix-it
-    check: Callable    # (tree, src, path) -> List[Finding]
-    kind: str = "ast"  # "ast" | "hlo" (hlo rules are not file passes)
+    check: Callable    # (tree, src, path) | (Project) -> List[Finding]
+    kind: str = "ast"  # "ast" | "project" | "hlo"
 
 
-#: rule_id -> Rule. AST passes register themselves on import
-#: (see :mod:`.ast_passes`); HLO rules register metadata only — they run
-#: on compiled HLO text via :mod:`.hlo_passes`, not on source files.
+#: rule_id -> Rule. AST and project passes register themselves on import
+#: (see :mod:`.ast_passes` / :mod:`.sequence` / :mod:`.locks`); HLO
+#: rules register metadata only — they run on compiled HLO text via
+#: :mod:`.hlo_passes`, not on source files.
 RULES: Dict[str, Rule] = {}
 
 
@@ -59,6 +79,46 @@ def register(rule: Rule) -> Rule:
         raise ValueError(f"duplicate dlint rule id {rule.rule_id}")
     RULES[rule.rule_id] = rule
     return rule
+
+
+def _load_passes() -> None:
+    """Import every pass module so the registry is complete no matter
+    which entry point ran first."""
+    from chainermn_tpu.analysis import ast_passes  # noqa: F401
+    from chainermn_tpu.analysis import locks  # noqa: F401
+    from chainermn_tpu.analysis import sequence  # noqa: F401
+
+
+@dataclass
+class Suppression:
+    """One ``# dlint: disable=...`` comment and what it absorbed."""
+
+    path: str
+    line: int               # line the comment is on
+    rules: set              # rule IDs it disables ({"all"} = wildcard)
+    start: int              # first finding line it covers
+    end: int                # last finding line it covers (inclusive)
+    hits: int = 0           # findings it suppressed in this run
+
+    def covers(self, f: Finding) -> bool:
+        return (self.start <= f.line <= self.end
+                and (f.rule in self.rules or "all" in self.rules))
+
+    def format(self) -> str:
+        rules = ",".join(sorted(self.rules))
+        return f"{self.path}:{self.line}: disable={rules}"
+
+
+@dataclass
+class LintRun:
+    """Everything one driver invocation produced."""
+
+    findings: List[Finding] = field(default_factory=list)
+    suppressions: List[Suppression] = field(default_factory=list)
+
+    @property
+    def dead_suppressions(self) -> List[Suppression]:
+        return [s for s in self.suppressions if s.hits == 0]
 
 
 def suppressed_lines(src: str) -> Dict[int, set]:
@@ -84,40 +144,115 @@ def suppressed_lines(src: str) -> Dict[int, set]:
     return out
 
 
-def _is_suppressed(f: Finding, disables: Dict[int, set]) -> bool:
-    for line in (f.line, f.line - 1):
-        rules = disables.get(line)
-        if rules and (f.rule in rules or "all" in rules):
-            return True
-    return False
+def _statement_ranges(tree: ast.AST) -> Dict[int, int]:
+    """first-line -> last end_lineno of any statement starting there.
+
+    "First line" counts decorators: a disable on the ``@decorator``
+    line of a decorated def covers the whole def. When several nested
+    statements start on one line (``if x: y()``), the outermost —
+    largest — range wins, which is the direction suppression should
+    err in: the comment visibly sits on that whole construct.
+    """
+    ranges: Dict[int, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        first = node.lineno
+        for dec in getattr(node, "decorator_list", None) or []:
+            first = min(first, dec.lineno)
+        end = getattr(node, "end_lineno", None) or node.lineno
+        if end > ranges.get(first, 0):
+            ranges[first] = end
+    return ranges
+
+
+def collect_suppressions(src: str, path: str,
+                         tree: Optional[ast.AST] = None
+                         ) -> List[Suppression]:
+    disables = suppressed_lines(src)
+    if not disables:
+        return []
+    ranges = _statement_ranges(tree) if tree is not None else {}
+    out = []
+    for line in sorted(disables):
+        # own line + line below (legacy), widened to the full range of
+        # a statement whose first line carries (or sits under) it
+        end = max(line + 1, ranges.get(line, 0), ranges.get(line + 1, 0))
+        out.append(Suppression(path, line, disables[line], line, end))
+    return out
+
+
+def _apply_suppressions(findings: List[Finding],
+                        sups: Dict[str, List[Suppression]]
+                        ) -> List[Finding]:
+    kept: List[Finding] = []
+    for f in findings:
+        hit = None
+        for s in sups.get(f.path, ()):
+            if s.covers(f):
+                hit = s
+                break
+        if hit is not None:
+            hit.hits += 1
+        else:
+            kept.append(f)
+    return kept
+
+
+def run_lint_sources(sources: Dict[str, str],
+                     rules: Optional[Sequence[str]] = None) -> LintRun:
+    """The driver: run AST passes per file and project passes over the
+    whole set. ``sources``: path -> source text."""
+    _load_passes()
+    from chainermn_tpu.analysis.callgraph import Project
+
+    run = LintRun()
+    findings: List[Finding] = []
+    sups: Dict[str, List[Suppression]] = {}
+    parsed: Dict[str, Tuple[ast.AST, str]] = {}
+    for path in sorted(sources):
+        src = sources[path]
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "DL000", path, e.lineno or 1,
+                f"syntax error blocks analysis: {e.msg}"))
+            sups[path] = collect_suppressions(src, path)
+            continue
+        parsed[path] = (tree, src)
+        sups[path] = collect_suppressions(src, path, tree)
+        for rule in RULES.values():
+            if rule.kind != "ast":
+                continue
+            if rules is not None and rule.rule_id not in rules:
+                continue
+            findings.extend(rule.check(tree, src, path))
+
+    project_rules = [r for r in RULES.values() if r.kind == "project"
+                     and (rules is None or r.rule_id in rules)]
+    if project_rules and parsed:
+        project = Project.build(parsed)
+        for rule in project_rules:
+            findings.extend(rule.check(project))
+
+    # a call nested under two rank-dependent Ifs can be reported by both
+    # evaluations; one report per (rule, path, line) is enough — dedup
+    # BEFORE suppression accounting so duplicates don't inflate hits
+    findings = sorted(set(findings),
+                      key=lambda f: (f.path, f.line, f.rule))
+    findings = _apply_suppressions(findings, sups)
+    run.findings = findings
+    run.suppressions = [s for path in sorted(sups) for s in sups[path]]
+    return run
 
 
 def lint_source(src: str, path: str = "<string>",
                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Run the AST passes over one source string. ``rules`` restricts to
-    the given IDs (default: every registered AST rule)."""
-    # passes register on import; import here so `import analysis.core`
-    # alone never yields an empty registry
-    from chainermn_tpu.analysis import ast_passes  # noqa: F401
-
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Finding("DL000", path, e.lineno or 1,
-                        f"syntax error blocks analysis: {e.msg}")]
-    disables = suppressed_lines(src)
-    findings: List[Finding] = []
-    for rule in RULES.values():
-        if rule.kind != "ast":
-            continue
-        if rules is not None and rule.rule_id not in rules:
-            continue
-        findings.extend(rule.check(tree, src, path))
-    findings = [f for f in findings if not _is_suppressed(f, disables)]
-    # a call nested under two rank-dependent Ifs can be reported by both
-    # evaluations; one report per (rule, line) is enough
-    findings = sorted(set(findings), key=lambda f: (f.path, f.line, f.rule))
-    return findings
+    """Run the passes over one source string (project passes see a
+    single-file project). ``rules`` restricts to the given IDs
+    (default: every registered source rule)."""
+    return run_lint_sources({path: src}, rules=rules).findings
 
 
 def lint_file(path: str,
@@ -146,10 +281,28 @@ def iter_python_files(roots: Iterable[str]) -> List[str]:
     return sorted(set(out))
 
 
+def run_lint(paths: Iterable[str],
+             rules: Optional[Sequence[str]] = None,
+             only: Optional[Iterable[str]] = None) -> LintRun:
+    """Lint every .py under ``paths``. ``only``, when given, restricts
+    REPORTING to those files while the whole-program passes still see
+    everything (the ``--changed`` contract: context stays global, the
+    gate is local)."""
+    sources: Dict[str, str] = {}
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            sources[path] = fh.read()
+    run = run_lint_sources(sources, rules=rules)
+    if only is not None:
+        keep = {os.path.abspath(p) for p in only}
+        run.findings = [f for f in run.findings
+                        if os.path.abspath(f.path) in keep]
+        run.suppressions = [s for s in run.suppressions
+                            if os.path.abspath(s.path) in keep]
+    return run
+
+
 def lint_paths(paths: Iterable[str],
                rules: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Run the AST passes over every .py file under ``paths``."""
-    findings: List[Finding] = []
-    for path in iter_python_files(paths):
-        findings.extend(lint_file(path, rules=rules))
-    return findings
+    """Run the source passes over every .py file under ``paths``."""
+    return run_lint(paths, rules=rules).findings

@@ -1,0 +1,167 @@
+"""dlint output layer: SARIF 2.1.0 emission and the findings baseline.
+
+**SARIF** — one ``run`` with the full rule catalogue under
+``tool.driver.rules`` and one ``result`` per finding, so CI viewers
+(GitHub code scanning et al.) render findings inline. Paths are
+emitted repo-relative with forward slashes, per the spec's
+``uriBaseId`` convention.
+
+**Baseline** — the ratchet that makes a whole-program linter adoptable
+on a repo with pre-existing findings: ``--write-baseline`` records
+today's findings as fingerprints; later runs with ``--baseline`` fail
+only on findings NOT in the file, so new debt is blocked while old
+debt burns down explicitly. Fingerprints are
+``rule :: relative-path :: stripped-source-line-text :: occurrence-
+index`` — anchored to the line's TEXT, not its number, so unrelated
+edits above a finding don't churn the baseline; the occurrence index
+disambiguates identical lines. A finding whose line text changes
+deliberately re-surfaces, which is the behavior a ratchet wants.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from chainermn_tpu.analysis.core import RULES, Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+BASELINE_VERSION = 1
+
+
+def _rel(path: str, root: Optional[str] = None) -> str:
+    root = root or os.getcwd()
+    try:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    except ValueError:          # different drive (windows)
+        rel = path
+    if rel.startswith(".."):    # outside the root: keep as given
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+# ---------------------------------------------------------------------------
+# SARIF
+# ---------------------------------------------------------------------------
+
+
+def to_sarif(findings: Sequence[Finding],
+             root: Optional[str] = None) -> dict:
+    """A complete SARIF 2.1.0 log object for one lint run."""
+    rules_meta = [
+        {
+            "id": rule.rule_id,
+            "name": rule.name,
+            "helpUri": rule.doc,
+            "shortDescription": {"text": rule.name},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for rule_id, rule in sorted(RULES.items())
+    ]
+    index = {r["id"]: i for i, r in enumerate(rules_meta)}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": _rel(f.path, root),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": max(1, f.line)},
+                },
+            }],
+        }
+        if f.rule in index:
+            result["ruleIndex"] = index[f.rule]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "dlint",
+                    "informationUri": "docs/static_analysis.md",
+                    "rules": rules_meta,
+                },
+            },
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": "file:///" + _rel(
+                    root or os.getcwd(), "/").lstrip("/") + "/"},
+            },
+            "results": results,
+        }],
+    }
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def _line_text(path: str, line: int,
+               cache: Dict[str, List[str]]) -> str:
+    if path not in cache:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                cache[path] = fh.read().splitlines()
+        except OSError:
+            cache[path] = []
+    lines = cache[path]
+    if 1 <= line <= len(lines):
+        return lines[line - 1].strip()
+    return ""
+
+
+def fingerprints(findings: Sequence[Finding],
+                 root: Optional[str] = None) -> List[Tuple[Finding, str]]:
+    """(finding, fingerprint) pairs; stable across line-number drift."""
+    cache: Dict[str, List[str]] = {}
+    counts: Dict[str, int] = {}
+    out: List[Tuple[Finding, str]] = []
+    # occurrence index assigned in (path, line, rule) order so two
+    # identical lines fingerprint deterministically
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        base = "::".join((f.rule, _rel(f.path, root),
+                          _line_text(f.path, f.line, cache)))
+        n = counts.get(base, 0)
+        counts[base] = n + 1
+        out.append((f, f"{base}::{n}"))
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   root: Optional[str] = None) -> dict:
+    data = {
+        "version": BASELINE_VERSION,
+        "tool": "dlint",
+        "findings": sorted(fp for _, fp in fingerprints(findings, root)),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return data
+
+
+def load_baseline(path: str) -> set:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path}: not a dlint baseline file")
+    return set(data["findings"])
+
+
+def filter_new(findings: Sequence[Finding], baseline: Iterable[str],
+               root: Optional[str] = None) -> List[Finding]:
+    """Findings whose fingerprint is NOT in the baseline — the only
+    ones a baselined run gates on."""
+    known = set(baseline)
+    return [f for f, fp in fingerprints(findings, root)
+            if fp not in known]

@@ -82,7 +82,7 @@ class PrefillPool:
         work — they pin their cursors and wait for export)."""
         if self.engine.idle():
             return False
-        self.engine.step()  # dlint: disable=DL104
+        self.engine.step()
         return True
 
     def ready(self) -> List[Tuple[Stream, object]]:
@@ -132,7 +132,7 @@ class DecodePool:
     def step(self) -> bool:
         worked = False
         if not self.engine.idle():
-            self.engine.step()  # dlint: disable=DL104
+            self.engine.step()
             worked = True
         still = []
         for req, stream in self._inflight:

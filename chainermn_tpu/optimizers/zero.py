@@ -728,7 +728,6 @@ def _make_zero2_bucketed(model, optimizer, comm, params, n_microbatches,
     m = n_microbatches
     rs, ef_reducer = _resolve_rs(grad_reducer, comm)
     if rs is None and ef_reducer is None:
-        # dlint: disable=DL106 — this IS the reducer plumbing
         rs = lambda g: lax.psum_scatter(g, ax, tiled=True) / n
 
     from chainermn_tpu.optimizers import _ReducerWrappedState

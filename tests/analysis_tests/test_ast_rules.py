@@ -24,10 +24,14 @@ def _only(findings, rule):
 def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
             "DL107", "DL108", "DL109", "DL110", "DL111", "DL112",
+            "DL113", "DL114", "DL115", "DL116",
             "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
-        assert rule.kind in ("ast", "hlo")
+        assert rule.kind in ("ast", "project", "hlo")
+    assert {r for r, rule in RULES.items()
+            if rule.kind == "project"} \
+        == {"DL113", "DL114", "DL115", "DL116"}
 
 
 # ---------------------------------------------------------------------------
@@ -401,6 +405,71 @@ def test_string_literal_cannot_suppress():
         return doc
     '''
     assert [f.rule for f in _lint(src)] == ["DL101"]
+
+
+def test_suppression_on_def_line_covers_the_whole_statement():
+    # the disable sits on the ``def`` line; the finding anchors three
+    # lines in — the statement-range rule must cover it
+    src = """\
+    def f(comm):  # dlint: disable=DL101 — drain-only entry point
+        if comm.rank == 0:
+            comm.barrier()
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+def test_suppression_above_decorated_def_covers_the_body():
+    # "first line" of a decorated def is the decorator line: a disable
+    # on (or directly above) it suppresses findings anywhere inside
+    src = """\
+    # dlint: disable=DL101 — retry wrapper runs on the drain rank only
+    @retry(3)
+    def f(comm):
+        if comm.rank == 0:
+            comm.barrier()
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+def test_suppression_on_multiline_statement_first_line():
+    # the finding anchors on the ``comm.gather`` line, TWO lines below
+    # the statement's first line where the disable sits — out of reach
+    # for the old line/line-1 matching, covered by the range rule
+    src = """\
+    def f(comm, xs):
+        if comm.rank == 0:
+            cfg = {  # dlint: disable=DL101 — root collects
+                "n": len(xs),
+                "g": comm.gather(xs, root=0),
+            }
+            return cfg
+    """
+    assert _only(_lint(src), "DL101") == []
+
+
+def test_suppression_range_does_not_leak_past_the_statement():
+    # the disable covers f's def but NOT g below it
+    src = """\
+    def f(comm):  # dlint: disable=DL101
+        if comm.rank == 0:
+            comm.barrier()
+
+    def g(comm):
+        if comm.rank == 0:
+            comm.barrier()
+    """
+    fs = _only(_lint(src), "DL101")
+    assert [f.line for f in fs] == [7]
+
+
+def test_suppression_is_still_rule_scoped_inside_the_range():
+    # a DL104 disable on the def line must not absorb a DL101 finding
+    src = """\
+    def f(comm):  # dlint: disable=DL104
+        if comm.rank == 0:
+            comm.barrier()
+    """
+    assert len(_only(_lint(src), "DL101")) == 1
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,208 @@
+"""Unit fixtures for the whole-program symbol table / call resolver
+(chainermn_tpu.analysis.callgraph) the DL113–DL116 passes stand on.
+
+Pure-AST tests: no jax import, no devices, tier-1 at zero cost.
+"""
+
+import ast
+import textwrap
+
+from chainermn_tpu.analysis.callgraph import Project, module_name_for
+
+
+def _project(**sources):
+    files = {}
+    for name, src in sources.items():
+        path = name.replace(".", "/") + ".py"
+        files[path] = (ast.parse(textwrap.dedent(src)), src)
+    return Project.build(files)
+
+
+def _calls_in(project, qualname):
+    func = project.functions[qualname]
+    return [n for n in ast.walk(func.node) if isinstance(n, ast.Call)]
+
+
+def test_module_name_walks_packages(tmp_path):
+    pkg = tmp_path / "pkg" / "sub"
+    pkg.mkdir(parents=True)
+    (tmp_path / "pkg" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text("x = 1\n")
+    assert module_name_for(str(pkg / "mod.py")) == "pkg.sub.mod"
+    assert module_name_for(str(pkg / "__init__.py")) == "pkg.sub"
+    # no __init__.py above: flat module name
+    (tmp_path / "loose.py").write_text("x = 1\n")
+    assert module_name_for(str(tmp_path / "loose.py")) == "loose"
+
+
+def test_symbol_table_indexes_functions_methods_and_bases():
+    p = _project(
+        m="""
+        class Base:
+            def shared(self):
+                pass
+
+        class Impl(Base):
+            def own(self):
+                pass
+
+        def free():
+            pass
+        """)
+    assert "m:free" in p.functions
+    assert "m:Impl.own" in p.functions
+    assert "m:Base.shared" in p.functions
+    ci = p.modules["m"].classes["Impl"]
+    assert ci.bases == ["Base"]
+
+
+def test_resolve_plain_name_and_from_import():
+    p = _project(
+        helpers="""
+        def sync_all(comm):
+            comm.barrier()
+        """,
+        train="""
+        from helpers import sync_all
+
+        def local():
+            pass
+
+        def step(comm):
+            local()
+            sync_all(comm)
+        """)
+    step = p.functions["train:step"]
+    resolved = [p.resolve_call(c, step) for c in _calls_in(p, "train:step")]
+    names = {r.qualname for r in resolved if r is not None}
+    assert names == {"train:local", "helpers:sync_all"}
+
+
+def test_resolve_module_attribute_chain_and_alias():
+    p = _project(
+        helpers="""
+        def sync_all(comm):
+            comm.barrier()
+        """,
+        train="""
+        import helpers as h
+
+        def step(comm):
+            h.sync_all(comm)
+        """)
+    step = p.functions["train:step"]
+    (call,) = _calls_in(p, "train:step")
+    assert p.resolve_call(call, step).qualname == "helpers:sync_all"
+
+
+def test_resolve_self_method_through_base_class():
+    p = _project(
+        m="""
+        class Base:
+            def helper(self):
+                pass
+
+        class Impl(Base):
+            def run(self):
+                self.helper()
+        """)
+    run = p.functions["m:Impl.run"]
+    (call,) = _calls_in(p, "m:Impl.run")
+    assert p.resolve_call(call, run).qualname == "m:Base.helper"
+
+
+def test_resolve_self_attr_type_from_constructor_assignment():
+    p = _project(
+        m="""
+        class Engine:
+            def step(self):
+                pass
+
+        class Frontend:
+            def __init__(self):
+                self.engine = Engine()
+
+            def tick(self):
+                self.engine.step()
+        """)
+    tick = p.functions["m:Frontend.tick"]
+    calls = [c for c in _calls_in(p, "m:Frontend.tick")]
+    assert p.resolve_call(calls[0], tick).qualname == "m:Engine.step"
+
+
+def test_resolve_typed_local_receiver():
+    p = _project(
+        m="""
+        class Engine:
+            def step(self):
+                pass
+
+        def run(eng: Engine):
+            eng.step()
+
+        def build():
+            e = Engine()
+            e.step()
+        """)
+    (call,) = _calls_in(p, "m:run")
+    assert p.resolve_call(call, p.functions["m:run"]).qualname \
+        == "m:Engine.step"
+    calls = _calls_in(p, "m:build")
+    step_call = [c for c in calls
+                 if isinstance(c.func, ast.Attribute)][0]
+    assert p.resolve_call(step_call, p.functions["m:build"]).qualname \
+        == "m:Engine.step"
+
+
+def test_unknown_receiver_is_opaque_not_guessed():
+    # two classes define ``step``; an untyped receiver must resolve to
+    # NEITHER (conservative: no guessing by method name)
+    p = _project(
+        m="""
+        class A:
+            def step(self):
+                pass
+
+        class B:
+            def step(self):
+                pass
+
+        def run(thing):
+            thing.step()
+        """)
+    (call,) = _calls_in(p, "m:run")
+    assert p.resolve_call(call, p.functions["m:run"]) is None
+
+
+def test_constructor_call_resolves_to_init():
+    p = _project(
+        m="""
+        class Engine:
+            def __init__(self):
+                self.n = 0
+
+        def build():
+            return Engine()
+        """)
+    (call,) = _calls_in(p, "m:build")
+    assert p.resolve_call(call, p.functions["m:build"]).qualname \
+        == "m:Engine.__init__"
+
+
+def test_module_level_conditional_defs_still_indexed():
+    p = _project(
+        m="""
+        try:
+            import numpy
+        except ImportError:
+            numpy = None
+
+        if numpy is not None:
+            def fast():
+                pass
+        else:
+            def fast():
+                pass
+        """)
+    assert "m:fast" in p.functions

@@ -1,0 +1,334 @@
+"""DL113 / DL114 fixtures: the interprocedural collective-sequence
+passes must catch cross-call and cross-module hazards the per-function
+DL101/DL102 provably miss — asserted side by side here — and stay
+quiet on agreeing twins.
+
+Pure-AST tests: no jax import, no devices, tier-1 at zero cost.
+"""
+
+import textwrap
+
+from chainermn_tpu.analysis import lint_source, run_lint_sources
+
+
+def _lint(src, rules=None):
+    return lint_source(textwrap.dedent(src), "fixture.py", rules=rules)
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+def _lint_files(rules=None, **sources):
+    files = {name.replace(".", "/") + ".py": textwrap.dedent(src)
+             for name, src in sources.items()}
+    return run_lint_sources(files, rules=rules).findings
+
+
+# ---------------------------------------------------------------------------
+# DL113 — interprocedural-divergent-collective
+# ---------------------------------------------------------------------------
+
+
+def test_dl113_flags_collective_reached_through_helper():
+    src = """\
+    def sync_helper(comm):
+        comm.allreduce(1)
+
+    def step(comm):
+        if comm.rank == 0:
+            sync_helper(comm)
+    """
+    fs = _only(_lint(src), "DL113")
+    assert len(fs) == 1
+    assert fs[0].line == 5          # anchored at the rank branch
+    assert "allreduce" in fs[0].message
+    assert "sync_helper" in fs[0].message
+    assert "docs/static_analysis.md#dl113" in fs[0].message
+
+
+def test_dl113_names_the_full_call_chain():
+    src = """\
+    def a(comm):
+        comm.psum(1)
+
+    def b(comm):
+        a(comm)
+
+    def c(comm):
+        b(comm)
+
+    def step(comm):
+        if comm.rank == 0:
+            c(comm)
+    """
+    fs = _only(_lint(src), "DL113")
+    assert len(fs) == 1
+    assert "c -> b -> a" in fs[0].message
+
+
+def test_dl113_catches_what_dl101_misses():
+    """The acceptance fixture: a divergence hidden behind one call hop
+    is invisible to the per-function pass and visible to DL113."""
+    src = """\
+    def sync_helper(comm):
+        comm.barrier()
+
+    def step(comm):
+        if comm.rank == 0:
+            sync_helper(comm)
+    """
+    assert _only(_lint(src), "DL101") == []     # DL101 cannot see it
+    assert len(_only(_lint(src), "DL113")) == 1
+
+
+def test_dl113_cross_module_divergence():
+    findings = _lint_files(
+        helpers="""
+        def sync_all(comm):
+            comm.allgather(1)
+        """,
+        train="""
+        from helpers import sync_all
+
+        def step(comm):
+            if comm.rank == 0:
+                sync_all(comm)
+        """)
+    fs = _only(findings, "DL113")
+    assert len(fs) == 1
+    assert fs[0].path == "train.py"
+    assert _only(findings, "DL101") == []
+
+
+def test_dl113_clean_when_both_sides_reach_same_collective():
+    src = """\
+    def sync_helper(comm):
+        comm.allreduce(1)
+
+    def step(comm):
+        if comm.rank == 0:
+            sync_helper(comm)
+        else:
+            sync_helper(comm)
+    """
+    assert _only(_lint(src), "DL113") == []
+
+
+def test_dl113_clean_when_sibling_calls_it_directly():
+    # membership check, not chain-identity: helper on one side, the
+    # same collective inline on the other
+    src = """\
+    def sync_helper(comm):
+        comm.barrier()
+
+    def step(comm):
+        if comm.rank == 0:
+            sync_helper(comm)
+        else:
+            comm.barrier()
+    """
+    assert _only(_lint(src), "DL113") == []
+
+
+def test_dl113_p2p_needs_sibling_communication_only():
+    src = """\
+    def push(comm, x):
+        comm.send(x, dest=1, tag=3)
+
+    def pull(comm):
+        return comm.recv(src=0, tag=3)
+
+    def exchange(comm, x):
+        if comm.rank == 0:
+            push(comm, x)
+        else:
+            pull(comm)
+    """
+    assert _only(_lint(src), "DL113") == []
+
+
+def test_dl113_flags_p2p_with_silent_sibling():
+    src = """\
+    def push(comm, x):
+        comm.send(x, dest=1, tag=3)
+
+    def step(comm, x):
+        if comm.rank == 0:
+            push(comm, x)
+        else:
+            x = x + 1
+    """
+    fs = _only(_lint(src), "DL113")
+    assert len(fs) == 1
+    assert "push" in fs[0].message
+
+
+def test_dl113_terminating_guard_uses_fallthrough_as_else():
+    src = """\
+    def sync_helper(comm):
+        comm.barrier()
+
+    def step(comm):
+        if comm.rank == 0:
+            sync_helper(comm)
+            return
+        sync_helper(comm)
+    """
+    assert _only(_lint(src), "DL113") == []
+
+
+def test_dl113_zero_hop_divergence_stays_dl101s():
+    # direct divergence in one function is DL101's finding; DL113 must
+    # not double-report it
+    src = """\
+    def step(comm):
+        if comm.rank == 0:
+            comm.barrier()
+    """
+    assert _only(_lint(src), "DL113") == []
+    assert len(_only(_lint(src), "DL101")) == 1
+
+
+def test_dl113_suppression_on_branch_line():
+    src = """\
+    def sync_helper(comm):
+        comm.barrier()
+
+    def step(comm):
+        if comm.rank == 0:  # dlint: disable=DL113 — drain-only rank
+            sync_helper(comm)
+    """
+    assert _only(_lint(src), "DL113") == []
+
+
+def test_dl113_recursion_is_opaque_not_fatal():
+    src = """\
+    def spin(comm, n):
+        if n:
+            spin(comm, n - 1)
+        comm.barrier()
+
+    def step(comm):
+        if comm.rank == 0:
+            spin(comm, 3)
+    """
+    fs = _only(_lint(src), "DL113")
+    assert len(fs) == 1             # barrier still reached through spin
+
+
+# ---------------------------------------------------------------------------
+# DL114 — send-recv-cycle
+# ---------------------------------------------------------------------------
+
+
+def test_dl114_flags_recv_recv_cycle():
+    src = """\
+    def worker(comm):
+        if comm.rank == 0:
+            x = comm.recv(src=1, tag=7)
+            comm.send(x, dest=1, tag=8)
+        else:
+            y = comm.recv(src=0, tag=8)
+            comm.send(y, dest=0, tag=7)
+    """
+    fs = _only(_lint(src), "DL114")
+    assert len(fs) == 1
+    assert "cycle" in fs[0].message
+    assert "7" in fs[0].message and "8" in fs[0].message
+    assert "docs/static_analysis.md#dl114" in fs[0].message
+
+
+def test_dl114_clean_ping_pong_send_first():
+    src = """\
+    def worker(comm):
+        if comm.rank == 0:
+            comm.send(1, dest=1, tag=7)
+            x = comm.recv(src=1, tag=8)
+        else:
+            y = comm.recv(src=0, tag=7)
+            comm.send(y, dest=0, tag=8)
+    """
+    assert _only(_lint(src), "DL114") == []
+
+
+def test_dl114_flags_unmatched_send_and_recv():
+    src = """\
+    def push(comm, x):
+        comm.send(x, dest=1, tag=5)
+
+    def pull(comm):
+        return comm.recv(src=0, tag=6)
+    """
+    fs = _only(_lint(src), "DL114")
+    assert len(fs) == 2
+    msgs = " | ".join(f.message for f in fs)
+    assert "never received" in msgs and "never sent" in msgs
+
+
+def test_dl114_variable_tags_do_not_participate():
+    # only statically-known tags join the channel graph — a variable
+    # tag cannot be proven unmatched
+    src = """\
+    def push(comm, x, tag):
+        comm.send(x, dest=1, tag=tag)
+    """
+    assert _only(_lint(src), "DL114") == []
+
+
+def test_dl114_cross_module_cycle_dl102_misses():
+    """The acceptance fixture: a deadlock cycle split across modules.
+    DL102's per-file tag registry sees one well-formed file each; the
+    whole-program channel graph sees the circular wait."""
+    sources = dict(
+        ping="""
+        def ping(comm):
+            x = comm.recv(src=1, tag=1)
+            comm.send(x, dest=1, tag=2)
+        """,
+        pong="""
+        def pong(comm):
+            y = comm.recv(src=0, tag=2)
+            comm.send(y, dest=0, tag=1)
+        """)
+    findings = _lint_files(**sources)
+    fs = _only(findings, "DL114")
+    assert len(fs) == 1
+    assert "cycle" in fs[0].message
+    assert _only(findings, "DL102") == []   # per-file pass is blind
+
+
+def test_dl114_cycle_broken_by_one_free_send_is_clean():
+    # rank 0 sends tag 1 unconditionally first: the cycle has an entry
+    src = """\
+    def worker(comm):
+        if comm.rank == 0:
+            comm.send(0, dest=1, tag=1)
+            x = comm.recv(src=1, tag=2)
+            comm.send(x, dest=1, tag=2)
+        else:
+            y = comm.recv(src=0, tag=1)
+            comm.send(y, dest=0, tag=2)
+            z = comm.recv(src=0, tag=2)
+    """
+    assert _only(_lint(src), "DL114") == []
+
+
+def test_dl114_suppression_with_rationale():
+    src = """\
+    def push(comm, x):
+        # dlint: disable=DL114 — receiver lives in the worker script
+        comm.send(x, dest=1, tag=5)
+    """
+    assert _only(_lint(src), "DL114") == []
+
+
+def test_dl114_traced_functional_send_not_confused():
+    # functions.send/recv (traced ppermute) share the name but take
+    # the peer rank positionally — no tag keyword, no channel graph
+    src = """\
+    def f(v, comm):
+        phi = F.send(v, comm, 1)
+        return F.recv(comm, 0)
+    """
+    assert _only(_lint(src), "DL114") == []

@@ -15,6 +15,10 @@ from chainermn_tpu.fleet import DisaggregatedFleet, FleetReport
 from chainermn_tpu.models.transformer import TransformerLM, generate
 from chainermn_tpu.serving.engine import Engine, EngineConfig
 
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 VOCAB = 43
 N_NEW = 6
 

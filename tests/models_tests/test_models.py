@@ -13,6 +13,10 @@ from chainermn_tpu.models.seq2seq import (
     BOS, EOS, PAD, Seq2Seq, pad_batch, seq2seq_loss,
 )
 
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 def test_resnet50_shapes_and_collections():
     m = ResNet50(num_classes=1000)

@@ -147,3 +147,8 @@ def test_data_parallel_training_learns():
         if first is None:
             first = last
     assert last < first * 0.5, (first, last)
+
+
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow

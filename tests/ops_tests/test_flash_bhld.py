@@ -199,3 +199,8 @@ def test_model_bhld_rejects_decode():
     with pytest.raises(ValueError, match="bhld"):
         m.init(jax.random.PRNGKey(0),
                jnp.zeros((1, 8), jnp.int32))
+
+
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow

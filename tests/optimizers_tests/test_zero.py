@@ -25,6 +25,10 @@ from chainermn_tpu.training.step import make_data_parallel_train_step
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def comm():

@@ -190,3 +190,8 @@ def test_tp_rejects_bad_compositions():
     with pytest.raises(ValueError, match="must divide"):
         run(TransformerBlock(d_model=D, n_heads=2, d_ff=FF, tp_axis="tp",
                              attention="reference"))
+
+
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow

@@ -182,3 +182,8 @@ def test_abort_all_requeue_preserves_requests():
     ref = generate(model, params, pr[None], 6)
     np.testing.assert_array_equal(np.asarray(r1.tokens),
                                   np.asarray(ref)[0, 4:])
+
+
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow

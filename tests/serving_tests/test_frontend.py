@@ -160,3 +160,8 @@ def test_close_fails_inflight_futures():
         fut.result(timeout=10)
     with pytest.raises(RuntimeError, match="closed"):
         fe.submit(np.ones((4,), np.int32))
+
+
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow

@@ -201,3 +201,8 @@ def test_serving_rejects_moe_and_tp():
         ServingStep(_model(moe_experts_per_device=1), {}, 1, 8)
     with pytest.raises(ValueError, match="tp_axis"):
         ServingStep(_model(tp_axis="model"), {}, 1, 8)
+
+
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow

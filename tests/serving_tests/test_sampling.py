@@ -28,6 +28,11 @@ from chainermn_tpu.serving.engine import Engine, EngineConfig
 from chainermn_tpu.serving.kv_cache import ServingStep
 from chainermn_tpu.serving.sampling import init_keys, sample_tokens
 
+import pytest
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 # single layer keeps compiles cheap — the contracts here are about
 # scheduling and sampling, not depth (the cache-bytes test opts into 2)

@@ -133,3 +133,8 @@ def test_roundtrip_requires_the_matching_topology(comm, tmp_path):
     with pytest.raises(ValueError, match="ranks"):
         chainermn_tpu.create_multi_node_optimizer(
             optax.sgd(0.1), comm, tune=path, topology=two_tier(4, 4))
+
+
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow

@@ -14,6 +14,11 @@ from chainermn_tpu.training.step import (
     make_expert_parallel_train_step,
 )
 
+import pytest
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow
+
 
 def _model(comm, epd=1):
     return TransformerLM(

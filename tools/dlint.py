@@ -1,16 +1,29 @@
 #!/usr/bin/env python
 """dlint — distributed-correctness lint for the whole stack.
 
-Runs the :mod:`chainermn_tpu.analysis` AST passes (DL1xx) over Python
-sources and prints one ``path:line: RULE message`` finding per line.
+Runs the :mod:`chainermn_tpu.analysis` source passes — the per-file
+AST rules (DL101–DL112) and the whole-program project rules
+(DL113–DL116, which see through call chains via the repo call graph) —
+and prints one ``path:line: RULE message`` finding per line.
 Exit status: 0 clean, 1 findings, 2 usage error.
 
 Usage::
 
     python tools/dlint.py --all                 # lint the whole repo
     python tools/dlint.py chainermn_tpu/comm    # lint specific paths
-    python tools/dlint.py --rules DL101,DL103 tests/
+    python tools/dlint.py --rules DL101,DL113 tests/
     python tools/dlint.py --list-rules          # catalogue + docs anchors
+    python tools/dlint.py --all --format sarif  # SARIF 2.1.0 to stdout
+    python tools/dlint.py --all --baseline tools/dlint_baseline.json
+    python tools/dlint.py --all --write-baseline tools/dlint_baseline.json
+    python tools/dlint.py --changed             # only files in the git diff
+    python tools/dlint.py --all --report-suppressions
+
+``--baseline`` gates on NEW findings only: anything fingerprinted in
+the baseline file passes (the ratchet — old debt burns down
+explicitly, new debt is blocked). ``--changed [REF]`` lints only files
+changed vs REF (default HEAD, staged+unstaged) while the whole-program
+passes still analyze every root for call-graph context.
 
 The compiled-HLO passes (DL2xx) take HLO text, not source files — run
 them via :mod:`chainermn_tpu.analysis.hlo_passes` on a compiled
@@ -19,13 +32,17 @@ computation (see ``tools/check_overlap_schedule.py``) or point
 argument-free ones (DL201, DL203).
 
 Suppress an intentional finding with ``# dlint: disable=RULE`` (plus a
-rationale) on the flagged line or the line above. The suite keeps the
-repo clean via tests/analysis_tests/test_repo_clean.py.
+rationale) on the flagged line, the line above, or the first line of
+the enclosing statement. ``--report-suppressions`` lists suppressions
+that absorbed zero findings so dead ones get removed as rules evolve.
+The suite keeps the repo clean via
+tests/analysis_tests/test_repo_clean.py.
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -34,6 +51,23 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 #: what --all means: every Python tree that ships or exercises
 #: distributed behavior
 REPO_ROOTS = ("chainermn_tpu", "examples", "tests", "tools")
+
+
+def _changed_files(repo: str, ref: str):
+    """Python files changed vs ``ref`` (committed, staged, and
+    unstaged), absolute paths, existing files only."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        cwd=repo, capture_output=True, text=True, check=True).stdout
+    files = []
+    for line in out.splitlines():
+        line = line.strip()
+        if not line.endswith(".py"):
+            continue
+        path = os.path.join(repo, line)
+        if os.path.isfile(path):
+            files.append(path)
+    return sorted(set(files))
 
 
 def main(argv=None):
@@ -48,12 +82,36 @@ def main(argv=None):
                     help="comma-separated rule IDs to run (default: all)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--format", dest="fmt", default="text",
+                    choices=("text", "sarif"),
+                    help="finding output format (default: text)")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="gate only on findings NOT fingerprinted in "
+                         "this baseline file")
+    ap.add_argument("--write-baseline", metavar="FILE", default=None,
+                    help="record the run's findings as the new "
+                         "baseline and exit 0")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="report only files changed vs REF (default "
+                         "HEAD); whole-program passes still see every "
+                         "repo root")
+    ap.add_argument("--report-suppressions", action="store_true",
+                    help="list '# dlint: disable' comments that "
+                         "suppressed zero findings (exit 1 if any)")
     ap.add_argument("--hlo", metavar="FILE", default=None,
                     help="also run argument-free HLO passes on a saved "
                          "compiled.as_text() dump")
     args = ap.parse_args(argv)
 
-    from chainermn_tpu.analysis import RULES, lint_paths
+    from chainermn_tpu.analysis import (
+        RULES,
+        filter_new,
+        load_baseline,
+        run_lint,
+        to_sarif,
+        write_baseline,
+    )
     from chainermn_tpu.analysis import hlo_passes
 
     if args.list_rules:
@@ -72,19 +130,59 @@ def main(argv=None):
             return 2
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    if args.all:
+    only = None
+    if args.changed is not None:
+        try:
+            only = _changed_files(repo, args.changed)
+        except subprocess.CalledProcessError as e:
+            print(f"dlint: git diff failed: {e.stderr.strip()}",
+                  file=sys.stderr)
+            return 2
+        # whole-program context needs every root regardless of the diff
+        paths = [os.path.join(repo, r) for r in REPO_ROOTS
+                 if os.path.isdir(os.path.join(repo, r))]
+    elif args.all:
         paths = [os.path.join(repo, r) for r in REPO_ROOTS
                  if os.path.isdir(os.path.join(repo, r))]
     else:
         paths = args.paths
     if not paths and not args.hlo:
         ap.print_usage(sys.stderr)
-        print("dlint: give paths, --all, or --hlo FILE", file=sys.stderr)
+        print("dlint: give paths, --all, --changed, or --hlo FILE",
+              file=sys.stderr)
         return 2
 
-    findings = lint_paths(paths, rules=rules) if paths else []
-    for f in findings:
-        print(f.format())
+    run = run_lint(paths, rules=rules, only=only) if paths else None
+    findings = run.findings if run is not None else []
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings, root=repo)
+        print(f"dlint: baseline written to {args.write_baseline} "
+              f"({len(findings)} finding(s))", file=sys.stderr)
+        return 0
+
+    gated = findings
+    if args.baseline:
+        try:
+            known = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"dlint: cannot read baseline: {e}", file=sys.stderr)
+            return 2
+        gated = filter_new(findings, known, root=repo)
+
+    if args.fmt == "sarif":
+        print(json.dumps(to_sarif(gated, root=repo), indent=2,
+                         sort_keys=True))
+    else:
+        for f in gated:
+            print(f.format())
+
+    dead = run.dead_suppressions if run is not None else []
+    if args.report_suppressions:
+        for s in dead:
+            print(f"dead suppression: {s.format()}")
+        if not dead:
+            print("dlint: no dead suppressions", file=sys.stderr)
 
     hlo_bad = 0
     if args.hlo:
@@ -100,7 +198,9 @@ def main(argv=None):
             if out["ok"] is False:
                 hlo_bad += 1
 
-    n = len(findings) + hlo_bad
+    n = len(gated) + hlo_bad
+    if args.report_suppressions:
+        n += len(dead)
     if n:
         print(f"dlint: {n} finding(s)", file=sys.stderr)
         return 1
