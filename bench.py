@@ -464,6 +464,48 @@ def main():
     except Exception as e:  # never sink the headline metric
         record["fleet_gate_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # async-conveyor gate (docs/serving.md#the-fleet-across-hosts): with
+    # a canned 5 ms wire (InProcessTransport wire_delay_ms — the same
+    # frames/NACK protocol as the cross-host plane, latency included),
+    # the asynchronous conveyor's step-thread stall must be <= 0.5x the
+    # synchronous conveyor's on the same workload, the streams stay
+    # bitwise the single-engine reference, and the overlap fraction is
+    # recorded. Cross-process throughput itself stays an honest null
+    # off-TPU (two local processes on one CPU say nothing about DCN).
+    try:
+        from chainermn_tpu.fleet import InProcessTransport
+
+        def _conveyor(asynchronous):
+            dfl = DisaggregatedFleet(
+                Engine(lm, lp, _fleet_cfg()), Engine(lm, lp, _fleet_cfg()),
+                transport=InProcessTransport(wire_delay_ms=5.0),
+                async_conveyor=asynchronous, max_pending=2)
+            streams = [dfl.submit(p, max_new_tokens=n_new)
+                       for p in fleet_prompts]
+            dfl.run_until_drained()
+            if asynchronous:
+                dfl.close()
+            toks = [list(s.tokens) for s in streams]
+            return dfl.stats["stall_ms_total"], dfl.overlap_fraction, toks
+
+        sync_stall, _, sync_toks = _conveyor(False)
+        async_stall, overlap, async_toks = _conveyor(True)
+        stall_ratio = (async_stall / sync_stall if sync_stall > 0
+                       else float("inf"))
+        conveyor_bitwise = (sync_toks == fleet_ref
+                           and async_toks == fleet_ref)
+        record["fleet_conveyor_sync_ms"] = round(sync_stall, 3)
+        record["fleet_conveyor_async_stall_ms"] = round(async_stall, 3)
+        record["fleet_conveyor_stall_ratio"] = round(stall_ratio, 6)
+        record["fleet_transfer_overlap_fraction"] = round(overlap, 6)
+        record["fleet_cross_process_honest_null"] = (
+            jax.default_backend() != "tpu")
+        record["fleet_gate_ok"] = bool(record.get("fleet_gate_ok")
+                                       and conveyor_bitwise
+                                       and stall_ratio <= 0.5)
+    except Exception as e:  # never sink the headline metric
+        record["fleet_conveyor_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # async checkpoint plane gate
     # (docs/fault_tolerance.md#checkpoint-cadence), folded into the same
     # JSON line: the per-step stall of saving through

@@ -1340,3 +1340,133 @@ def check_asymmetric_tier_collective(tree, src, path) -> List[Finding]:
 
 register(Rule("DL112", "asymmetric-tier-collective", f"{_DOC}#dl112",
               check_asymmetric_tier_collective))
+
+
+# ---------------------------------------------------------------------------
+# DL117 — unbounded-retry-loop
+# ---------------------------------------------------------------------------
+
+#: callee names that mark one attempt against a remote peer — the
+#: RPC/transport operations a retry loop is presumably absorbing
+#: failures of (object-plane ops, coordinator KV primitives, generic
+#: wire verbs)
+_RETRY_RPC_CALLS = OBJ_PLANE_CALLS | {
+    "try_recv_obj", "blocking_key_value_get",
+    "blocking_key_value_get_bytes", "key_value_set",
+    "key_value_set_bytes", "wait_at_barrier", "send", "recv",
+    "rpc", "request", "urlopen",
+}
+
+#: calls that are bounding evidence on their own: the RpcPolicy retry
+#: ladder (a loop sleeping the jittered ladder is policy-driven)
+_BACKOFF_CALLS = {"backoff_ms", "backoffs_ms"}
+
+#: clock reads whose presence in the loop marks deadline math
+_CLOCK_CALLS = {"monotonic", "perf_counter"}
+
+#: name fragments that mark an attempt/deadline bound when they appear
+#: in a comparison inside the loop
+_BOUND_NAME_HINTS = ("deadline", "attempt", "budget", "waited",
+                     "remaining", "left", "tries", "retries")
+
+
+def _retry_handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """For DL117 a handler bounds the loop if ANY path through it
+    raises, returns, or breaks — each one exits the retry. Only a
+    handler that always falls back into the loop (``pass``/
+    ``continue``/log-and-go) swallows."""
+    for n in _walk_statements(handler.body):
+        if isinstance(n, (ast.Raise, ast.Return, ast.Break)):
+            return False
+    return True
+
+
+def _names_in(node: ast.AST):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            yield n.id
+        elif isinstance(n, ast.Attribute):
+            yield n.attr
+
+
+def _loop_is_bounded(loop: ast.While) -> bool:
+    """Bounding evidence anywhere in the loop body (over-approximate on
+    purpose — a misfire on bounded code is noise; the fix for a true
+    positive is mechanical): a policy backoff call, a clock read
+    (deadline math), or a comparison over an attempt/deadline-named
+    quantity."""
+    for n in _walk_excluding_defs(loop.body):
+        if isinstance(n, ast.Call):
+            name = _callee_name(n)
+            if name in _BACKOFF_CALLS or name in _CLOCK_CALLS:
+                return True
+        if isinstance(n, ast.Compare):
+            for name in _names_in(n):
+                if any(h in name.lower() for h in _BOUND_NAME_HINTS):
+                    return True
+    return False
+
+
+def check_unbounded_retry_loop(tree, src, path) -> List[Finding]:
+    """Retry-forever around an RPC/transport call.
+
+    The resilience discipline (docs/fault_tolerance.md): every retry
+    against a remote peer must be bounded by a deadline, an attempt
+    cap, or the :class:`~chainermn_tpu.resilience.policy.RpcPolicy`
+    backoff ladder — a bare ``while True: try: rpc() except: continue``
+    retries against a DEAD coordinator forever, which is exactly the
+    silent hang the watchdog/poison-key machinery exists to prevent.
+    Flagged shape: a ``while True``-style loop (constant-true
+    condition) whose try body calls an RPC/transport operation
+    (``send_obj``/``recv_obj``/``try_recv_obj``/KV-store primitives/
+    generic wire verbs) with a handler that always falls back into the
+    loop, and NO bounding evidence in the loop body.
+
+    NOT flagged: ``for`` loops and non-constant ``while`` conditions
+    (inherently bounded); handlers that raise/return/break on any path
+    (the exit is the bound); loops containing ``RpcPolicy.backoff_ms``/
+    ``backoffs_ms`` calls, a ``time.monotonic()``/``perf_counter()``
+    read (deadline math), or a comparison over an attempt/deadline-
+    named quantity. The fixed patterns are ``comm/object_plane.py``'s
+    ``_sliced_get`` (budget-sliced, raises on exhaustion) and
+    ``fleet/transport.py``'s ack wait (per-attempt ``handoff_ack_ms``
+    deadline under a ``max_attempts`` cap).
+    """
+    findings: List[Finding] = []
+    for loop in ast.walk(tree):
+        if not isinstance(loop, ast.While):
+            continue
+        test = loop.test
+        if not (isinstance(test, ast.Constant) and test.value):
+            continue                      # non-constant condition = bound
+        if _loop_is_bounded(loop):
+            continue
+        for node in _walk_excluding_defs(loop.body):
+            if not isinstance(node, ast.Try):
+                continue
+            if not any(_retry_handler_swallows(h) for h in node.handlers):
+                continue
+            for n in _walk_statements(node.body):
+                if not isinstance(n, ast.Call):
+                    continue
+                name = _callee_name(n)
+                if name not in _RETRY_RPC_CALLS:
+                    continue
+                findings.append(Finding(
+                    "DL117", path, n.lineno,
+                    f"'{name}' is retried in a 'while True' loop whose "
+                    "handler always falls back into the loop, with no "
+                    "deadline, attempt cap, or backoff in sight — "
+                    "against a dead peer this retries forever, the "
+                    "silent hang the fail-fast machinery exists to "
+                    "prevent. Bound it: slice the wait against an "
+                    "RpcPolicy budget and raise on exhaustion "
+                    "(comm/object_plane.py _sliced_get), or cap "
+                    "attempts with backoff_ms between re-sends "
+                    f"(fleet/transport.py) ({_DOC}#dl117)."))
+                break                     # one finding per try block
+    return findings
+
+
+register(Rule("DL117", "unbounded-retry-loop", f"{_DOC}#dl117",
+              check_unbounded_retry_loop))

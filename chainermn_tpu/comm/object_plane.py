@@ -237,6 +237,27 @@ class ObjectPlane:
         )
         return pickle.loads(data)
 
+    def try_recv_obj(self, src: int, tag: int = 0,
+                     timeout_ms: Optional[int] = None) -> Any:
+        """Bounded receive that leaves the channel position intact on
+        timeout. ``recv_obj`` increments the channel sequence *before*
+        the blocking get, so a timed-out wait would permanently desync
+        the channel (the next recv skips the object that eventually
+        lands). Pollers — ``fleet/transport.py`` ack/data loops — need
+        to come back later, so here the sequence is committed only when
+        the get succeeds; a miss raises ``TimeoutError`` and the next
+        call retries the SAME slot."""
+        if self.process_count == 1:
+            raise RuntimeError(
+                "try_recv_obj with a single process has no peer")
+        channel = f"p2p/{src}/{self.process_index}/{tag}"
+        seq = self._p2p_seq.get(channel, 0)
+        data = self._kv_get(
+            f"og/p2p/{src}/{self.process_index}/{tag}/{seq}",
+            timeout_ms=timeout_ms)
+        self._p2p_seq[channel] = seq + 1
+        return pickle.loads(data)
+
     # -- host barrier ----------------------------------------------------
 
     def barrier(self, timeout_ms: Optional[int] = None) -> None:
@@ -414,3 +435,120 @@ def _sliced_get(key: str, timeout_ms: int, raw: bool = False):
                 raise  # transport error: coordinator gone — fail fast
             waited += slice_ms
             _coordinator_alive()
+
+
+class FsObjectPlane:
+    """File-backed point-to-point object plane for supervised fleets.
+
+    The jax.distributed coordinator cannot re-admit a rank after SIGKILL
+    (the service pins membership at init), which rules the KV store out
+    as the wire for the supervised-restart drill: the whole point is
+    that a killed prefill host comes back under
+    :class:`~chainermn_tpu.resilience.supervisor.Supervisor` and keeps
+    shipping handoffs. This plane keeps the exact ``send_obj`` /
+    ``recv_obj`` / ``try_recv_obj`` surface but rides a shared
+    directory instead:
+
+    * one subdirectory per directed channel ``(src, dst, tag)``, one
+      file per message, named by sequence number;
+    * writes are atomic (tmp + ``os.replace``) so a reader can never
+      observe a torn message — a SIGKILL mid-write leaves only a tmp
+      file the reader ignores;
+    * the sender derives its next sequence from the files already on
+      disk, so a restarted incarnation continues the channel instead of
+      overwriting it (consumed files are never deleted — receiver
+      positions are process-local);
+    * every receive is deadline-sliced exactly like the KV-store path
+      (``TimeoutError`` on a miss; ``try_recv_obj`` commits the reader
+      position only on success).
+
+    Single-host scope: this is the test/drill wire for processes
+    sharing a filesystem, not a datacenter transport — the production
+    path is :class:`ObjectPlane` over the coordinator.
+    """
+
+    def __init__(self, root: str, index: int, count: int) -> None:
+        import os as _os
+
+        self.root = root
+        self.process_index = int(index)
+        self.process_count = int(count)
+        self._recv_pos: dict = {}
+        _os.makedirs(root, exist_ok=True)
+
+    def _chan_dir(self, src: int, dst: int, tag: int) -> str:
+        import os as _os
+
+        return _os.path.join(self.root, f"p2p_{src}_{dst}_{tag}")
+
+    @staticmethod
+    def _on_disk(chan_dir: str) -> int:
+        """Messages already published on a channel (restart-safe seq)."""
+        import os as _os
+
+        try:
+            names = _os.listdir(chan_dir)
+        except FileNotFoundError:
+            return 0
+        return sum(1 for n in names if n.endswith(".obj"))
+
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        import os as _os
+        import tempfile
+
+        chan = self._chan_dir(self.process_index, dest, tag)
+        _os.makedirs(chan, exist_ok=True)
+        seq = self._on_disk(chan)
+        fd, tmp = tempfile.mkstemp(dir=chan, suffix=".tmp")
+        try:
+            with _os.fdopen(fd, "wb") as f:
+                f.write(pickle.dumps(obj))
+                f.flush()
+                _os.fsync(f.fileno())
+            _os.replace(tmp, _os.path.join(chan, f"{seq:08d}.obj"))
+        except BaseException:
+            try:
+                _os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _read_at(self, src: int, tag: int, seq: int,
+                 timeout_ms: Optional[int]) -> bytes:
+        import os as _os
+
+        pol = _rpc_policy()
+        if timeout_ms is None:
+            timeout_ms = pol.timeout_ms
+        path = _os.path.join(self._chan_dir(src, self.process_index, tag),
+                             f"{seq:08d}.obj")
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            try:
+                with open(path, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                pass
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise TimeoutError(
+                    f"object {path!r} not published within {timeout_ms} ms")
+            # poll fast: the drill ships small frames on localhost, and a
+            # probe-sliced sleep would add whole probe windows of latency
+            time.sleep(min(left, 0.005))
+
+    def recv_obj(self, src: int, tag: int = 0) -> Any:
+        chan = (src, tag)
+        seq = self._recv_pos.get(chan, 0)
+        self._recv_pos[chan] = seq + 1
+        return pickle.loads(self._read_at(src, tag, seq, None))
+
+    def try_recv_obj(self, src: int, tag: int = 0,
+                     timeout_ms: Optional[int] = None) -> Any:
+        """Bounded receive; the reader position advances only on
+        success, so a timed-out poll retries the same slot later."""
+        chan = (src, tag)
+        seq = self._recv_pos.get(chan, 0)
+        data = self._read_at(src, tag, seq, timeout_ms)
+        self._recv_pos[chan] = seq + 1
+        return pickle.loads(data)

@@ -9,7 +9,10 @@ Two composition patterns over ``serving.Engine``:
 * **Disaggregated** (``pools.DisaggregatedFleet``): a prefill pool
   runs ``prefill_chunk`` to completion and hands populated KV slots to
   a decode pool through the manifest-versioned ``handoff`` codec (raw
-  f32 — bitwise — or blockwise int8 at ~0.254× the wire bytes).
+  f32 — bitwise — or blockwise int8 at ~0.254× the wire bytes), over a
+  ``transport`` (in-process queue pair, or seq/SHA-framed object-plane
+  frames between real processes) — synchronously or on the async
+  conveyor's bounded worker queue.
 
 ``reports.FleetReport`` aggregates per-replica telemetry honestly
 (pooled-sample percentiles, token-weighted ratios); ``health.
@@ -25,6 +28,10 @@ from chainermn_tpu.fleet.pools import (DecodePool, DisaggregatedFleet,
                                        PrefillPool, Stream)
 from chainermn_tpu.fleet.reports import FleetReport
 from chainermn_tpu.fleet.router import EngineReplica, Router
+from chainermn_tpu.fleet.transport import (Arrival, InProcessTransport,
+                                           LoopbackPlane,
+                                           ObjectPlaneTransport,
+                                           TransportError)
 
 __all__ = [
     "HandoffError", "encode_handoff", "decode_handoff",
@@ -32,4 +39,6 @@ __all__ = [
     "FleetHealth", "FleetReport",
     "Stream", "PrefillPool", "DecodePool", "DisaggregatedFleet",
     "EngineReplica", "Router",
+    "TransportError", "Arrival", "InProcessTransport",
+    "ObjectPlaneTransport", "LoopbackPlane",
 ]

@@ -9,28 +9,51 @@ for every active decode stream. Here the split is explicit:
   completion with ``max_new_tokens=1, hold=True`` — the first token is
   sampled on device and the finished slot PARKS (``Engine.held``: KV
   rows, cursor, and post-split PRNG key stay bound) instead of
-  retiring.
+  retiring. The slot stays held until the TRANSPORT reports a terminal
+  status for its handoff (deferred release), so an aborted transfer
+  can still fall back cleanly.
 * :class:`DecodePool` owns an engine that adopts exported slots
   (``Engine.import_handoff``) and decodes them to termination.
-* :class:`DisaggregatedFleet` is the synchronous conveyor between
-  them: every held prefill slot is exported, serialized through the
+* :class:`DisaggregatedFleet` is the conveyor between them. Every held
+  prefill slot is exported, serialized through the
   :mod:`~chainermn_tpu.fleet.handoff` codec (``wire_format`` — ``f32``
-  raw or ``int8-block``), passed through the chaos fault plane
-  (``corrupt_handoff`` mutates the wire bytes exactly like a torn
-  interconnect), and placed on the decode engine.
+  raw or ``int8-block``), and shipped over a
+  :mod:`~chainermn_tpu.fleet.transport` — seq-numbered, SHA-verified
+  frames with NACK → bounded re-send, the layer the wire-level chaos
+  faults (drop/delay/dup/corrupt/truncate) tear at.
+
+Two conveyor disciplines:
+
+* **synchronous** (default) — ``step()`` does export → send → place
+  inline; the step thread pays every wire millisecond. Simple, and the
+  bitwise reference the async path is checked against.
+* **asynchronous** (``async_conveyor=True``) — encode+send move onto a
+  bounded worker queue (the ``AsyncSnapshotPlane`` double-buffer
+  discipline, checkpointing/async_plane.py) so the wire overlaps
+  decode steps. Engine calls — export, release, import — STAY on the
+  step thread (the engine is not thread-safe and ``_decode`` iterates
+  ``held``); only serialization and transport ride the worker.
+  ``backpressure="block"`` stalls the step thread when ``max_pending``
+  transfers are queued; ``"skip"`` leaves the slot held and retries
+  next step (counted in ``stats["skipped"]``). ``drain(deadline_s=)``
+  bounds shutdown; worker errors surface on the next ``step()``.
 
 Contracts the tests pin: raw-format streams are BITWISE-identical to
 the single-engine path (export → import is exact f32 bytes and the PRNG
-key continues, never re-derives); a handoff that fails verification
-(:class:`~chainermn_tpu.fleet.handoff.HandoffError`) falls back to a
-CLEAN re-prefill of the full prompt on the decode engine — same seed,
+key continues, never re-derives) in BOTH conveyor modes; a handoff the
+transport cannot deliver intact within its attempt budget falls back to
+a CLEAN re-prefill of the full prompt on the decode engine — same seed,
 so the one-split-per-token contract replays the identical stream — and
 never a poisoned slot.
 """
 
 from __future__ import annotations
 
+import collections
 import itertools
+import queue
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,7 +61,7 @@ import numpy as np
 from chainermn_tpu.fleet.handoff import (HandoffError, decode_handoff,
                                          encode_handoff)
 from chainermn_tpu.fleet.reports import FleetReport
-from chainermn_tpu.resilience import chaos
+from chainermn_tpu.fleet.transport import InProcessTransport
 
 __all__ = ["Stream", "PrefillPool", "DecodePool", "DisaggregatedFleet"]
 
@@ -92,11 +115,21 @@ class PrefillPool:
         return [(self._by_id[r.request_id], r) for r in reqs]
 
     def export(self, req) -> dict:
-        """Export + release one held slot; returns the handoff dict."""
-        handoff = self.engine.export_handoff(req)
-        self.engine.release_held(req)
+        """Export one held slot (a pure read of device state). The slot
+        STAYS held until :meth:`release` — deferred so the conveyor can
+        wait for the transport's terminal status and, on an aborted
+        transfer, release with the abort accounted."""
+        return self.engine.export_handoff(req)
+
+    def release(self, req, aborted: bool = False) -> None:
+        """Release a held slot whose handoff reached a terminal
+        transport status (``adopted``/``duplicate`` → clean retire;
+        ``failed`` → aborted retire, the receiver re-prefills)."""
+        if aborted:
+            self.engine.abort_held(req)
+        else:
+            self.engine.release_held(req)
         self._by_id.pop(req.request_id, None)
-        return handoff
 
 
 class DecodePool:
@@ -118,10 +151,10 @@ class DecodePool:
         self._inflight.append((req, stream))
 
     def fallback(self, stream: Stream) -> None:
-        """Handoff failed verification → CLEAN re-prefill of the full
-        prompt on this engine. Same seed, so the per-token key-split
-        contract replays the identical stream; the suspect bytes never
-        touch a slot."""
+        """Handoff failed verification or delivery → CLEAN re-prefill
+        of the full prompt on this engine. Same seed, so the per-token
+        key-split contract replays the identical stream; the suspect
+        bytes never touch a slot."""
         req = self.engine.submit(stream.prompt,
                                  max_new_tokens=stream.max_new_tokens,
                                  **stream.kw)
@@ -146,23 +179,61 @@ class DecodePool:
 
 
 class DisaggregatedFleet:
-    """The synchronous conveyor: submit → prefill → handoff → decode.
+    """The conveyor: submit → prefill → handoff transport → decode.
 
     ``wire_format`` picks the handoff codec (``"f32"`` raw/bitwise,
     ``"int8-block"`` quantized at ~0.254× the wire bytes); ``report``
     accumulates the fleet counters (handoffs, wire bytes by format,
-    fallbacks) that ``bench.py``'s fleet gate reads.
+    fallbacks) that ``bench.py``'s fleet gate reads; ``transport``
+    defaults to an :class:`~chainermn_tpu.fleet.transport.
+    InProcessTransport` (pass one with ``wire_delay_ms`` to model DCN
+    latency, or wire the pools across processes via
+    ``tools/fleet_lm.py --hosts``).
+
+    With ``async_conveyor=True`` the encode+send leg runs on a worker
+    thread behind a bounded queue — see the module docstring for the
+    threading discipline and backpressure semantics. ``stats`` then
+    separates ``stall_ms_total`` (step-thread time lost to the
+    conveyor) from ``transfer_ms_total`` (worker wall-time on the
+    wire); their ratio is :attr:`overlap_fraction`. The synchronous
+    conveyor books every transfer millisecond as stall — by
+    construction its overlap is 0.
     """
+
+    _POLL_S = 0.05
 
     def __init__(self, prefill_engine, decode_engine, *,
                  wire_format: str = "f32",
-                 report: Optional[FleetReport] = None):
+                 report: Optional[FleetReport] = None,
+                 transport=None,
+                 async_conveyor: bool = False,
+                 max_pending: int = 2,
+                 backpressure: str = "block"):
+        if backpressure not in ("block", "skip"):
+            raise ValueError(
+                f"backpressure must be 'block' or 'skip': {backpressure!r}")
         self.prefill = PrefillPool(prefill_engine)
         self.decode = DecodePool(decode_engine)
         self.wire_format = wire_format
         self.report = report or FleetReport()
+        self.transport = transport or InProcessTransport()
+        self.async_conveyor = bool(async_conveyor)
+        self.backpressure = backpressure
         self._ids = itertools.count()
         self.streams: List[Stream] = []
+        self._by_sid: Dict[int, Stream] = {}
+        self._pending_place: list = []        # verified Arrivals, no room yet
+        self.stats = {"transfers": 0, "skipped": 0,
+                      "stall_ms_total": 0.0, "transfer_ms_total": 0.0}
+        if self.async_conveyor:
+            self._q: queue.Queue = queue.Queue(max(1, int(max_pending)))
+            self._inflight: Dict[int, object] = {}   # sid → held req
+            self._done: collections.deque = collections.deque()
+            self._error: Optional[BaseException] = None
+            self._stop = threading.Event()
+            self._worker = threading.Thread(
+                target=self._run_worker, name="fleet-conveyor", daemon=True)
+            self._worker.start()
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                **kw) -> Stream:
@@ -170,13 +241,53 @@ class DisaggregatedFleet:
                else self.prefill.engine.config.max_new_tokens)
         stream = Stream(next(self._ids), prompt, mnt, kw)
         self.streams.append(stream)
+        self._by_sid[stream.stream_id] = stream
         self.prefill.submit(stream)
         return stream
 
+    # -- arrivals (both modes; step thread only) -------------------------
+
+    def _pump_arrivals(self) -> None:
+        self._pending_place.extend(self.transport.poll())
+
+    def _place(self) -> bool:
+        """Adopt or fall back every buffered arrival the decode pool
+        has room for (fallback re-submits through the engine queue, so
+        it never needs a free slot up front)."""
+        placed = False
+        still = []
+        for arr in self._pending_place:
+            stream = self._by_sid.get(arr.stream_id)
+            if stream is None:
+                continue          # fenced/unknown stream: nothing to do
+            if arr.failed:
+                self.report.record_fallback()
+                self.decode.fallback(stream)
+                placed = True
+                continue
+            if not self.decode.has_room():
+                still.append(arr)
+                continue
+            try:
+                self.decode.place(stream,
+                                  decode_handoff(arr.manifest, arr.blob))
+            except HandoffError:
+                # wire-verified but structurally unusable (format skew):
+                # same clean-re-prefill answer as a failed delivery
+                self.report.record_fallback()
+                self.decode.fallback(stream)
+            placed = True
+        self._pending_place = still
+        return placed
+
+    # -- synchronous conveyor --------------------------------------------
+
     def _transfer(self) -> bool:
         """Move every exportable held slot the decode pool has room
-        for: export → encode → (chaos fault plane) → verify → place,
-        with :class:`HandoffError` answered by a clean re-prefill."""
+        for: export → encode → transport (seq/SHA frames, bounded
+        re-send) → place, with delivery failure answered by a clean
+        re-prefill. The step thread pays the wire inline — all of it
+        booked as stall so the async path has an honest baseline."""
         moved = False
         for stream, req in self.prefill.ready():
             if not self.decode.has_room():
@@ -184,28 +295,165 @@ class DisaggregatedFleet:
             handoff = self.prefill.export(req)
             manifest, blob = encode_handoff(handoff, self.wire_format)
             self.report.record_handoff(self.wire_format, len(blob))
-            # the wire: corrupt_handoff faults tear/flip bytes HERE,
-            # between the sender's digest and the receiver's check
-            blob = chaos.on_handoff(blob)
-            try:
-                self.decode.place(stream, decode_handoff(manifest, blob))
-            except HandoffError:
-                self.report.record_fallback()
-                self.decode.fallback(stream)
+            t0 = time.monotonic()
+            status = self.transport.send(stream.stream_id, manifest, blob)
+            spent_ms = (time.monotonic() - t0) * 1000.0
+            self.stats["transfer_ms_total"] += spent_ms
+            self.stats["stall_ms_total"] += spent_ms
+            self.stats["transfers"] += 1
+            self.prefill.release(req, aborted=(status == "failed"))
+            # place immediately so has_room stays accurate for the next
+            # held slot in this same pass
+            self._pump_arrivals()
+            self._place()
             moved = True
         return moved
 
+    # -- asynchronous conveyor -------------------------------------------
+
+    def _run_worker(self) -> None:
+        """Worker leg: serialize + ship. No engine calls here — the
+        handoff dict was exported on the step thread; errors are
+        captured and re-raised from the next ``step()``."""
+        while not self._stop.is_set():
+            try:
+                sid, handoff = self._q.get(timeout=self._POLL_S)
+            except queue.Empty:
+                continue
+            try:
+                manifest, blob = encode_handoff(handoff, self.wire_format)
+                self.report.record_handoff(self.wire_format, len(blob))
+                t0 = time.monotonic()
+                status = self.transport.send(sid, manifest, blob)
+                self.stats["transfer_ms_total"] += (
+                    (time.monotonic() - t0) * 1000.0)
+                self._done.append((sid, status))
+            except BaseException as e:  # noqa: BLE001 — surfaced in step()
+                if self._error is None:
+                    self._error = e
+                self._done.append((sid, "failed"))
+            finally:
+                self.stats["transfers"] += 1
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            e, self._error = self._error, None
+            raise RuntimeError("async conveyor transfer failed") from e
+
+    def _offer(self) -> bool:
+        """Export ready held slots on the step thread and hand them to
+        the worker. ``skip`` backpressure leaves the slot held on a
+        full queue (it re-offers next step); ``block`` waits — that
+        wait is the only stall the async conveyor books."""
+        offered = False
+        for stream, req in self.prefill.ready():
+            sid = stream.stream_id
+            if sid in self._inflight:
+                continue           # already on the wire; release pending
+            if self.backpressure == "skip" and self._q.full():
+                self.stats["skipped"] += 1
+                break
+            handoff = self.prefill.export(req)
+            if self.backpressure == "skip":
+                try:
+                    self._q.put_nowait((sid, handoff))
+                except queue.Full:  # raced the check above: same answer
+                    self.stats["skipped"] += 1
+                    break
+            else:
+                t0 = time.monotonic()
+                while True:
+                    self._raise_pending()   # a dead worker never drains
+                    try:
+                        self._q.put((sid, handoff), timeout=self._POLL_S)
+                        break
+                    except queue.Full:
+                        continue
+                self.stats["stall_ms_total"] += (
+                    (time.monotonic() - t0) * 1000.0)
+            self._inflight[sid] = req
+            offered = True
+        return offered
+
+    def _reap(self) -> bool:
+        """Release held slots whose transfers reached a terminal
+        status (step thread — the engine's held map is not safe to
+        mutate from the worker)."""
+        reaped = False
+        while self._done:
+            sid, status = self._done.popleft()
+            req = self._inflight.pop(sid, None)
+            if req is not None:
+                self.prefill.release(req, aborted=(status == "failed"))
+            reaped = True
+        return reaped
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Wait for every queued and in-flight transfer to reach a
+        terminal transport status, then reap and place. ``deadline_s``
+        is seconds from now; a missed deadline returns ``False`` (never
+        raises for lateness — mirror of ``AsyncSnapshotPlane.drain``).
+        Synchronous conveyors have nothing in flight: always ``True``."""
+        if not self.async_conveyor:
+            return True
+        deadline = (None if deadline_s is None
+                    else time.monotonic() + float(deadline_s))
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                if deadline is None:
+                    self._q.all_tasks_done.wait()
+                    continue
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._q.all_tasks_done.wait(timeout=left)
+        self._reap()
+        self._pump_arrivals()
+        self._place()
+        return True
+
+    def close(self) -> None:
+        """Drain outstanding transfers and stop the worker. Idempotent;
+        a closed fleet can still ``step()`` its engines (the conveyor
+        leg is simply empty)."""
+        if not self.async_conveyor or self._stop.is_set():
+            return
+        self._q.join()
+        self._stop.set()
+        self._worker.join()
+        self._reap()
+
+    # -- the conveyor loop ------------------------------------------------
+
     def step(self) -> bool:
         """One conveyor iteration; returns whether anything advanced."""
+        if not self.async_conveyor:
+            worked = self.prefill.step()
+            worked = self._transfer() or worked
+            self._pump_arrivals()
+            worked = self._place() or worked
+            worked = self.decode.step() or worked
+            return worked
+        self._raise_pending()
         worked = self.prefill.step()
-        worked = self._transfer() or worked
+        worked = self._reap() or worked
+        worked = self._offer() or worked
+        self._pump_arrivals()
+        worked = self._place() or worked
         worked = self.decode.step() or worked
         return worked
 
     def idle(self) -> bool:
-        return (self.prefill.engine.idle()
-                and not self.prefill.engine.held
-                and self.decode.engine.idle())
+        if (not self.prefill.engine.idle()
+                or self.prefill.engine.held
+                or not self.decode.engine.idle()
+                or self._pending_place):
+            return False
+        if self.async_conveyor and (self._inflight or self._done
+                                    or self._q.unfinished_tasks):
+            return False
+        return True
 
     def run_until_drained(self, max_steps: int = 100_000) -> int:
         n = 0
@@ -214,9 +462,22 @@ class DisaggregatedFleet:
                 raise RuntimeError(
                     f"fleet failed to drain within {max_steps} steps")
             # each engine step syncs internally (int32 token pulls)
-            self.step()  # dlint: disable=DL104
+            worked = self.step()  # dlint: disable=DL104
+            if not worked and self.async_conveyor:
+                time.sleep(0.001)   # transfer in flight: yield to worker
             n += 1
         return n
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Fraction of wire wall-time hidden behind decode steps:
+        ``1 − stall/transfer`` clamped to [0, 1]. The synchronous
+        conveyor books stall == transfer, so it reads 0."""
+        xfer = self.stats["transfer_ms_total"]
+        if xfer <= 0:
+            return 0.0
+        return max(0.0, min(1.0,
+                            1.0 - self.stats["stall_ms_total"] / xfer))
 
     def reports(self):
         return [self.prefill.engine.report, self.decode.engine.report]

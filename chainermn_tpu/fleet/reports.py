@@ -74,6 +74,62 @@ class FleetReport:
         self.handoff_fallbacks += 1
 
     # ----------------------------------------------------------------
+    # wire serialization (cross-process fleet merge)
+    # ----------------------------------------------------------------
+
+    #: bump on any change to the counter schema below
+    WIRE_VERSION = 1
+
+    def to_wire(self) -> dict:
+        """Version-tagged JSON-safe envelope of the fleet counters —
+        a cross-process host ships this home next to its
+        ``ServingReport.to_wire()`` blocks; the merging side rebuilds
+        with :meth:`from_wire` and folds hosts together with
+        :meth:`absorb`. Round-trip is exact (ints only)."""
+        return {"version": self.WIRE_VERSION, "kind": "fleet_report",
+                "counters": {
+                    "rejected": self.rejected,
+                    "requeued": self.requeued,
+                    "replicas_dead": self.replicas_dead,
+                    "handoffs": self.handoffs,
+                    "handoff_fallbacks": self.handoff_fallbacks,
+                    "handoff_wire_bytes": dict(self.handoff_wire_bytes),
+                }}
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "FleetReport":
+        if not isinstance(wire, dict) or wire.get("kind") != "fleet_report":
+            raise ValueError(
+                f"not a fleet_report envelope: {type(wire).__name__}")
+        if wire.get("version") != cls.WIRE_VERSION:
+            raise ValueError(
+                f"fleet_report wire version {wire.get('version')!r} "
+                f"!= {cls.WIRE_VERSION} (mixed-version fleet?)")
+        c = wire["counters"]
+        out = cls()
+        out.rejected = int(c["rejected"])
+        out.requeued = int(c["requeued"])
+        out.replicas_dead = int(c["replicas_dead"])
+        out.handoffs = int(c["handoffs"])
+        out.handoff_fallbacks = int(c["handoff_fallbacks"])
+        out.handoff_wire_bytes = {str(k): int(v) for k, v
+                                  in c["handoff_wire_bytes"].items()}
+        return out
+
+    def absorb(self, other: "FleetReport") -> None:
+        """Fold another host's counters into this report (merge of the
+        routing-layer tallies; the sample-level merge stays in
+        :meth:`merge`, fed by each host's serving reports)."""
+        self.rejected += other.rejected
+        self.requeued += other.requeued
+        self.replicas_dead += other.replicas_dead
+        self.handoffs += other.handoffs
+        self.handoff_fallbacks += other.handoff_fallbacks
+        for fmt, nbytes in other.handoff_wire_bytes.items():
+            self.handoff_wire_bytes[fmt] = (
+                self.handoff_wire_bytes.get(fmt, 0) + int(nbytes))
+
+    # ----------------------------------------------------------------
     # aggregation
     # ----------------------------------------------------------------
 

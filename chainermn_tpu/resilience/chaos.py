@@ -24,7 +24,15 @@ deterministic schedule, so the suite can prove the stack survives them:
   drill uses: futures stay unresolved, survivors must absorb the work);
 * ``corrupt_handoff`` — damage a prefill→decode KV handoff blob on the
   wire (flip or truncate), which the decode pool's manifest verification
-  must catch and answer with a clean re-prefill.
+  must catch and answer with a clean re-prefill;
+* ``drop_handoff`` / ``delay_handoff`` / ``dup_handoff`` — wire-level
+  delivery faults for the fleet transport (:func:`on_wire`): a frame
+  vanishes, arrives late, or arrives twice. The transport's sequence
+  numbers + SHA-verified frames + bounded NACK/re-send protocol must
+  end every case in exact adoption or a clean re-prefill — never a
+  poisoned decode slot or a duplicated token. All wire faults accept
+  ``times=N`` (fire at most N times) so a drill can damage exactly one
+  delivery attempt and let the re-send heal.
 
 Faults can be pinned to one supervised incarnation with ``run=K``: the
 supervisor (:mod:`chainermn_tpu.resilience.supervisor`) exports
@@ -106,16 +114,30 @@ FAULT_KINDS: Dict[str, str] = {
                      "step=N[,replica=R|*][,rank=R|*]"),
     "corrupt_handoff": ("damage a prefill→decode KV handoff on the "
                         "wire (flip 64 bytes at offset, or truncate "
-                        "when keep= is given — the decode pool must "
-                        "fall back to a clean re-prefill): "
-                        "[offset=O][,keep=BYTES][,after=K][,prob=P]"
-                        "[,seed=S][,rank=R|*]"),
+                        "when keep= is given — the transport must NACK "
+                        "and the decode pool must fall back to a clean "
+                        "re-prefill): [offset=O][,keep=BYTES][,after=K]"
+                        "[,times=N][,prob=P][,seed=S][,rank=R|*]"),
+    "drop_handoff": ("swallow a handoff frame on the wire (the sender's "
+                     "RpcPolicy-bounded ack wait must notice and "
+                     "re-send; an unbounded drop must end in a clean "
+                     "re-prefill): [times=N][,after=K][,prob=P]"
+                     "[,seed=S][,rank=R|*]"),
+    "delay_handoff": ("hold a handoff frame in flight for ms= before "
+                      "delivery (a congested DCN link — late frames "
+                      "past the receiver's deadline must be fenced "
+                      "out as duplicates): ms=M[,times=N][,after=K]"
+                      "[,prob=P][,seed=S][,rank=R|*]"),
+    "dup_handoff": ("deliver a handoff frame twice (the receiver must "
+                    "dedup by stream — a double adoption would emit "
+                    "duplicated tokens): [times=N][,after=K][,prob=P]"
+                    "[,seed=S][,rank=R|*]"),
 }
 
 #: every fault kind also accepts ``run=K`` — fire only in supervised
 #: incarnation K ($CHAINERMN_TPU_RESTART_COUNT, 0 when unsupervised)
 _INT_KEYS = {"step", "ms", "offset", "keep", "after", "seed", "run",
-             "replica"}
+             "replica", "times"}
 _FLOAT_KEYS = {"prob"}
 
 
@@ -133,6 +155,7 @@ class Fault:
     offset: int = 0
     keep: Optional[int] = None
     after: int = 0
+    times: Optional[int] = None         # fire at most N times (wire faults)
     run: Optional[int] = None           # None = every incarnation
     replica: Optional[int] = None       # None = every replica ('*')
     fired: int = field(default=0, repr=False)
@@ -164,7 +187,7 @@ class Fault:
         --dry-run listing)."""
         parts = []
         for name in ("step", "signal", "op", "ms", "prob", "seed",
-                     "match", "offset", "keep", "after", "run",
+                     "match", "offset", "keep", "after", "times", "run",
                      "replica"):
             val = getattr(self, name)
             if val is None:
@@ -224,8 +247,11 @@ def parse_spec(spec: str) -> List[Fault]:
             raise ValueError(
                 f"{fault.kind} fault needs match=SUBSTRING: {clause!r}")
         if (fault.kind in ("delay_rpc", "slow_disk", "slow_offload",
-                           "stall_writer") and fault.ms is None):
+                           "stall_writer", "delay_handoff")
+                and fault.ms is None):
             raise ValueError(f"{fault.kind} fault needs ms=M: {clause!r}")
+        if fault.times is not None and fault.times <= 0:
+            raise ValueError(f"times must be positive: {clause!r}")
         if not (0.0 <= fault.prob <= 1.0):
             raise ValueError(f"prob must be in [0, 1]: {clause!r}")
         faults.append(fault)
@@ -396,36 +422,80 @@ class ChaosPlan:
             return True
         return False
 
+    def _damage_handoff(self, f: Fault, data: bytes) -> bytes:
+        """Apply one fired ``corrupt_handoff``: truncate to ``keep``
+        bytes, or XOR-flip 64 bytes at ``offset``."""
+        if f.keep is not None:
+            self.log.append(f"corrupt_handoff keep={f.keep}")
+            return data[:max(0, f.keep)]
+        self.log.append(f"corrupt_handoff offset={f.offset}")
+        buf = bytearray(data)
+        end = min(len(buf), f.offset + 64)
+        for i in range(f.offset, end):
+            buf[i] ^= 0xFF
+        return bytes(buf)
+
+    def _wire_gate(self, f: Fault, rank: Optional[int]) -> bool:
+        """Shared fire/skip decision for the wire faults: rank + run +
+        ``after=`` skip window + ``times=`` fire cap + probability."""
+        if not f.applies_to_rank(rank) or not f.applies_to_run():
+            return False
+        if f.times is not None and f.fired >= f.times:
+            return False
+        if f._skipped < f.after:
+            f._skipped += 1
+            return False
+        return f.roll()
+
     def on_handoff(self, data: bytes,
                    rank: Optional[int] = None) -> bytes:
-        """KV-handoff wire hook (fleet/pools.py, between encode and
-        decode): ``corrupt_handoff`` returns a damaged copy — 64 bytes
-        XOR-flipped at ``offset``, or the blob truncated to ``keep``
-        bytes. The decode side's manifest verification must catch it
-        and fall back to a clean re-prefill."""
+        """KV-handoff byte hook (legacy single-blob form of
+        :meth:`on_wire`): ``corrupt_handoff`` returns a damaged copy —
+        64 bytes XOR-flipped at ``offset``, or the blob truncated to
+        ``keep`` bytes. The decode side's manifest verification must
+        catch it and fall back to a clean re-prefill."""
         rank = _own_rank() if rank is None else rank
         for f in self.faults:
             if f.kind != "corrupt_handoff":
                 continue
-            if not f.applies_to_rank(rank) or not f.applies_to_run():
-                continue
-            if f._skipped < f.after:
-                f._skipped += 1
-                continue
-            if not f.roll():
+            if not self._wire_gate(f, rank):
                 continue
             f.fired += 1
-            if f.keep is not None:
-                self.log.append(f"corrupt_handoff keep={f.keep}")
-                data = data[:max(0, f.keep)]
-            else:
-                self.log.append(f"corrupt_handoff offset={f.offset}")
-                buf = bytearray(data)
-                end = min(len(buf), f.offset + 64)
-                for i in range(f.offset, end):
-                    buf[i] ^= 0xFF
-                data = bytes(buf)
+            data = self._damage_handoff(f, data)
         return data
+
+    def on_wire(self, data: bytes,
+                rank: Optional[int] = None) -> tuple:
+        """Transport wire hook (fleet/transport.py, once per delivery
+        ATTEMPT — a re-send rolls the faults again): returns
+        ``(verdict, data)`` with verdict ``"deliver"``, ``"drop"`` (the
+        frame vanishes; the sender's RpcPolicy-bounded ack wait must
+        notice and re-send), or ``"dup"`` (the frame arrives twice; the
+        receiver must dedup by stream). ``delay_handoff`` sleeps the
+        frame in flight, ``corrupt_handoff`` damages the returned
+        bytes. Wire faults honour ``times=N`` so a drill can drop
+        exactly one attempt and let the re-send heal."""
+        rank = _own_rank() if rank is None else rank
+        verdict = "deliver"
+        for f in self.faults:
+            if f.kind not in ("drop_handoff", "delay_handoff",
+                              "dup_handoff", "corrupt_handoff"):
+                continue
+            if not self._wire_gate(f, rank):
+                continue
+            f.fired += 1
+            if f.kind == "drop_handoff":
+                self.log.append("drop_handoff")
+                return ("drop", data)
+            if f.kind == "delay_handoff":
+                self.log.append(f"delay_handoff ms={f.ms}")
+                self._sleep((f.ms or 0) / 1000.0)
+            elif f.kind == "dup_handoff":
+                self.log.append("dup_handoff")
+                verdict = "dup"
+            else:
+                data = self._damage_handoff(f, data)
+        return (verdict, data)
 
     #: pipeline stage → fault kind for :meth:`on_offload`
     _OFFLOAD_STAGES = {"offload": "slow_offload", "writer": "stall_writer"}
@@ -528,3 +598,11 @@ def on_handoff(data: bytes) -> bytes:
         if plan is not None:
             return plan.on_handoff(data)
     return data
+
+
+def on_wire(data: bytes) -> tuple:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            return plan.on_wire(data)
+    return ("deliver", data)

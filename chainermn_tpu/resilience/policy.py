@@ -106,6 +106,13 @@ class RpcPolicy:
         of the payload budget, floored at one probe slice."""
         return max(self.probe_ms, self.timeout_ms // 10)
 
+    def handoff_ack_ms(self) -> int:
+        """Per-attempt budget for one handoff-frame acknowledgement
+        (fleet/transport.py). One probe slice: an unacked frame should
+        re-send in O(probe), not ride out the full payload timeout —
+        the re-send itself is bounded by the transport's attempt cap."""
+        return max(1, self.probe_ms)
+
     def put_budget_ms(self, nchunks: int) -> int:
         """Budget for a chunked KV put — scales with payload so multi-GB
         scatters aren't cut off (one probe slice of headroom per chunk)."""

@@ -67,6 +67,21 @@ BUDGET_EXHAUSTED_EXIT_CODE = 112
 RESTART_COUNT_ENV = "CHAINERMN_TPU_RESTART_COUNT"
 
 
+def restart_count() -> int:
+    """This process's supervised-incarnation number: 0 on the first
+    launch (or when unsupervised). Scripts key per-incarnation
+    artifacts off this — e.g. ``tools/fleet_lm.py --hosts`` names each
+    incarnation's JSONL part file with it, so a restart NEVER appends
+    to a file a SIGKILL may have left with a torn trailing line."""
+    raw = os.environ.get(RESTART_COUNT_ENV)
+    if raw is not None:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return 0
+
+
 def classify_exit(returncode: int) -> str:
     """One of ``clean`` / ``preempted`` / ``aborted`` / ``crash``.
 

@@ -241,18 +241,31 @@ class Engine:
         """Terminal-by-budget request parks in 'held' instead of
         retiring: the slot stays bound (its KV rows, cursor, and PRNG
         key intact) until ``export_handoff`` + ``release_held`` — the
-        prefill side of the disaggregated fleet (fleet/pools.py)."""
+        prefill side of the disaggregated fleet (fleet/pools.py). The
+        conveyor defers the release until the handoff TRANSPORT reports
+        a terminal status, so a slot may stay held across many engine
+        steps while its bytes are in flight — ``export_handoff`` is a
+        pure read precisely so that window is harmless."""
         req.state = "held"
         self.active.pop(req.slot, None)
         self.prefilling.pop(req.slot, None)
         self.held[req.slot] = req
 
     def release_held(self, req: Request, aborted: bool = False) -> None:
-        """Free a held request's slot (after ``export_handoff``)."""
+        """Free a held request's slot (after ``export_handoff`` reached
+        a terminal outcome — adopted by a peer, or abandoned)."""
         if req.state != "held" or self.held.get(req.slot) is not req:
             raise ValueError(
                 f"request {req.request_id} is not held by this engine")
         self._retire(req, aborted=aborted)
+
+    def abort_held(self, req: Request) -> None:
+        """Release a held slot whose handoff could NOT be delivered
+        (transport attempt budget exhausted): the slot frees cleanly,
+        the retire is counted as an abort, and the receiver's clean
+        re-prefill owns the stream from here — this engine must not
+        keep decoding it."""
+        self.release_held(req, aborted=True)
 
     def export_handoff(self, req: Request) -> dict:
         """Package a HELD request's device state for a decode replica:

@@ -17,7 +17,13 @@ import json
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["ServingReport", "percentile"]
+__all__ = ["ServingReport", "ReceivedServingReport", "percentile",
+           "REPORT_WIRE_VERSION"]
+
+#: version tag on every serialized report envelope — bump on any change
+#: to the ``raw()`` schema so a mixed-version fleet fails loudly instead
+#: of merging mis-shaped telemetry
+REPORT_WIRE_VERSION = 1
 
 
 def percentile(samples: List[float], q: float) -> float:
@@ -170,3 +176,52 @@ class ServingReport:
 
     def json(self) -> str:
         return json.dumps(self.summary(), sort_keys=True)
+
+    # ----------------------------------------------------------------
+    # wire serialization (cross-process fleet merge)
+    # ----------------------------------------------------------------
+
+    def to_wire(self) -> dict:
+        """Version-tagged, JSON-safe envelope of :meth:`raw` — the form
+        a cross-process replica ships its telemetry home in (fleet_lm
+        ``--hosts`` report files). Everything in ``raw()`` is ints and
+        floats, and Python's float repr round-trips exactly through
+        ``json.dumps``/``loads``, so the pooled-percentile merge on the
+        far side sees bit-identical samples."""
+        return {"version": REPORT_WIRE_VERSION, "kind": "serving_report",
+                "raw": self.raw()}
+
+    @staticmethod
+    def from_wire(wire: dict) -> "ReceivedServingReport":
+        """Rehydrate a :meth:`to_wire` envelope (version-checked) into
+        an object ``FleetReport.merge`` accepts alongside live ones."""
+        if not isinstance(wire, dict) or wire.get("kind") != "serving_report":
+            raise ValueError(
+                f"not a serving_report envelope: {type(wire).__name__}")
+        if wire.get("version") != REPORT_WIRE_VERSION:
+            raise ValueError(
+                f"serving_report wire version {wire.get('version')!r} "
+                f"!= {REPORT_WIRE_VERSION} (mixed-version fleet?)")
+        return ReceivedServingReport(wire["raw"])
+
+
+class ReceivedServingReport:
+    """A peer replica's telemetry, deserialized from the wire: exposes
+    the same :meth:`raw` surface ``FleetReport.merge`` pools, nothing
+    else (a received report cannot record new events)."""
+
+    def __init__(self, raw: dict):
+        missing = [k for k in ("ttft_s", "token_gap_s",
+                               "queue_depth_samples", "occupancy_samples",
+                               "submitted", "completed", "aborted",
+                               "tokens_emitted", "host_bytes", "wall_s")
+                   if k not in raw]
+        if missing:
+            raise ValueError(
+                f"serving_report raw block missing keys: {missing}")
+        self._raw = {k: (list(v) if isinstance(v, list) else v)
+                     for k, v in raw.items()}
+
+    def raw(self) -> dict:
+        return {k: (list(v) if isinstance(v, list) else v)
+                for k, v in self._raw.items()}

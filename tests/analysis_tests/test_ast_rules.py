@@ -24,7 +24,7 @@ def _only(findings, rule):
 def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
             "DL107", "DL108", "DL109", "DL110", "DL111", "DL112",
-            "DL113", "DL114", "DL115", "DL116",
+            "DL113", "DL114", "DL115", "DL116", "DL117",
             "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
@@ -1158,3 +1158,133 @@ def test_dl112_suppression_with_rationale():
         return jax.lax.psum(v, "dbg")  # dlint: disable=DL112
     """
     assert _only(_lint(src), "DL112") == []
+
+
+# ---------------------------------------------------------------------------
+# DL117 — unbounded-retry-loop
+# ---------------------------------------------------------------------------
+
+
+def test_dl117_flags_retry_forever_around_rpc():
+    src = """\
+    def pump(plane):
+        while True:
+            try:
+                return plane.recv_obj(0, tag=7)
+            except Exception:
+                continue
+    """
+    fs = _only(_lint(src), "DL117")
+    assert len(fs) == 1
+    assert fs[0].line == 4
+    assert "recv_obj" in fs[0].message
+    assert "docs/static_analysis.md#dl117" in fs[0].message
+
+
+def test_dl117_flags_swallowed_send_with_logging():
+    src = """\
+    def ship(sock, frame, log):
+        while 1:
+            try:
+                sock.send(frame)
+                return
+            except OSError as e:
+                log.warning("send failed: %s", e)
+    """
+    fs = _only(_lint(src), "DL117")
+    assert len(fs) == 1
+    assert "send" in fs[0].message
+
+
+def test_dl117_clean_for_loop_attempt_cap():
+    src = """\
+    def pump(plane):
+        for attempt in range(4):
+            try:
+                return plane.recv_obj(0, tag=7)
+            except Exception:
+                continue
+        raise TimeoutError("peer dead")
+    """
+    assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_clean_handler_reraises():
+    src = """\
+    def pump(plane):
+        while True:
+            try:
+                return plane.recv_obj(0, tag=7)
+            except TimeoutError:
+                raise
+    """
+    assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_clean_policy_backoff_in_loop():
+    src = """\
+    def pump(plane, pol):
+        import time
+        attempt = 0
+        while True:
+            try:
+                return plane.recv_obj(0, tag=7)
+            except Exception:
+                time.sleep(pol.backoff_ms(attempt) / 1e3)
+                attempt += 1
+    """
+    assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_clean_deadline_clock_check():
+    src = """\
+    def pump(plane, deadline):
+        import time
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError("handoff ack deadline")
+            try:
+                return plane.recv_obj(0, tag=7)
+            except Exception:
+                continue
+    """
+    assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_clean_attempt_compare_bound():
+    src = """\
+    def pump(plane, tries):
+        while True:
+            if tries <= 0:
+                return None
+            try:
+                return plane.recv_obj(0, tag=7)
+            except Exception:
+                tries -= 1
+    """
+    assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_clean_conditional_while_test():
+    src = """\
+    def pump(plane, alive):
+        while alive():
+            try:
+                return plane.recv_obj(0, tag=7)
+            except Exception:
+                continue
+    """
+    assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_suppression_with_rationale():
+    src = """\
+    def pump(plane):
+        while True:
+            try:
+                # fixture: daemon pump, exits with the process
+                return plane.recv_obj(0, tag=7)  # dlint: disable=DL117
+            except Exception:
+                continue
+    """
+    assert _only(_lint(src), "DL117") == []

@@ -7,10 +7,20 @@ so the tier-1 fleet drills run against this fake: one token per
 of ``(prompt, seed)`` — which makes the router's replay-on-requeue
 contract directly checkable (a re-queued request MUST reproduce the
 exact stream the dead replica was emitting, because the real engine's
-seeded sampler replays identically)."""
+seeded sampler replays identically).
+
+The disaggregated conveyor additionally needs the handoff face
+(``hold`` / ``held`` / ``export_handoff`` / ``import_handoff`` /
+``release_held`` / ``abort_held``): a held fake slot exports
+deterministic "KV pages" derived from (prompt, seed) — real bytes for
+the codec to hash, quantize, corrupt, and verify — and an import
+CONTINUES ``expected_tokens`` from the handed-off position, so the
+tier-1 transport/conveyor tests can pin bitwise adoption and clean
+re-prefill without a device."""
 
 import itertools
 import time
+import types
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -42,6 +52,8 @@ class FakeEngine:
         self.report = ServingReport()
         self.iteration = 0
         self._ids = itertools.count()
+        # the one config field the conveyor reads off an engine
+        self.config = types.SimpleNamespace(max_new_tokens=max_new_tokens)
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_id=None, temperature=None, top_k=None, seed: int = 0,
@@ -81,11 +93,19 @@ class FakeEngine:
             emitted += 1
             hit_eos = req.eos_id is not None and tok == req.eos_id
             if hit_eos or len(req.tokens) >= req.max_new_tokens:
-                req.state = "done"
-                self.free_slots.append(slot)
-                del self.active[slot]
-                req.slot = None
-                self.report.record_retire(req.request_id)
+                if getattr(req, "hold", False):
+                    # park instead of retiring: the slot stays bound
+                    # until export_handoff + release_held (the prefill
+                    # side of the disaggregated conveyor)
+                    req.state = "held"
+                    self.held[slot] = req
+                    del self.active[slot]
+                else:
+                    req.state = "done"
+                    self.free_slots.append(slot)
+                    del self.active[slot]
+                    req.slot = None
+                    self.report.record_retire(req.request_id)
         self.report.record_step(len(self.queue),
                                 len(self.active) / self.n_slots)
         return {"admitted": admitted, "emitted": emitted,
@@ -93,6 +113,89 @@ class FakeEngine:
 
     def idle(self) -> bool:
         return not self.queue and not self.active and not self.prefilling
+
+    # ----------------------------------------------------------------
+    # handoff face (fleet/pools.py conveyor)
+    # ----------------------------------------------------------------
+
+    def _check_held(self, req: Request) -> None:
+        if req.state != "held" or self.held.get(req.slot) is not req:
+            raise ValueError(
+                f"request {req.request_id} is not held by this engine")
+
+    def export_handoff(self, req: Request) -> dict:
+        """Deterministic handoff dict shaped like the real engine's:
+        fake KV pages derived from (prompt, seed) — stable bytes, so a
+        corrupted/truncated wire frame fails the codec's digest exactly
+        as a real cache row would. Pure read: the slot stays held."""
+        self._check_held(req)
+        fill = int(req.prompt.size + len(req.tokens) - 1)
+        rng = np.random.RandomState(
+            (int(req.prompt.sum()) + 101 * req.seed) % (2**31))
+        pages = {"block0": {
+            "k": rng.rand(max(1, fill), 1, 4).astype(np.float32),
+            "v": rng.rand(max(1, fill), 1, 4).astype(np.float32)}}
+        return {
+            "pages": pages,
+            "cursor": fill,
+            "tokens": list(req.tokens),
+            "key": np.asarray([req.seed & 0xFFFFFFFF,
+                               len(req.tokens)], np.uint32),
+            "prompt_len": int(req.prompt.size),
+            "eos_id": req.eos_id,
+            "temperature": req.temperature,
+            "top_k": req.top_k,
+            "seed": req.seed,
+        }
+
+    def import_handoff(self, handoff: dict, prompt,
+                       max_new_tokens: Optional[int] = None) -> Request:
+        """Adopt a handed-off stream: the continuation is
+        ``expected_tokens`` from the handed-off position — bitwise the
+        exporting fake continuing, mirroring the real raw-format
+        contract."""
+        if not self.free_slots:
+            raise RuntimeError("no free slot to import a handoff into")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size != int(handoff["prompt_len"]):
+            raise ValueError(
+                f"handoff prompt_len {handoff['prompt_len']} does not "
+                f"match the supplied prompt ({prompt.size})")
+        if not handoff["tokens"]:
+            raise ValueError("handoff carries no sampled token")
+        req = Request(request_id=next(self._ids), prompt=prompt,
+                      max_new_tokens=(max_new_tokens
+                                      if max_new_tokens is not None
+                                      else self.default_max_new),
+                      eos_id=handoff["eos_id"],
+                      temperature=handoff["temperature"],
+                      top_k=handoff["top_k"], seed=handoff["seed"],
+                      tokens=list(handoff["tokens"]), state="running")
+        self.report.record_submit(req.request_id)
+        req.slot = self.free_slots.pop(0)
+        if len(req.tokens) >= req.max_new_tokens or (
+                req.eos_id is not None and req.tokens[-1] == req.eos_id):
+            req.state = "done"
+            self.free_slots.append(req.slot)
+            req.slot = None
+            self.report.record_retire(req.request_id)
+        else:
+            self.active[req.slot] = req
+        return req
+
+    def release_held(self, req: Request, aborted: bool = False) -> None:
+        self._check_held(req)
+        slot = req.slot
+        req.state = "aborted" if aborted else "done"
+        self.free_slots.append(slot)
+        del self.held[slot]
+        req.slot = None
+        self.report.record_retire(req.request_id, aborted=aborted)
+
+    def abort_held(self, req: Request) -> None:
+        """Transport could not deliver this slot's handoff: free it as
+        an abort (the receiver's clean re-prefill owns the stream)."""
+        self.release_held(req, aborted=True)
 
     def abort_all(self, requeue: bool = False) -> List[Request]:
         hit = []

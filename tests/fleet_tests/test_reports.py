@@ -3,7 +3,10 @@
 metrics are weighted by actual token counts (a mean of per-replica
 quotients weights a 10-token replica like a 10k-token one)."""
 
+import json
+
 import numpy as np
+import pytest
 
 from chainermn_tpu.fleet import FleetReport
 from chainermn_tpu.serving.reports import ServingReport, percentile
@@ -110,3 +113,68 @@ def test_merge_of_nothing_is_well_formed():
     assert out["tokens_emitted"] == 0
     assert np.isnan(out["host_bytes_per_token"])
     assert np.isnan(out["itl_ms"]["p50"])
+
+
+# ---------------------------------------------------------------------------
+# wire serialization (cross-process fleet merge)
+# ---------------------------------------------------------------------------
+
+
+def test_serving_report_wire_round_trip_is_exact():
+    r = _report([0.01, 0.0213718237], tokens=3, host_bytes=12,
+                span_s=1.5, ttft_s=[0.5071])
+    wire = json.loads(json.dumps(r.to_wire()))     # a real JSON hop
+    back = ServingReport.from_wire(wire)
+    assert back.raw() == r.raw()                   # bit-identical floats
+    # a received report merges next to live ones
+    merged = FleetReport.merge([r, back])
+    assert merged["replicas"] == 2
+    assert merged["tokens_emitted"] == 6
+
+
+def test_serving_report_wire_rejects_skew():
+    r = _report([0.01], tokens=1, host_bytes=4, span_s=1.0)
+    wire = r.to_wire()
+    with pytest.raises(ValueError, match="version"):
+        ServingReport.from_wire(dict(wire, version=99))
+    with pytest.raises(ValueError, match="envelope"):
+        ServingReport.from_wire({"kind": "nonsense"})
+    bad = json.loads(json.dumps(wire))
+    del bad["raw"]["tokens_emitted"]
+    with pytest.raises(ValueError, match="missing"):
+        ServingReport.from_wire(bad)
+
+
+def test_received_report_is_read_only_telemetry():
+    r = _report([0.01], tokens=1, host_bytes=4, span_s=1.0)
+    back = ServingReport.from_wire(r.to_wire())
+    got = back.raw()
+    got["ttft_s"].append(123.0)        # mutating a copy, not the report
+    assert back.raw()["ttft_s"] == r.raw()["ttft_s"]
+    assert not hasattr(back, "record_token")
+
+
+def test_fleet_report_wire_round_trip_and_absorb():
+    a = FleetReport()
+    a.record_rejected()
+    a.record_handoff("f32", 500)
+    a.record_fallback()
+    wire = json.loads(json.dumps(a.to_wire()))
+    b = FleetReport.from_wire(wire)
+    assert b.to_wire() == a.to_wire()
+    host2 = FleetReport()
+    host2.record_requeue(2)
+    host2.record_handoff("f32", 100)
+    host2.record_handoff("int8-block", 60)
+    b.absorb(host2)
+    assert b.rejected == 1 and b.requeued == 2
+    assert b.handoffs == 3 and b.handoff_fallbacks == 1
+    assert b.handoff_wire_bytes == {"f32": 600, "int8-block": 60}
+
+
+def test_fleet_report_wire_rejects_skew():
+    wire = FleetReport().to_wire()
+    with pytest.raises(ValueError, match="version"):
+        FleetReport.from_wire(dict(wire, version=0))
+    with pytest.raises(ValueError, match="envelope"):
+        FleetReport.from_wire([])

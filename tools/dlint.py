@@ -2,7 +2,7 @@
 """dlint — distributed-correctness lint for the whole stack.
 
 Runs the :mod:`chainermn_tpu.analysis` source passes — the per-file
-AST rules (DL101–DL112) and the whole-program project rules
+AST rules (DL101–DL112, DL117) and the whole-program project rules
 (DL113–DL116, which see through call chains via the repo call graph) —
 and prints one ``path:line: RULE message`` finding per line.
 Exit status: 0 clean, 1 findings, 2 usage error.

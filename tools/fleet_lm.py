@@ -16,19 +16,37 @@ serve_lm.py), queues a deterministic batch of prompts, and drains:
 * ``--disaggregate`` — ``fleet.DisaggregatedFleet``: prefill engine →
   KVHandoff wire (``--wire-format`` f32 | int8-block) → decode engine,
   exposed to ``corrupt_handoff`` faults (fallback = clean re-prefill).
+  Add ``--async-conveyor`` to overlap the wire with decode steps.
+* ``--hosts N --host-rank R --plane-dir D`` — REAL cross-process
+  disaggregation: rank 0 prefills and ships seq/SHA-framed handoffs
+  over the restart-tolerant ``FsObjectPlane`` wire
+  (``fleet.ObjectPlaneTransport``); ranks 1..N-1 adopt and decode.
+  Wire-level chaos (``drop_handoff``/``delay_handoff``/``dup_handoff``/
+  ``corrupt_handoff``) tears at the frames in flight; ``kill@step=``
+  SIGKILLs the prefill process mid-transfer — under
+  ``resilience.Supervisor`` the restarted incarnation re-prefills
+  every unfinished stream and the receivers' fences answer already-
+  adopted replays with duplicate acks (zero dropped or duplicated
+  tokens).
 
 Completed streams append to ``--out`` idempotently (request ids already
 on disk are skipped), so a supervised restart heals to the same final
 JSONL the unkilled run would have produced — per-request seeds are
 ``--seed + request_id``, making sampled streams as replayable as greedy
-ones. Exit status follows the supervisor contract: 0 clean, 75 on a
-watchdog abort, anything else is a crash.
+ones. In ``--hosts`` mode each decode host writes a per-incarnation
+part file ``<out>.h<rank>.r<restart>`` instead (a restarted process
+never appends to a file a SIGKILL may have torn mid-line); ``_done_ids``
+merges base + parts and skips torn trailing lines. Exit status follows
+the supervisor contract: 0 clean, 75 on a watchdog abort, anything else
+is a crash.
 """
 
 import argparse
+import glob
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
@@ -39,14 +57,24 @@ def _log(msg):
 
 
 def _done_ids(path):
-    """Request ids already drained to the JSONL (prior incarnations)."""
+    """Request ids already drained to the JSONL — the base file plus any
+    per-host/per-incarnation part files (``--hosts`` mode). A SIGKILLed
+    incarnation can leave its newest line torn, so undecodable lines are
+    skipped: the request they would have recorded re-runs, and seeded
+    replay makes the re-run emit the identical stream."""
     done = set()
-    if os.path.exists(path):
-        with open(path) as f:
+    for p in [path] + sorted(glob.glob(path + ".h*")):
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
             for line in f:
                 line = line.strip()
-                if line:
+                if not line:
+                    continue
+                try:
                     done.add(json.loads(line)["request_id"])
+                except (ValueError, KeyError):
+                    continue     # torn trailing line from a killed run
     return done
 
 
@@ -57,13 +85,14 @@ def _emit(out, i, prompt, tokens):
     os.fsync(out.fileno())
 
 
-def serve(args):
-    import numpy as np
-
+def _engine_factory(args):
+    """Shared model/params/engine construction. Params come from the
+    seeded init (identical in every process — the cross-host bitwise
+    contract needs no weight shipping) unless ``--weights`` names a
+    published snapshot to warm-load or cold-publish."""
     import jax
     import jax.numpy as jnp
 
-    from chainermn_tpu.fleet import DisaggregatedFleet, FleetReport, Router
     from chainermn_tpu.models.transformer import TransformerLM
     from chainermn_tpu.serving import (Engine, EngineConfig,
                                        load_weights, publish_weights)
@@ -99,6 +128,14 @@ def serve(args):
                                    decode_k=args.decode_k,
                                    prefill_chunk=args.prefill_chunk))
 
+    return engine
+
+
+def _pending_prompts(args):
+    """The deterministic request batch minus what prior incarnations
+    already drained (base JSONL + any ``--hosts`` part files)."""
+    import numpy as np
+
     done = _done_ids(args.out)
     rng = np.random.RandomState(args.seed)
     prompts = {}
@@ -109,7 +146,17 @@ def serve(args):
             prompts[i] = prompt
     _log(f"queued {len(prompts)} of {args.requests} requests "
          f"({len(done)} already drained)")
+    return prompts
 
+
+def serve(args):
+    from chainermn_tpu.fleet import DisaggregatedFleet, FleetReport, Router
+
+    if args.hosts:
+        return serve_hosts(args)
+
+    engine = _engine_factory(args)
+    prompts = _pending_prompts(args)
     report = FleetReport()
     kw = dict(max_new_tokens=args.max_new_tokens,
               temperature=args.temperature, top_k=args.top_k)
@@ -117,7 +164,8 @@ def serve(args):
     if args.disaggregate:
         fleet = DisaggregatedFleet(engine(), engine(),
                                    wire_format=args.wire_format,
-                                   report=report)
+                                   report=report,
+                                   async_conveyor=args.async_conveyor)
         streams = {i: fleet.submit(p, seed=args.seed + i, **kw)
                    for i, p in emit_order(prompts)}
         with open(args.out, "a") as out:
@@ -129,6 +177,7 @@ def serve(args):
                     if s.finished and i not in emitted:
                         emitted.add(i)
                         _emit(out, i, prompts[i], s.tokens)
+        fleet.close()
         summary = fleet.summary()
     else:
         with Router([engine() for _ in range(args.replicas)],
@@ -146,6 +195,142 @@ def serve(args):
     if args.report:
         with open(args.report, "w") as f:
             f.write(json.dumps(summary, sort_keys=True))
+    return None
+
+
+def serve_hosts(args):
+    """One host of a REAL cross-process disaggregated fleet.
+
+    Rank 0 prefills every pending stream and ships handoffs to their
+    owner decode hosts (stream ``i`` belongs to rank ``1 + i % (N-1)``)
+    over ``ObjectPlaneTransport`` frames on the ``FsObjectPlane`` wire
+    — the file-backed plane, because the jax.distributed coordinator
+    cannot re-admit a SIGKILLed rank and the whole point of this mode
+    is surviving exactly that under the supervisor. Decode hosts adopt
+    (or, past ``--handoff-deadline-s``, fence + fall back to a clean
+    re-prefill from seed) and append finished streams to their own
+    per-incarnation part file.
+    """
+    from chainermn_tpu.comm.object_plane import FsObjectPlane
+    from chainermn_tpu.fleet import FleetReport
+    from chainermn_tpu.fleet.handoff import (HandoffError, decode_handoff,
+                                             encode_handoff)
+    from chainermn_tpu.fleet.pools import DecodePool, PrefillPool, Stream
+    from chainermn_tpu.fleet.transport import ObjectPlaneTransport
+    from chainermn_tpu.resilience import chaos
+    from chainermn_tpu.resilience.supervisor import restart_count
+
+    if args.hosts < 2:
+        raise SystemExit("--hosts needs at least 2 (1 prefill + 1 decode)")
+    if not (0 <= args.host_rank < args.hosts):
+        raise SystemExit(f"--host-rank {args.host_rank} outside "
+                         f"[0, {args.hosts})")
+    if not args.plane_dir:
+        raise SystemExit("--hosts needs --plane-dir (the shared wire)")
+    rank, n = args.host_rank, args.hosts
+    plane = FsObjectPlane(args.plane_dir, rank, n)
+    engine = _engine_factory(args)()
+    prompts = _pending_prompts(args)
+    report = FleetReport()
+    owner = lambda i: 1 + (i % (n - 1))  # noqa: E731 — one-line mapping
+    kw = dict(temperature=args.temperature, top_k=args.top_k)
+    budget_s = args.handoff_deadline_s + 120.0   # hard stop for any loop
+
+    if rank == 0:
+        pool = PrefillPool(engine)
+        transports = {r: ObjectPlaneTransport(plane, peer=r)
+                      for r in range(1, n)}
+        for i, p in emit_order(prompts):
+            pool.submit(Stream(i, p, args.max_new_tokens,
+                               dict(kw, seed=args.seed + i)))
+        deadline = time.monotonic() + budget_s
+        it = 0
+        while not engine.idle() or engine.held:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"prefill host failed to drain within {budget_s}s")
+            # the drill's kill@step= SIGKILL lands here — between
+            # engine steps, possibly with frames already in flight
+            chaos.on_step(it)
+            it += 1
+            # export/encode below pulls every ready slot's pages to
+            # host (np.asarray) — that IS the per-iteration sync
+            pool.step()  # dlint: disable=DL104
+            for stream, req in pool.ready():
+                handoff = pool.export(req)
+                manifest, blob = encode_handoff(handoff, args.wire_format)
+                report.record_handoff(args.wire_format, len(blob))
+                status = transports[owner(stream.stream_id)].send(
+                    stream.stream_id, manifest, blob)
+                if status == "failed":
+                    report.record_fallback()
+                pool.release(req, aborted=(status == "failed"))
+                _log(f"handoff stream={stream.stream_id} -> "
+                     f"h{owner(stream.stream_id)}: {status}")
+        summary = report.summary([engine.report])
+    else:
+        pool = DecodePool(engine)
+        transport = ObjectPlaneTransport(plane, peer=0)
+        owned = {i: p for i, p in prompts.items() if owner(i) == rank}
+        streams = {i: Stream(i, p, args.max_new_tokens,
+                             dict(kw, seed=args.seed + i))
+                   for i, p in owned.items()}
+        part = f"{args.out}.h{rank}.r{restart_count()}"
+        arrive_by = time.monotonic() + args.handoff_deadline_s
+        deadline = time.monotonic() + budget_s
+        placed, emitted, backlog = set(), set(), []
+        with open(part, "a") as out:
+            while len(emitted) < len(owned):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"decode host {rank} failed to drain within "
+                        f"{budget_s}s ({len(emitted)}/{len(owned)})")
+                backlog.extend(transport.poll(timeout_ms=20))
+                still = []
+                for arr in backlog:
+                    s = streams.get(arr.stream_id)
+                    if s is None or arr.stream_id in placed:
+                        continue
+                    if arr.failed:
+                        report.record_fallback()
+                        pool.fallback(s)
+                    elif pool.has_room():
+                        try:
+                            pool.place(s, decode_handoff(arr.manifest,
+                                                         arr.blob))
+                        except HandoffError:
+                            report.record_fallback()
+                            pool.fallback(s)
+                    else:
+                        still.append(arr)   # adopted frame waits for room
+                        continue
+                    placed.add(arr.stream_id)
+                backlog = still
+                if time.monotonic() > arrive_by:
+                    for i in sorted(set(owned) - placed):
+                        # never arrived: fence the stream (a late frame
+                        # now acks duplicate) and re-prefill from seed
+                        transport.resolve(i)
+                        report.record_fallback()
+                        pool.fallback(streams[i])
+                        placed.add(i)
+                        _log(f"stream {i} missed the handoff deadline; "
+                             f"fenced + re-prefilled")
+                # each engine step syncs internally (int32 token pulls)
+                pool.step()  # dlint: disable=DL104
+                for i, s in streams.items():
+                    if s.finished and i not in emitted:
+                        emitted.add(i)
+                        _emit(out, i, owned[i], s.tokens)
+        summary = report.summary([engine.report])
+
+    _log(f"host {rank} drained; report: "
+         f"{json.dumps(summary, sort_keys=True)}")
+    if args.report:
+        wire = {"fleet": report.to_wire(),
+                "serving": [engine.report.to_wire()]}
+        with open(f"{args.report}.h{rank}", "w") as f:
+            f.write(json.dumps(wire, sort_keys=True))
     return None
 
 
@@ -172,6 +357,22 @@ def main(argv=None):
     ap.add_argument("--wire-format", default="f32",
                     choices=["f32", "int8-block"],
                     help="KVHandoff wire format (disaggregated mode)")
+    ap.add_argument("--async-conveyor", action="store_true",
+                    help="overlap handoff transfer with decode steps "
+                         "(disaggregated mode, bounded worker queue)")
+    ap.add_argument("--hosts", type=int, default=0,
+                    help="cross-PROCESS disaggregation over N hosts "
+                         "(this process is one of them; see --host-rank)")
+    ap.add_argument("--host-rank", type=int, default=0,
+                    help="this process's rank in --hosts mode "
+                         "(0 = prefill host, 1..N-1 = decode hosts)")
+    ap.add_argument("--plane-dir", default=None,
+                    help="shared directory backing the FsObjectPlane "
+                         "wire (--hosts mode)")
+    ap.add_argument("--handoff-deadline-s", type=float, default=30.0,
+                    help="decode-host budget for a stream's handoff to "
+                         "arrive before fencing it and re-prefilling "
+                         "from seed (--hosts mode)")
     ap.add_argument("--max-queue-depth", type=int, default=None,
                     help="per-replica admission bound (router mode)")
     ap.add_argument("--requests", type=int, default=6)
