@@ -31,6 +31,26 @@ Two pass families, one CLI (``tools/dlint.py``):
   - ``DL115`` lock-order inversion across the threaded planes
   - ``DL116`` blocking call while holding a lock
 
+* **Dataflow passes** (:mod:`.dataflow_rules`) are project passes on
+  the value-level engine in :mod:`.dataflow` — reaching definitions
+  and def-use chains per function, composed interprocedurally through
+  the call graph by per-function summaries (which params are consumed
+  or donated):
+
+  - ``DL118`` PRNG-key reuse, or a discarded ``split``/``fold_in``
+    result (the one-split-per-sampled-token reproducibility contract)
+  - ``DL119`` use-after-donation (a value handed to a
+    ``donate_argnums`` position — directly or through a callee — read
+    again afterwards)
+  - ``DL120`` ``set`` iteration feeding collective construction,
+    channel-tag assignment, or trace-signature tuples
+  - ``DL121`` host-device sync (``.item()``, ``np.asarray``,
+    ``float()``) on values derived from the data arguments of a
+    ``decode_k``/``ServingStep`` hot path
+  - ``DL122`` trace-count instability — Python branching on
+    request-dependent values inside jit-compiled functions (the static
+    twin of DL108's runtime check)
+
 * **Compiled-HLO passes** (:mod:`.hlo_passes`) run over scheduled HLO
   text (``compiled.as_text()``) — the generalized form of
   ``tools/check_overlap_schedule.py``, which is now a thin wrapper:
@@ -54,6 +74,7 @@ CI-grade (:mod:`.output`).
 """
 
 from chainermn_tpu.analysis import ast_passes  # noqa: F401  (registers DL1xx)
+from chainermn_tpu.analysis import dataflow_rules  # noqa: F401  (DL118–DL122)
 from chainermn_tpu.analysis import locks  # noqa: F401  (DL115/DL116)
 from chainermn_tpu.analysis import sequence  # noqa: F401  (DL113/DL114)
 from chainermn_tpu.analysis.callgraph import (  # noqa: F401
@@ -75,9 +96,17 @@ from chainermn_tpu.analysis.core import (  # noqa: F401
 from chainermn_tpu.analysis.output import (  # noqa: F401
     filter_new,
     fingerprints,
+    from_sarif,
     load_baseline,
     to_sarif,
     write_baseline,
+)
+from chainermn_tpu.analysis.dataflow import (  # noqa: F401
+    Analysis,
+    DefUse,
+    Definition,
+    FlowWalker,
+    ParamSummary,
 )
 from chainermn_tpu.analysis.hlo_passes import (  # noqa: F401
     check_collective_budget,

@@ -1365,9 +1365,10 @@ _BACKOFF_CALLS = {"backoff_ms", "backoffs_ms"}
 _CLOCK_CALLS = {"monotonic", "perf_counter"}
 
 #: name fragments that mark an attempt/deadline bound when they appear
-#: in a comparison inside the loop
+#: in a comparison — or on the receiver/name of a call — inside the loop
 _BOUND_NAME_HINTS = ("deadline", "attempt", "budget", "waited",
-                     "remaining", "left", "tries", "retries")
+                     "remaining", "left", "tries", "retries",
+                     "policy", "exhausted")
 
 
 def _retry_handler_swallows(handler: ast.ExceptHandler) -> bool:
@@ -1400,6 +1401,14 @@ def _loop_is_bounded(loop: ast.While) -> bool:
             name = _callee_name(n)
             if name in _BACKOFF_CALLS or name in _CLOCK_CALLS:
                 return True
+            # the OBJECT form of the same evidence: a method call on a
+            # budget/policy-named receiver (``budget.exhausted()``,
+            # ``pol.remaining_ms()`` — the fleet/transport.py retry
+            # shape, where the bound lives behind an RpcPolicy budget
+            # object instead of a literal count)
+            for part in _names_in(n.func):
+                if any(h in part.lower() for h in _BOUND_NAME_HINTS):
+                    return True
         if isinstance(n, ast.Compare):
             for name in _names_in(n):
                 if any(h in name.lower() for h in _BOUND_NAME_HINTS):
@@ -1426,8 +1435,10 @@ def check_unbounded_retry_loop(tree, src, path) -> List[Finding]:
     (inherently bounded); handlers that raise/return/break on any path
     (the exit is the bound); loops containing ``RpcPolicy.backoff_ms``/
     ``backoffs_ms`` calls, a ``time.monotonic()``/``perf_counter()``
-    read (deadline math), or a comparison over an attempt/deadline-
-    named quantity. The fixed patterns are ``comm/object_plane.py``'s
+    read (deadline math), a comparison over an attempt/deadline-
+    named quantity, or a method call on a budget/policy-named receiver
+    (the RpcPolicy budget-object form: ``budget.exhausted()``,
+    ``pol.remaining_ms()``). The fixed patterns are ``comm/object_plane.py``'s
     ``_sliced_get`` (budget-sliced, raises on exhaustion) and
     ``fleet/transport.py``'s ack wait (per-attempt ``handoff_ack_ms``
     deadline under a ``max_attempts`` cap).

@@ -46,6 +46,17 @@ from typing import Dict, List, Optional, Tuple
 #: chain length, not runtime)
 DEFAULT_CALL_DEPTH = 6
 
+#: wrappers that forward calls to their first positional argument:
+#: ``g = partial(f, x)`` / ``g = jax.jit(f)`` — calling ``g`` runs
+#: ``f``. NOT ``wraps``: ``functools.wraps(f)`` returns a decorator
+#: for some OTHER function, not a callable forwarding to ``f``.
+_WRAPPER_NAMES = {"partial", "jit", "pjit", "pmap", "vmap",
+                  "lru_cache", "cache", "checkpoint", "remat"}
+
+#: alias-chain resolution depth cap (``h = partial(g)``;
+#: ``g = jit(f)`` …) — bounds lazy re-resolution, not graph size
+_ALIAS_DEPTH = 4
+
 
 @dataclass
 class FunctionInfo:
@@ -80,6 +91,11 @@ class ModuleInfo:
     imports: Dict[str, object] = field(default_factory=dict)
     functions: Dict[str, FunctionInfo] = field(default_factory=dict)
     classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    #: ``g = wrapper(f, ...)`` module-level assignments: local name →
+    #: the Call node, resolved LAZILY (the wrapped symbol may live in a
+    #: module indexed later, and non-wrapper calls are filtered at
+    #: resolution time, not here)
+    alias_calls: Dict[str, ast.Call] = field(default_factory=dict)
 
 
 def module_name_for(path: str) -> str:
@@ -132,6 +148,7 @@ class Project:
         self.functions: Dict[str, FunctionInfo] = {}   # qualname → info
         self.classes: Dict[str, List[ClassInfo]] = {}  # name → candidates
         self._local_types: Dict[str, Dict[str, str]] = {}  # memo
+        self._local_aliases: Dict[int, Dict[str, ast.Call]] = {}  # memo
 
     # -- construction -----------------------------------------------------
 
@@ -206,6 +223,15 @@ class Project:
                     self.functions[info.qualname] = info
             mod.classes[node.name] = ci
             self.classes.setdefault(node.name, []).append(ci)
+        elif isinstance(node, ast.Assign):
+            # candidate wrapper alias: ``g = something(f, ...)`` with a
+            # name/attr first argument. Whether ``something`` actually
+            # forwards calls is decided lazily in _through_wrapper.
+            if (isinstance(node.value, ast.Call) and node.value.args
+                    and _attr_chain(node.value.args[0]) is not None):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        mod.alias_calls[t.id] = node.value
         elif isinstance(node, (ast.If, ast.Try)):
             # module-level try/if wrappers around imports/defs (the
             # optional-dependency idiom) still contribute symbols
@@ -336,21 +362,118 @@ class Project:
         self._local_types[func.qualname] = out
         return out
 
+    def local_aliases(self, func: FunctionInfo) -> Dict[str, ast.Call]:
+        """``g = wrapper(f, ...)`` assignments inside ``func``: name →
+        the Call node (same lazy contract as
+        :attr:`ModuleInfo.alias_calls`). Memoized by node identity so
+        synthetic contexts (module bodies wrapped as functions by the
+        dataflow rules) are safe."""
+        cached = self._local_aliases.get(id(func.node))
+        if cached is not None:
+            return cached
+        out: Dict[str, ast.Call] = {}
+        for n in ast.walk(func.node):
+            if (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Call) and n.value.args
+                    and _attr_chain(n.value.args[0]) is not None):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = n.value
+        self._local_aliases[id(func.node)] = out
+        return out
+
     # -- the resolver -----------------------------------------------------
 
     def resolve_call(self, call: ast.Call, ctx: FunctionInfo,
                      local_types: Optional[Dict[str, str]] = None
                      ) -> Optional[FunctionInfo]:
         """Map one call site inside ``ctx`` to a known function, or
-        None when the callee is not statically known."""
+        None when the callee is not statically known. Sees through
+        forwarding wrappers: ``g = partial(f, x)`` / ``g = jax.jit(f)``
+        aliases (module-level and local), inline ``jit(f)(args)``
+        application, and single-level project decorators whose body
+        provably forwards (returns its function parameter or a nested
+        def)."""
         mod = self.modules.get(ctx.module)
         if mod is None:
             return None
-        chain = _attr_chain(call.func)
-        if not chain:
-            return None
         if local_types is None:
             local_types = self.local_types(ctx)
+        return self._resolve_func_expr(mod, call.func, ctx,
+                                       local_types, 0)
+
+    def _decorator_forwards(self, deco: FunctionInfo) -> bool:
+        """True when ``deco`` is a single-level decorator shape: it
+        takes exactly ONE positional parameter (the function) and
+        either returns it (identity decorator) or returns a nested def
+        while CALLING the parameter somewhere in its body (the standard
+        closure decorator). A factory that returns a closure over
+        config it never calls (``make_step(cfg)``) is NOT a decorator —
+        treating it as one would invent edges from the closure to the
+        config's constructor."""
+        node = deco.node
+        args = node.args
+        pos = list(args.posonlyargs) + list(args.args)
+        if len(pos) != 1 or args.kwonlyargs:
+            return False
+        fn_param = pos[0].arg
+        nested = {n.name for n in ast.iter_child_nodes(node)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        returns_nested = False
+        param_called = False
+        for n in ast.walk(node):
+            if isinstance(n, ast.Return) and isinstance(n.value, ast.Name):
+                if n.value.id == fn_param:
+                    return True
+                if n.value.id in nested:
+                    returns_nested = True
+            elif (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == fn_param):
+                param_called = True
+        return returns_nested and param_called
+
+    def _through_wrapper(self, mod: ModuleInfo, call: ast.Call,
+                         ctx: FunctionInfo, local_types: Dict[str, str],
+                         depth: int) -> Optional[FunctionInfo]:
+        """Resolve the function a wrapper application forwards to:
+        ``partial(f, x)`` / ``jit(f)`` → ``f``. Unknown callees only
+        count when they resolve to a project function that provably
+        forwards (see :meth:`_decorator_forwards`) — a plain data call
+        ``x = compute(y)`` is NOT an alias."""
+        if depth > _ALIAS_DEPTH or not call.args:
+            return None
+        chain = _attr_chain(call.func)
+        if chain is None:
+            # decorator-factory application: ``lru_cache(None)(f)``
+            inner = call.func
+            if (isinstance(inner, ast.Call)
+                    and (_attr_chain(inner.func) or [""])[-1]
+                    in _WRAPPER_NAMES):
+                return self._resolve_func_expr(mod, call.args[0], ctx,
+                                               local_types, depth + 1)
+            return None
+        if chain[-1] not in _WRAPPER_NAMES:
+            deco = self._resolve_func_expr(mod, call.func, ctx,
+                                           local_types, depth + 1)
+            if deco is None or not self._decorator_forwards(deco):
+                return None
+        return self._resolve_func_expr(mod, call.args[0], ctx,
+                                       local_types, depth + 1)
+
+    def _resolve_func_expr(self, mod: ModuleInfo, expr: ast.expr,
+                           ctx: FunctionInfo,
+                           local_types: Dict[str, str],
+                           depth: int) -> Optional[FunctionInfo]:
+        if depth > _ALIAS_DEPTH:
+            return None
+        if isinstance(expr, ast.Call):
+            # inline application: ``jit(f)(args)`` / ``partial(f, 1)()``
+            return self._through_wrapper(mod, expr, ctx,
+                                         local_types, depth)
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
 
         if len(chain) == 1:
             name = chain[0]
@@ -367,8 +490,19 @@ class Project:
                     if bound[1] in target.classes:
                         return self._method_on(
                             target.classes[bound[1]], "__init__")
+                    if bound[1] in target.alias_calls:
+                        return self._through_wrapper(
+                            target, target.alias_calls[bound[1]],
+                            ctx, local_types, depth + 1)
             if name in mod.classes:
                 return self._method_on(mod.classes[name], "__init__")
+            local = self.local_aliases(ctx)
+            if name in local:
+                return self._through_wrapper(mod, local[name], ctx,
+                                             local_types, depth + 1)
+            if name in mod.alias_calls:
+                return self._through_wrapper(mod, mod.alias_calls[name],
+                                             ctx, local_types, depth + 1)
             return None
 
         head, meth = chain[0], chain[-1]
@@ -395,4 +529,8 @@ class Project:
                 return target.functions[meth]
             if meth in target.classes:
                 return self._method_on(target.classes[meth], "__init__")
+            if meth in target.alias_calls:
+                return self._through_wrapper(target,
+                                             target.alias_calls[meth],
+                                             ctx, local_types, depth + 1)
         return None

@@ -34,6 +34,7 @@ import ast
 import io
 import os
 import re
+import time
 import tokenize
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
@@ -85,6 +86,7 @@ def _load_passes() -> None:
     """Import every pass module so the registry is complete no matter
     which entry point ran first."""
     from chainermn_tpu.analysis import ast_passes  # noqa: F401
+    from chainermn_tpu.analysis import dataflow_rules  # noqa: F401
     from chainermn_tpu.analysis import locks  # noqa: F401
     from chainermn_tpu.analysis import sequence  # noqa: F401
 
@@ -115,6 +117,10 @@ class LintRun:
 
     findings: List[Finding] = field(default_factory=list)
     suppressions: List[Suppression] = field(default_factory=list)
+    #: wall-clock seconds per pass ("DL113", …) plus the fixed-cost
+    #: phases ("parse", "project-build") — ``tools/dlint.py --timings``
+    #: serializes this so CI can watch the verify-budget headroom
+    timings: Dict[str, float] = field(default_factory=dict)
 
     @property
     def dead_suppressions(self) -> List[Suppression]:
@@ -210,8 +216,10 @@ def run_lint_sources(sources: Dict[str, str],
     findings: List[Finding] = []
     sups: Dict[str, List[Suppression]] = {}
     parsed: Dict[str, Tuple[ast.AST, str]] = {}
+    timings = run.timings
     for path in sorted(sources):
         src = sources[path]
+        t0 = time.perf_counter()
         try:
             tree = ast.parse(src)
         except SyntaxError as e:
@@ -220,6 +228,9 @@ def run_lint_sources(sources: Dict[str, str],
                 f"syntax error blocks analysis: {e.msg}"))
             sups[path] = collect_suppressions(src, path)
             continue
+        finally:
+            timings["parse"] = timings.get("parse", 0.0) \
+                + time.perf_counter() - t0
         parsed[path] = (tree, src)
         sups[path] = collect_suppressions(src, path, tree)
         for rule in RULES.values():
@@ -227,14 +238,21 @@ def run_lint_sources(sources: Dict[str, str],
                 continue
             if rules is not None and rule.rule_id not in rules:
                 continue
+            t0 = time.perf_counter()
             findings.extend(rule.check(tree, src, path))
+            timings[rule.rule_id] = timings.get(rule.rule_id, 0.0) \
+                + time.perf_counter() - t0
 
     project_rules = [r for r in RULES.values() if r.kind == "project"
                      and (rules is None or r.rule_id in rules)]
     if project_rules and parsed:
+        t0 = time.perf_counter()
         project = Project.build(parsed)
+        timings["project-build"] = time.perf_counter() - t0
         for rule in project_rules:
+            t0 = time.perf_counter()
             findings.extend(rule.check(project))
+            timings[rule.rule_id] = time.perf_counter() - t0
 
     # a call nested under two rank-dependent Ifs can be reported by both
     # evaluations; one report per (rule, path, line) is enough — dedup

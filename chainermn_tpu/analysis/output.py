@@ -24,7 +24,7 @@ import json
 import os
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from chainermn_tpu.analysis.core import RULES, Finding
+from chainermn_tpu.analysis.core import RULES, Finding, Suppression
 
 SARIF_VERSION = "2.1.0"
 SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
@@ -49,8 +49,15 @@ def _rel(path: str, root: Optional[str] = None) -> str:
 
 
 def to_sarif(findings: Sequence[Finding],
-             root: Optional[str] = None) -> dict:
-    """A complete SARIF 2.1.0 log object for one lint run."""
+             root: Optional[str] = None,
+             suppressions: Optional[Sequence[Suppression]] = None
+             ) -> dict:
+    """A complete SARIF 2.1.0 log object for one lint run. When
+    ``suppressions`` is given, the in-source ``# dlint: disable``
+    comments the run honored are recorded under the run's
+    ``properties.suppressions`` (path, line, rules, absorbed-finding
+    count) so a SARIF consumer can audit what was silenced and why the
+    result list is shorter than the raw finding count."""
     rules_meta = [
         {
             "id": rule.rule_id,
@@ -81,24 +88,67 @@ def to_sarif(findings: Sequence[Finding],
         if f.rule in index:
             result["ruleIndex"] = index[f.rule]
         results.append(result)
+    run: dict = {
+        "tool": {
+            "driver": {
+                "name": "dlint",
+                "informationUri": "docs/static_analysis.md",
+                "rules": rules_meta,
+            },
+        },
+        "originalUriBaseIds": {
+            "SRCROOT": {"uri": "file:///" + _rel(
+                root or os.getcwd(), "/").lstrip("/") + "/"},
+        },
+        "results": results,
+    }
+    if suppressions is not None:
+        run["properties"] = {
+            "suppressions": [
+                {
+                    "uri": _rel(s.path, root),
+                    "line": s.line,
+                    "rules": sorted(s.rules),
+                    "hits": s.hits,
+                }
+                for s in sorted(suppressions,
+                                key=lambda s: (s.path, s.line))
+            ],
+        }
     return {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,
-        "runs": [{
-            "tool": {
-                "driver": {
-                    "name": "dlint",
-                    "informationUri": "docs/static_analysis.md",
-                    "rules": rules_meta,
-                },
-            },
-            "originalUriBaseIds": {
-                "SRCROOT": {"uri": "file:///" + _rel(
-                    root or os.getcwd(), "/").lstrip("/") + "/"},
-            },
-            "results": results,
-        }],
+        "runs": [run],
     }
+
+
+def from_sarif(log: dict) -> Tuple[List[Finding], List[Suppression]]:
+    """Inverse of :func:`to_sarif` up to path relativization: rebuild
+    the findings (rule, uri, line, message) and recorded suppressions
+    from a dlint SARIF log. Locations come back repo-relative — exactly
+    what round-trip tests and CI tooling diffing two logs need."""
+    if not isinstance(log, dict) or "runs" not in log:
+        raise ValueError("not a SARIF log object")
+    findings: List[Finding] = []
+    suppressions: List[Suppression] = []
+    for run in log["runs"]:
+        for res in run.get("results", ()):
+            loc = (res.get("locations") or [{}])[0]
+            phys = loc.get("physicalLocation", {})
+            uri = phys.get("artifactLocation", {}).get("uri", "")
+            line = phys.get("region", {}).get("startLine", 1)
+            findings.append(Finding(
+                res.get("ruleId", ""), uri, int(line),
+                res.get("message", {}).get("text", "")))
+        for s in run.get("properties", {}).get("suppressions", ()):
+            sup = Suppression(
+                path=s.get("uri", ""), line=int(s.get("line", 0)),
+                rules=set(s.get("rules", ())),
+                start=int(s.get("line", 0)),
+                end=int(s.get("line", 0)) + 1)
+            sup.hits = int(s.get("hits", 0))
+            suppressions.append(sup)
+    return findings, suppressions
 
 
 # ---------------------------------------------------------------------------

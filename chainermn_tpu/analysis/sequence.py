@@ -151,6 +151,7 @@ class SequenceAnalysis:
         self._summaries: Dict[str, List[object]] = {}
         self._flat: Dict[Tuple[str, int], List[Op]] = {}
         self._expanded: Dict[Tuple[str, int], List[object]] = {}
+        self._op_reach_map: Optional[Dict[str, bool]] = None
 
     # -- summarization ----------------------------------------------------
 
@@ -287,6 +288,8 @@ class SequenceAnalysis:
             elif isinstance(ev, CallSite):
                 if depth <= 0:
                     continue
+                if not self._op_reach().get(ev.callee, False):
+                    continue               # op-free subtree: nothing there
                 callee = ev.callee.split(":", 1)[-1]
                 if callee in via:
                     continue               # recursion: treat as opaque
@@ -301,6 +304,45 @@ class SequenceAnalysis:
     def _summaries_for(self, qualname: str) -> List[object]:
         func = self.project.functions.get(qualname)
         return self.summary(func) if func is not None else []
+
+    # -- op reachability (expansion pruning) ------------------------------
+
+    def _op_reach(self) -> Dict[str, bool]:
+        """qualname → does any Op exist transitively in its call tree.
+        One whole-project fixpoint; expansion then skips op-free
+        callees entirely. Without this, a cluster of mutually recursive
+        helpers is re-inlined at every distinct remaining depth — an
+        exponential tree copy that buys nothing, since an op-free
+        subtree can never contribute an event."""
+        if self._op_reach_map is not None:
+            return self._op_reach_map
+        direct: Dict[str, bool] = {}
+        calls: Dict[str, Set[str]] = {}
+
+        def scan(events, q):
+            for ev in events:
+                if isinstance(ev, Op):
+                    direct[q] = True
+                elif isinstance(ev, Branch):
+                    scan(ev.body, q)
+                    scan(ev.orelse, q)
+                elif isinstance(ev, CallSite):
+                    calls[q].add(ev.callee)
+
+        for q, func in self.project.functions.items():
+            direct.setdefault(q, False)
+            calls.setdefault(q, set())
+            scan(self.summary(func), q)
+        changed = True
+        while changed:
+            changed = False
+            for q, callees in calls.items():
+                if not direct[q] and any(direct.get(c, False)
+                                         for c in callees):
+                    direct[q] = True
+                    changed = True
+        self._op_reach_map = direct
+        return direct
 
     # -- rank paths (DL114) -----------------------------------------------
 
@@ -342,6 +384,8 @@ class SequenceAnalysis:
             elif isinstance(ev, CallSite):
                 if depth <= 0:
                     continue
+                if not self._op_reach().get(ev.callee, False):
+                    continue               # op-free subtree: nothing there
                 out.extend(self._expanded_tree(ev.callee, depth - 1))
         return out
 

@@ -541,6 +541,10 @@ class ServingStep:
             raise ValueError(
                 f"fill {fill} outside (0, capacity={self.capacity}] — a "
                 "wrapped slot cannot be exported")
+        # Export IS the host pull: handoff serialization runs once per
+        # migration, outside the per-token decode loop, and the payload
+        # must be host bytes by contract.
+        # dlint: disable=DL121 — sanctioned migration-time host pull
         return {name: {"k": np.asarray(page["k"][slot, :fill]),
                        "v": np.asarray(page["v"][slot, :fill])}
                 for name, page in self.cache.items()}

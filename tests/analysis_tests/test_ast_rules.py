@@ -24,14 +24,16 @@ def _only(findings, rule):
 def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
             "DL107", "DL108", "DL109", "DL110", "DL111", "DL112",
-            "DL113", "DL114", "DL115", "DL116", "DL117",
+            "DL113", "DL114", "DL115", "DL116", "DL117", "DL118",
+            "DL119", "DL120", "DL121", "DL122",
             "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
         assert rule.kind in ("ast", "project", "hlo")
     assert {r for r, rule in RULES.items()
             if rule.kind == "project"} \
-        == {"DL113", "DL114", "DL115", "DL116"}
+        == {"DL113", "DL114", "DL115", "DL116",
+            "DL118", "DL119", "DL120", "DL121", "DL122"}
 
 
 # ---------------------------------------------------------------------------
@@ -1288,3 +1290,61 @@ def test_dl117_suppression_with_rationale():
                 continue
     """
     assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_clean_rpc_policy_budget_object():
+    # the fleet/transport.py retry shape: the bound lives behind an
+    # RpcPolicy budget OBJECT (method calls, not a literal count or a
+    # hinted comparison) — must not be flagged
+    src = """\
+    def await_ack(plane, pol, seq):
+        budget = pol.ack_budget()
+        while True:
+            if budget.exhausted():
+                return None
+            try:
+                ack = plane.try_recv_obj(0, tag=9)
+            except TimeoutError:
+                budget.charge(pol.probe_ms)
+                continue
+            if ack and ack.get("seq") == seq:
+                return ack
+    """
+    assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_clean_policy_receiver_method_call():
+    src = """\
+    def pump(plane, policy):
+        while True:
+            try:
+                return plane.recv_obj(0, tag=7)
+            except Exception:
+                policy.note_failure()
+                continue
+    """
+    assert _only(_lint(src), "DL117") == []
+
+
+def test_dl117_budget_object_does_not_mask_other_loops():
+    # bounding evidence in ONE loop must not launder a sibling bare
+    # retry-forever loop in the same function
+    src = """\
+    def pump(plane, pol):
+        budget = pol.ack_budget()
+        while True:
+            if budget.exhausted():
+                break
+            try:
+                plane.send_obj(0, {}, tag=1)
+            except Exception:
+                continue
+        while True:
+            try:
+                return plane.recv_obj(0, tag=7)
+            except Exception:
+                continue
+    """
+    fs = _only(_lint(src), "DL117")
+    assert len(fs) == 1
+    assert "recv_obj" in fs[0].message

@@ -206,3 +206,180 @@ def test_module_level_conditional_defs_still_indexed():
                 pass
         """)
     assert "m:fast" in p.functions
+
+
+# ---------------------------------------------------------------------------
+# wrapper aliases: partial / jit / single-level decorators
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_through_module_level_partial():
+    p = _project(
+        m="""
+        from functools import partial
+
+        def f(a, b):
+            pass
+
+        g = partial(f, 1)
+
+        def caller():
+            g(2)
+        """)
+    (call,) = _calls_in(p, "m:caller")
+    assert p.resolve_call(call, p.functions["m:caller"]).qualname == "m:f"
+
+
+def test_resolve_through_local_jit_alias():
+    p = _project(
+        m="""
+        import jax
+
+        def step(state):
+            return state
+
+        def run(state):
+            fast = jax.jit(step)
+            return fast(state)
+        """)
+    calls = [c for c in _calls_in(p, "m:run")
+             if getattr(c.func, "id", None) == "fast"]
+    assert p.resolve_call(calls[0], p.functions["m:run"]).qualname \
+        == "m:step"
+
+
+def test_resolve_inline_jit_application():
+    p = _project(
+        m="""
+        import jax
+
+        def step(state):
+            return state
+
+        def run(state):
+            return jax.jit(step)(state)
+        """)
+    calls = [c for c in _calls_in(p, "m:run")
+             if isinstance(c.func, __import__("ast").Call)]
+    assert p.resolve_call(calls[0], p.functions["m:run"]).qualname \
+        == "m:step"
+
+
+def test_resolve_alias_imported_from_other_module():
+    p = _project(
+        lib="""
+        from functools import partial
+
+        def f(a, b):
+            pass
+
+        g = partial(f, 1)
+        """,
+        m="""
+        from lib import g
+
+        def caller():
+            g(2)
+        """)
+    (call,) = _calls_in(p, "m:caller")
+    assert p.resolve_call(call, p.functions["m:caller"]).qualname \
+        == "lib:f"
+
+
+def test_resolve_alias_chain_partial_of_jit():
+    p = _project(
+        m="""
+        import jax
+        from functools import partial
+
+        def f(a, b):
+            pass
+
+        j = jax.jit(f)
+        g = partial(j, 1)
+
+        def caller():
+            g(2)
+        """)
+    (call,) = _calls_in(p, "m:caller")
+    assert p.resolve_call(call, p.functions["m:caller"]).qualname == "m:f"
+
+
+def test_resolve_through_project_decorator_closure():
+    p = _project(
+        m="""
+        def traced(fn):
+            def wrapper(*a, **kw):
+                return fn(*a, **kw)
+            return wrapper
+
+        def f():
+            pass
+
+        g = traced(f)
+
+        def caller():
+            g()
+        """)
+    (call,) = _calls_in(p, "m:caller")
+    assert p.resolve_call(call, p.functions["m:caller"]).qualname == "m:f"
+
+
+def test_plain_data_call_is_not_an_alias():
+    # ``x = compute(f)`` is a value, not a forwarding wrapper — calling
+    # ``x`` must NOT resolve to f
+    p = _project(
+        m="""
+        def compute(fn):
+            return fn() + 1
+
+        def f():
+            return 0
+
+        x = compute(f)
+
+        def caller():
+            x()
+        """)
+    (call,) = _calls_in(p, "m:caller")
+    assert p.resolve_call(call, p.functions["m:caller"]) is None
+
+
+def test_real_functions_shadow_aliases():
+    p = _project(
+        m="""
+        from functools import partial
+
+        def f():
+            pass
+
+        def g():
+            pass
+
+        g2 = partial(f)
+
+        def caller():
+            g()
+        """)
+    (call,) = _calls_in(p, "m:caller")
+    assert p.resolve_call(call, p.functions["m:caller"]).qualname == "m:g"
+
+
+def test_dl113_sees_through_partial_alias():
+    from chainermn_tpu.analysis import lint_source
+    import textwrap as _tw
+    src = _tw.dedent("""
+        from functools import partial
+        import jax
+
+        def sync(comm):
+            comm.allreduce(1)
+
+        do_sync = partial(sync)
+
+        def step(comm):
+            if comm.rank == 0:
+                do_sync(comm)
+        """)
+    findings = [f for f in lint_source(src, "fx.py") if f.rule == "DL113"]
+    assert len(findings) >= 1

@@ -1,0 +1,351 @@
+"""Unit fixtures for the value-level dataflow engine
+(chainermn_tpu.analysis.dataflow): reaching definitions through
+branch/loop/try topology, def-use chains, derivation closures, and the
+interprocedural parameter summaries the DL118–DL122 rules stand on.
+
+Pure-AST tests: no jax import, no devices, tier-1 at zero cost.
+"""
+
+import ast
+import textwrap
+
+from chainermn_tpu.analysis.callgraph import Project
+from chainermn_tpu.analysis.dataflow import (
+    Analysis,
+    DefUse,
+    map_args_to_params,
+    positional_param_indices,
+    scopes_in,
+)
+
+
+def _func(src, name=None):
+    tree = ast.parse(textwrap.dedent(src))
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, ast.FunctionDef)
+             and (name is None or n.name == name)]
+    return funcs[0]
+
+
+def _du(src, name=None):
+    return DefUse.of(_func(src, name))
+
+
+def _loads_named(du, name):
+    """All (node, defs) load records for a given variable name."""
+    return [(n, defs) for n, defs in du._loads.values() if n.id == name]
+
+
+def _project(**sources):
+    files = {}
+    for name, src in sources.items():
+        files[name.replace(".", "/") + ".py"] = \
+            (ast.parse(textwrap.dedent(src)), src)
+    return Project.build(files)
+
+
+# ---------------------------------------------------------------------------
+# reaching definitions
+# ---------------------------------------------------------------------------
+
+
+def test_straight_line_rebind_kills_old_def():
+    du = _du("""
+    def f():
+        x = 1
+        x = 2
+        return x
+    """)
+    (load,) = _loads_named(du, "x")
+    (d,) = load[1]
+    assert d.line == 4          # only the second binding reaches
+
+
+def test_if_merge_keeps_both_arms():
+    du = _du("""
+    def f(c):
+        if c:
+            x = 1
+        else:
+            x = 2
+        return x
+    """)
+    (load,) = _loads_named(du, "x")
+    assert sorted(d.line for d in load[1]) == [4, 6]
+
+
+def test_terminating_arm_does_not_reach_join():
+    du = _du("""
+    def f(c):
+        x = 1
+        if c:
+            x = 2
+            return x
+        return x
+    """)
+    loads = _loads_named(du, "x")
+    final = [defs for n, defs in loads if n.lineno == 7]
+    assert [sorted(d.line for d in defs) for defs in final] == [[3]]
+
+
+def test_loop_body_sees_entry_and_backedge_defs():
+    du = _du("""
+    def f(xs):
+        y = 0
+        for x in xs:
+            y = y + x
+        return y
+    """)
+    # the y load inside the body (line 5) must see both the entry def
+    # (line 3) and the back-edge def (line 5 itself)
+    in_body = [defs for n, defs in _loads_named(du, "y")
+               if n.lineno == 5]
+    assert any(sorted(d.line for d in defs) == [3, 5]
+               for defs in in_body)
+
+
+def test_try_handler_sees_pre_and_mid_body_defs():
+    du = _du("""
+    def f():
+        x = 1
+        try:
+            x = 2
+            risky()
+        except Exception:
+            use(x)
+        return x
+    """)
+    handler = [defs for n, defs in _loads_named(du, "x")
+               if n.lineno == 8]
+    assert [sorted(d.line for d in defs) for defs in handler] == [[3, 5]]
+
+
+def test_nested_def_binds_name_without_descending():
+    du = _du("""
+    def f():
+        def g():
+            return hidden
+        return g
+    """, name="f")
+    assert _loads_named(du, "hidden") == []     # body not interpreted
+    (load,) = _loads_named(du, "g")
+    assert len(load[1]) == 1
+
+
+def test_comprehension_targets_scope_out():
+    du = _du("""
+    def f(xs):
+        ys = [x * 2 for x in xs]
+        return x
+    """)
+    # the trailing x load must NOT see the comprehension binding
+    final = [defs for n, defs in _loads_named(du, "x") if n.lineno == 4]
+    assert final == [set()]
+
+
+# ---------------------------------------------------------------------------
+# def-use queries
+# ---------------------------------------------------------------------------
+
+
+def test_calls_and_expr_statements_recorded_in_order():
+    du = _du("""
+    def f(k):
+        a(k)
+        b(k)
+        c(k)
+    """)
+    assert [n.func.id for n in du.calls] == ["a", "b", "c"]
+    assert len(du.expr_statements) == 3
+
+
+def test_derived_from_closes_over_value_exprs():
+    du = _du("""
+    def f(a):
+        b = g(a)
+        c = b + 1
+        d = 7
+        return c, d
+    """)
+    seed = {du.params["a"]}
+    derived = du.derived_from(seed)
+    assert {d.name for d in derived} == {"a", "b", "c"}
+
+
+def test_derived_from_stops_at_static_attrs():
+    du = _du("""
+    def f(x):
+        n = x.shape[0]
+        y = x * 2
+        return n, y
+    """)
+    derived = du.derived_from({du.params["x"]},
+                              skip_attrs=("shape",))
+    assert {d.name for d in derived} == {"x", "y"}
+
+
+def test_alias_origins_tracks_aliases_not_derivation():
+    du = _du("""
+    def f(key, n):
+        k2 = key
+        fresh = make((n,))
+        a, b = key, n
+        return k2, fresh, a, b
+    """)
+    origins = du.alias_origins(positional_param_indices(
+        _func("""
+    def f(key, n):
+        pass
+    """)))
+    by_name = {}
+    for d in du.defs:
+        if d.uid in origins:
+            by_name.setdefault(d.name, set()).update(origins[d.uid])
+    assert by_name.get("k2") == {0}          # pure alias
+    assert "fresh" not in by_name            # derived, not aliased
+    assert by_name.get("a") == {0}           # tuple-unpack element 0
+    assert by_name.get("b") == {1}           # tuple-unpack element 1
+
+
+def test_param_origins_tracks_full_derivation():
+    du = _du("""
+    def f(key, n):
+        fresh = make((n,))
+        return fresh
+    """)
+    origins = du.param_origins({"key": 0, "n": 1})
+    fresh = [d for d in du.defs if d.name == "fresh"][0]
+    assert origins[fresh.uid] == {1}
+
+
+# ---------------------------------------------------------------------------
+# argument/parameter mapping
+# ---------------------------------------------------------------------------
+
+
+def test_map_args_to_params_plain_and_keyword():
+    callee = _func("""
+    def f(a, b, c=3):
+        pass
+    """)
+    from chainermn_tpu.analysis.callgraph import FunctionInfo
+    info = FunctionInfo("m:f", "m", "f", None, callee, "m.py")
+    call = ast.parse("f(x, c=z)").body[0].value
+    out = map_args_to_params(call, info)
+    assert {i: ast.unparse(e) for i, e in out.items()} \
+        == {0: "x", 2: "z"}
+
+
+def test_map_args_to_params_offsets_self_for_method_receiver():
+    callee = _func("""
+    def meth(self, a):
+        pass
+    """)
+    from chainermn_tpu.analysis.callgraph import FunctionInfo
+    info = FunctionInfo("m:C.meth", "m", "meth", "C", callee, "m.py")
+    call = ast.parse("obj.meth(x)").body[0].value
+    out = map_args_to_params(call, info)
+    assert {i: ast.unparse(e) for i, e in out.items()} == {1: "x"}
+
+
+# ---------------------------------------------------------------------------
+# interprocedural summaries
+# ---------------------------------------------------------------------------
+
+
+def _consume_sink_detector(du, call, func):
+    """Test detector: ``sink(x)`` consumes its first argument."""
+    if isinstance(call.func, ast.Name) and call.func.id == "sink":
+        return [(call.args[0], "sunk")] if call.args else []
+    return []
+
+
+def test_summary_direct_consumption():
+    p = _project(
+        m="""
+        def f(a, b):
+            sink(a)
+            return b
+        """)
+    analysis = Analysis.of(p)
+    s = analysis.summary(p.functions["m:f"], _consume_sink_detector,
+                         "test")
+    assert s.consumed == {0: "sunk"}
+    assert s.returned == {1}
+
+
+def test_summary_composes_through_calls():
+    p = _project(
+        m="""
+        def leaf(x):
+            sink(x)
+
+        def mid(y):
+            leaf(y)
+
+        def top(z, keep):
+            mid(z)
+            return keep
+        """)
+    analysis = Analysis.of(p)
+    s = analysis.summary(p.functions["m:top"], _consume_sink_detector,
+                         "test")
+    assert set(s.consumed) == {0}
+    assert "via" in s.consumed[0]
+    assert s.returned == {1}
+
+
+def test_summary_alias_only_composition():
+    # a value DERIVED from the param inside the callee being consumed
+    # does not consume the caller's param
+    p = _project(
+        m="""
+        def inner(n):
+            fresh = make((n,))
+            sink(fresh)
+
+        def top(n):
+            inner(n)
+            return n
+        """)
+    analysis = Analysis.of(p)
+    s = analysis.summary(p.functions["m:top"], _consume_sink_detector,
+                         "test")
+    assert s.consumed == {}
+
+
+def test_summary_recursion_is_cycle_guarded():
+    p = _project(
+        m="""
+        def a(x):
+            b(x)
+
+        def b(x):
+            a(x)
+            sink(x)
+        """)
+    analysis = Analysis.of(p)
+    s = analysis.summary(p.functions["m:a"], _consume_sink_detector,
+                         "test")
+    assert set(s.consumed) == {0}     # terminates, still sees the sink
+
+
+def test_analysis_shared_per_project():
+    p = _project(m="def f():\n    pass\n")
+    assert Analysis.of(p) is Analysis.of(p)
+
+
+def test_scopes_in_lists_module_and_all_functions():
+    tree = ast.parse(textwrap.dedent("""
+    def f():
+        def inner():
+            pass
+
+    class C:
+        def meth(self):
+            pass
+    """))
+    scopes = scopes_in(tree)
+    assert scopes[0] is tree
+    assert sorted(s.name for s in scopes[1:]) \
+        == ["f", "inner", "meth"]

@@ -1,0 +1,559 @@
+"""dlint dataflow-rule fixtures (DL118–DL122): every rule trips on a
+seeded violation and stays quiet on its clean twin — the contract the
+catalogue rows in docs/static_analysis.md promise.
+
+Pure-AST tests (no jax import, no devices), plus one module-scoped run
+over the real repo roots asserting each dataflow rule is clean on the
+code it ships with (the finding-or-clean acceptance check).
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from chainermn_tpu.analysis import lint_source, run_lint
+
+
+def _lint(src, rules=None):
+    return lint_source(textwrap.dedent(src), "fixture.py", rules=rules)
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# DL118 — prng-key-reuse
+# ---------------------------------------------------------------------------
+
+
+def test_dl118_flags_straight_line_key_reuse():
+    src = """\
+    import jax
+
+    def sample(key):
+        a = jax.random.normal(key, (4,))
+        b = jax.random.uniform(key, (4,))
+        return a, b
+    """
+    fs = _only(_lint(src), "DL118")
+    assert len(fs) == 1
+    assert fs[0].line == 5
+    assert "'key'" in fs[0].message
+    assert "docs/static_analysis.md#dl118" in fs[0].message
+
+
+def test_dl118_flags_reuse_across_loop_iterations():
+    src = """\
+    import jax
+
+    def sample(key, xs):
+        out = []
+        for x in xs:
+            out.append(jax.random.normal(key, (4,)))
+        return out
+    """
+    fs = _only(_lint(src), "DL118")
+    assert len(fs) == 1
+    assert fs[0].line == 6
+
+
+def test_dl118_flags_discarded_split_result():
+    src = """\
+    import jax
+
+    def advance(key):
+        jax.random.split(key)
+        return key
+    """
+    fs = _only(_lint(src), "DL118")
+    assert len(fs) == 1
+    assert "discarded" in fs[0].message
+
+
+def test_dl118_flags_reuse_through_a_callee():
+    src = """\
+    import jax
+
+    def draw(k):
+        return jax.random.normal(k, (4,))
+
+    def sample(key):
+        a = draw(key)
+        b = draw(key)
+        return a, b
+    """
+    fs = _only(_lint(src), "DL118")
+    assert len(fs) == 1
+    assert fs[0].line == 8
+
+
+def test_dl118_clean_split_and_rebind():
+    src = """\
+    import jax
+
+    def sample(key):
+        key, sub = jax.random.split(key)
+        a = jax.random.normal(sub, (4,))
+        key, sub = jax.random.split(key)
+        b = jax.random.uniform(sub, (4,))
+        return a, b
+    """
+    assert _only(_lint(src), "DL118") == []
+
+
+def test_dl118_clean_distinct_split_indices():
+    src = """\
+    import jax
+
+    def sample(key):
+        ks = jax.random.split(key, 3)
+        a = jax.random.normal(ks[0], (4,))
+        b = jax.random.uniform(ks[1], (4,))
+        return a, b
+    """
+    assert _only(_lint(src), "DL118") == []
+
+
+def test_dl118_clean_fold_in_per_iteration():
+    # the sanctioned loop idiom (training/step.py): fold varying data
+    # into one base key, consume only the folded keys
+    src = """\
+    import jax
+
+    def sample(key, xs):
+        out = []
+        for i, x in enumerate(xs):
+            k = jax.random.fold_in(key, i)
+            out.append(jax.random.normal(k, (4,)))
+        return out
+    """
+    assert _only(_lint(src), "DL118") == []
+
+
+def test_dl118_clean_one_consumer_per_branch_arm():
+    src = """\
+    import jax
+
+    def sample(key, gumbel):
+        if gumbel:
+            return jax.random.gumbel(key, (4,))
+        return jax.random.normal(key, (4,))
+    """
+    assert _only(_lint(src), "DL118") == []
+
+
+# ---------------------------------------------------------------------------
+# DL119 — use-after-donation
+# ---------------------------------------------------------------------------
+
+
+def test_dl119_flags_read_after_donating_call():
+    src = """\
+    import jax
+
+    def _impl(state):
+        return state
+
+    step = jax.jit(_impl, donate_argnums=(0,))
+
+    def run(state):
+        out = step(state)
+        return state + out
+    """
+    fs = _only(_lint(src), "DL119")
+    assert len(fs) == 1
+    assert fs[0].line == 10
+    assert "'state'" in fs[0].message
+    assert "docs/static_analysis.md#dl119" in fs[0].message
+
+
+def test_dl119_flags_self_attribute_jit_alias():
+    src = """\
+    import jax
+
+    class Runner:
+        def __init__(self, fn):
+            self._step = jax.jit(fn, donate_argnums=(0,))
+
+        def run(self, state):
+            out = self._step(state)
+            return state.sum() + out
+    """
+    fs = _only(_lint(src), "DL119")
+    assert len(fs) == 1
+    assert fs[0].line == 9
+
+
+def test_dl119_flags_donation_through_a_callee():
+    src = """\
+    import jax
+
+    def _impl(state):
+        return state
+
+    step = jax.jit(_impl, donate_argnums=(0,))
+
+    def advance(s):
+        return step(s)
+
+    def run(state):
+        advance(state)
+        return state
+    """
+    fs = _only(_lint(src), "DL119")
+    assert len(fs) == 1
+    assert fs[0].line == 13
+
+
+def test_dl119_clean_rebind_over_input():
+    src = """\
+    import jax
+
+    def _impl(state):
+        return state
+
+    step = jax.jit(_impl, donate_argnums=(0,))
+
+    def run(state):
+        state = step(state)
+        return state
+    """
+    assert _only(_lint(src), "DL119") == []
+
+
+def test_dl119_clean_conditional_donation_stays_opaque():
+    # maybe-donated must not flag: the (0,) if donate else () switch is
+    # deliberately not resolved
+    src = """\
+    import jax
+
+    def _impl(state):
+        return state
+
+    def make(donate):
+        return jax.jit(_impl,
+                       donate_argnums=(0,) if donate else ())
+
+    step = make(True)
+
+    def run(state):
+        out = step(state)
+        return state + out
+    """
+    assert _only(_lint(src), "DL119") == []
+
+
+def test_dl119_clean_callee_donates_derived_value_not_param():
+    src = """\
+    import jax
+    import jax.numpy as jnp
+
+    def _impl(state):
+        return state
+
+    step = jax.jit(_impl, donate_argnums=(0,))
+
+    def advance(n):
+        buf = jnp.zeros((n,))
+        return step(buf)
+
+    def run(n):
+        advance(n)
+        return n
+    """
+    assert _only(_lint(src), "DL119") == []
+
+
+# ---------------------------------------------------------------------------
+# DL120 — nondeterministic-iteration
+# ---------------------------------------------------------------------------
+
+
+def test_dl120_flags_set_iteration_driving_tagged_sends():
+    src = """\
+    def fan_out(comm, peers, payload):
+        targets = set(peers)
+        for p in targets:
+            comm.send(payload, dest=p, tag=7)
+    """
+    fs = _only(_lint(src), "DL120")
+    assert len(fs) == 1
+    assert fs[0].line == 3
+    assert "'targets'" in fs[0].message
+    assert "docs/static_analysis.md#dl120" in fs[0].message
+
+
+def test_dl120_flags_direct_set_call_iteration():
+    src = """\
+    def fan_out(comm, peers, payload):
+        for p in set(peers):
+            comm.send(payload, dest=p, tag=7)
+    """
+    fs = _only(_lint(src), "DL120")
+    assert len(fs) == 1
+    assert fs[0].line == 2
+    assert "set(...)" in fs[0].message
+
+
+def test_dl120_flags_set_iteration_driving_collectives():
+    src = """\
+    def sync_all(comm, shards):
+        for s in {x.name for x in shards}:
+            comm.allreduce(s)
+    """
+    fs = _only(_lint(src), "DL120")
+    assert len(fs) == 1
+
+
+def test_dl120_flags_signature_tuple_built_from_set():
+    src = """\
+    def trace_key(shapes):
+        seen = set(shapes)
+        sig = tuple(seen)
+        return sig
+    """
+    fs = _only(_lint(src), "DL120")
+    assert len(fs) == 1
+    assert fs[0].line == 3
+    assert "'sig'" in fs[0].message
+
+
+def test_dl120_clean_sorted_set_iteration():
+    src = """\
+    def fan_out(comm, peers, payload):
+        targets = set(peers)
+        for p in sorted(targets):
+            comm.send(payload, dest=p, tag=7)
+    """
+    assert _only(_lint(src), "DL120") == []
+
+
+def test_dl120_clean_set_loop_without_comm():
+    src = """\
+    def total(peers):
+        acc = 0
+        for p in set(peers):
+            acc += p
+        return acc
+    """
+    assert _only(_lint(src), "DL120") == []
+
+
+def test_dl120_clean_dict_iteration():
+    # dict order is a language guarantee (3.7+) — the repo relies on it
+    src = """\
+    def fan_out(comm, routes, payload):
+        for p in routes:
+            comm.send(payload, dest=p, tag=7)
+    """
+    assert _only(_lint(src), "DL120") == []
+
+
+# ---------------------------------------------------------------------------
+# DL121 — host-sync-in-decode
+# ---------------------------------------------------------------------------
+
+
+def test_dl121_flags_np_asarray_in_decode_root():
+    src = """\
+    import numpy as np
+
+    def decode_k_step(tokens, logits):
+        host = np.asarray(logits)
+        return host
+    """
+    fs = _only(_lint(src), "DL121")
+    assert len(fs) == 1
+    assert fs[0].line == 4
+    assert "np.asarray" in fs[0].message
+    assert "docs/static_analysis.md#dl121" in fs[0].message
+
+
+def test_dl121_flags_host_pull_reached_through_callee():
+    src = """\
+    def _pull(v):
+        return float(v)
+
+    def decode_k_loop(logits):
+        return _pull(logits)
+    """
+    fs = _only(_lint(src), "DL121")
+    assert len(fs) == 1
+    assert fs[0].line == 2
+    assert "reached from decode_k_loop" in fs[0].message
+
+
+def test_dl121_flags_item_in_serving_step_method():
+    src = """\
+    class ServingStep:
+        def step(self, tokens):
+            return tokens.item()
+    """
+    fs = _only(_lint(src), "DL121")
+    assert len(fs) == 1
+    assert ".item()" in fs[0].message
+
+
+def test_dl121_clean_device_resident_decode():
+    src = """\
+    import jax.numpy as jnp
+
+    def decode_k_step(logits):
+        return jnp.argmax(logits, axis=-1)
+    """
+    assert _only(_lint(src), "DL121") == []
+
+
+def test_dl121_clean_self_state_pull():
+    # sanctioned debug pulls (ServingStep.cursors) read self.cache —
+    # self is not a data parameter
+    src = """\
+    import numpy as np
+
+    class ServingStep:
+        def cursors(self):
+            return np.asarray(self.cache)
+    """
+    assert _only(_lint(src), "DL121") == []
+
+
+def test_dl121_clean_test_functions_are_not_roots():
+    src = """\
+    import numpy as np
+
+    def test_decode_k_eos_masks(logits):
+        return np.asarray(logits)
+    """
+    assert _only(_lint(src), "DL121") == []
+
+
+# ---------------------------------------------------------------------------
+# DL122 — trace-count-instability
+# ---------------------------------------------------------------------------
+
+
+def test_dl122_flags_if_on_traced_argument():
+    src = """\
+    import jax
+
+    @jax.jit
+    def act(x):
+        if x > 0:
+            return x
+        return -x
+    """
+    fs = _only(_lint(src), "DL122")
+    assert len(fs) == 1
+    assert fs[0].line == 5
+    assert "'x'" in fs[0].message
+    assert "docs/static_analysis.md#dl122" in fs[0].message
+
+
+def test_dl122_flags_while_in_jit_application_form():
+    src = """\
+    import jax
+
+    def countdown(x):
+        while x > 0:
+            x = x - 1
+        return x
+
+    stepped = jax.jit(countdown)
+    """
+    fs = _only(_lint(src), "DL122")
+    assert len(fs) == 1
+    assert "while" in fs[0].message
+
+
+def test_dl122_clean_static_argnums():
+    src = """\
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(0,))
+    def scale(n, x):
+        if n > 3:
+            return x * 2
+        return x
+    """
+    assert _only(_lint(src), "DL122") == []
+
+
+def test_dl122_clean_shape_branch_is_trace_time():
+    src = """\
+    import jax
+
+    @jax.jit
+    def reduce(x):
+        if x.shape[0] > 1:
+            return x.sum()
+        return x
+    """
+    assert _only(_lint(src), "DL122") == []
+
+
+def test_dl122_clean_is_none_dispatch():
+    src = """\
+    import jax
+
+    @jax.jit
+    def apply(x, mask):
+        if mask is None:
+            return x
+        return x * mask
+    """
+    assert _only(_lint(src), "DL122") == []
+
+
+def test_dl122_clean_defaulted_capture_param():
+    src = """\
+    import jax
+
+    @jax.jit
+    def act(x, _k=3):
+        if _k > 2:
+            return x * 2
+        return x
+    """
+    assert _only(_lint(src), "DL122") == []
+
+
+def test_dl122_clean_uncompiled_function():
+    src = """\
+    def act(x):
+        if x > 0:
+            return x
+        return -x
+    """
+    assert _only(_lint(src), "DL122") == []
+
+
+# ---------------------------------------------------------------------------
+# the repo itself, per rule — the finding-or-clean acceptance check
+# ---------------------------------------------------------------------------
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_ROOTS = [os.path.join(_REPO, d)
+          for d in ("chainermn_tpu", "examples", "tests", "tools")]
+
+
+@pytest.fixture(scope="module")
+def dataflow_repo_run():
+    return run_lint(_ROOTS,
+                    rules=["DL118", "DL119", "DL120", "DL121", "DL122"])
+
+
+@pytest.mark.parametrize("rule", ["DL118", "DL119", "DL120", "DL121",
+                                  "DL122"])
+def test_repo_is_clean_per_dataflow_rule(dataflow_repo_run, rule):
+    fs = _only(dataflow_repo_run.findings, rule)
+    assert fs == [], "\n" + "\n".join(f.format() for f in fs)
+
+
+def test_repo_run_exercised_every_dataflow_pass(dataflow_repo_run):
+    # the clean verdict above is only meaningful if the passes ran
+    assert {"DL118", "DL119", "DL120", "DL121",
+            "DL122"} <= set(dataflow_repo_run.timings)

@@ -233,3 +233,127 @@ def test_report_suppressions_quiet_when_all_live(tmp_path):
     proc = _cli(str(p), "--report-suppressions")
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert "no dead suppressions" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# SARIF round-trip (from_sarif) and suppression recording
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_roundtrip_preserves_rule_ids_and_locations(tmp_path):
+    from chainermn_tpu.analysis import from_sarif
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD)
+    findings = lint_source(_BAD, str(bad))
+    assert findings
+    log = to_sarif(findings, root=str(tmp_path))
+    back, _sups = from_sarif(log)
+    assert [(f.rule, f.line, f.message) for f in back] \
+        == [(f.rule, f.line, f.message) for f in findings]
+    assert all(f.path == "bad.py" for f in back)   # repo-relative
+
+
+def test_sarif_roundtrip_through_json_serialization(tmp_path):
+    from chainermn_tpu.analysis import from_sarif
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD)
+    findings = lint_source(_BAD, str(bad))
+    log = json.loads(json.dumps(to_sarif(findings, root=str(tmp_path))))
+    back, _ = from_sarif(log)
+    assert {(f.rule, f.line) for f in back} \
+        == {(f.rule, f.line) for f in findings}
+
+
+def test_sarif_records_suppressions_and_roundtrips_them(tmp_path):
+    from chainermn_tpu.analysis import from_sarif, run_lint
+
+    src = (
+        "def f(comm):\n"
+        "    if comm.rank == 0:\n"
+        "        comm.barrier()  # dlint: disable=DL101 — drain rank\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    run = run_lint([str(tmp_path)])
+    assert run.findings == []
+    (sup,) = [s for s in run.suppressions if s.hits > 0]
+    log = to_sarif(run.findings, root=str(tmp_path),
+                   suppressions=run.suppressions)
+    recorded = log["runs"][0]["properties"]["suppressions"]
+    assert recorded == [{"uri": "mod.py", "line": 3,
+                         "rules": ["DL101"], "hits": sup.hits}]
+    _back, sups = from_sarif(log)
+    assert [(s.path, s.line, s.rules, s.hits) for s in sups] \
+        == [("mod.py", 3, {"DL101"}, sup.hits)]
+
+
+def test_sarif_without_suppressions_has_no_properties(tmp_path):
+    log = to_sarif([], root=str(tmp_path))
+    assert "properties" not in log["runs"][0]
+
+
+def test_from_sarif_rejects_non_sarif():
+    from chainermn_tpu.analysis import from_sarif
+
+    with pytest.raises(ValueError):
+        from_sarif({"not": "sarif"})
+
+
+def test_baseline_gating_stable_under_file_reordering(tmp_path):
+    """Fingerprints and gating must not depend on the order files are
+    fed to the driver (os.walk order differs across filesystems)."""
+    from chainermn_tpu.analysis import run_lint_sources
+
+    src_a = (
+        "def f(comm):\n"
+        "    if comm.rank == 0:\n"
+        "        comm.barrier()\n"
+    )
+    src_b = (
+        "def g(comm):\n"
+        "    if comm.rank == 1:\n"
+        "        comm.psum(1)\n"
+    )
+    a, b = tmp_path / "a.py", tmp_path / "b.py"
+    a.write_text(src_a)
+    b.write_text(src_b)
+    fwd = run_lint_sources({str(a): src_a, str(b): src_b}).findings
+    rev_sources = {str(b): src_b, str(a): src_a}
+    rev = run_lint_sources(rev_sources).findings
+    fps_fwd = [fp for _, fp in fingerprints(fwd, root=str(tmp_path))]
+    fps_rev = [fp for _, fp in fingerprints(rev, root=str(tmp_path))]
+    assert fps_fwd == fps_rev
+    base = tmp_path / "base.json"
+    data = write_baseline(str(base), fwd, root=str(tmp_path))
+    assert data["findings"] == sorted(data["findings"])
+    known = load_baseline(str(base))
+    assert filter_new(rev, known, root=str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# --timings
+# ---------------------------------------------------------------------------
+
+
+def test_timings_flag_writes_per_pass_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD)
+    out = tmp_path / "timings.json"
+    proc = _cli(str(bad), "--timings", str(out))
+    assert proc.returncode == 1        # findings still reported
+    data = json.loads(out.read_text())
+    assert data["total_seconds"] >= 0
+    assert "parse" in data["passes"]
+    assert "DL101" in data["passes"]
+    assert all(v >= 0 for v in data["passes"].values())
+
+
+def test_timings_dash_goes_to_stderr(tmp_path):
+    clean = tmp_path / "ok.py"
+    clean.write_text("x = 1\n")
+    proc = _cli(str(clean), "--timings", "-")
+    assert proc.returncode == 0, proc.stderr
+    data = json.loads(proc.stderr[proc.stderr.index("{"):])
+    assert "total_seconds" in data

@@ -42,10 +42,11 @@ def test_repo_has_no_dead_suppressions(repo_run):
 
 
 def test_interprocedural_suppressions_carry_rationales(repo_run):
-    # a DL113–DL116 suppression claims a whole-program property doesn't
+    # a DL113–DL122 suppression claims a whole-program property doesn't
     # hold at that site; the claim needs a stated reason on the line —
     # enforced as "text beyond the bare marker"
-    new_rules = {"DL113", "DL114", "DL115", "DL116"}
+    new_rules = {"DL113", "DL114", "DL115", "DL116",
+                 "DL118", "DL119", "DL120", "DL121", "DL122"}
     bare = []
     for s in repo_run.suppressions:
         if not (s.rules & new_rules):
@@ -60,16 +61,20 @@ def test_interprocedural_suppressions_carry_rationales(repo_run):
         + "\n".join(bare)
 
 
-def test_dlint_cli_all_sarif_baseline_exits_zero():
+def test_dlint_cli_all_sarif_baseline_exits_zero(tmp_path):
     """The acceptance-criteria run: ``--all --format sarif --baseline
-    <committed> --report-suppressions`` must exit 0 and emit valid
-    SARIF 2.1.0 with zero results."""
+    <committed> --report-suppressions --timings`` must exit 0, emit
+    valid SARIF 2.1.0 with zero results, and finish inside the
+    recorded budget (tools/dlint_budget.json) — a new pass cannot
+    silently eat the tier-1 verify window."""
+    timings_file = tmp_path / "timings.json"
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "tools", "dlint.py"),
          "--all", "--format", "sarif",
          "--baseline", os.path.join(_REPO, "tools",
                                     "dlint_baseline.json"),
-         "--report-suppressions"],
+         "--report-suppressions",
+         "--timings", str(timings_file)],
         capture_output=True, text=True, timeout=300, cwd=_REPO)
     assert proc.returncode == 0, (proc.stdout[-4000:],
                                   proc.stderr[-2000:])
@@ -79,7 +84,24 @@ def test_dlint_cli_all_sarif_baseline_exits_zero():
     assert run["tool"]["driver"]["name"] == "dlint"
     assert run["results"] == []
     ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
-    assert {"DL113", "DL114", "DL115", "DL116"} <= ids
+    assert {"DL113", "DL114", "DL115", "DL116",
+            "DL118", "DL119", "DL120", "DL121", "DL122"} <= ids
+    # recorded suppressions ride along in the SARIF run properties
+    sups = run["properties"]["suppressions"]
+    assert all(s["hits"] > 0 for s in sups)
+
+    timings = json.loads(timings_file.read_text())
+    with open(os.path.join(_REPO, "tools",
+                           "dlint_budget.json")) as fh:
+        budget = json.load(fh)["all_seconds"]
+    assert timings["total_seconds"] < budget, (
+        f"full --all run took {timings['total_seconds']}s, budget is "
+        f"{budget}s — slowest passes: "
+        + str(sorted(timings["passes"].items(),
+                     key=lambda kv: -kv[1])[:5]))
+    # every dataflow pass reports its own wall time
+    assert {"DL118", "DL119", "DL120", "DL121",
+            "DL122"} <= set(timings["passes"])
 
 
 def test_dlint_cli_reports_seeded_violation(tmp_path):

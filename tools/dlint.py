@@ -3,9 +3,9 @@
 
 Runs the :mod:`chainermn_tpu.analysis` source passes — the per-file
 AST rules (DL101–DL112, DL117) and the whole-program project rules
-(DL113–DL116, which see through call chains via the repo call graph) —
-and prints one ``path:line: RULE message`` finding per line.
-Exit status: 0 clean, 1 findings, 2 usage error.
+(DL113–DL116 call-graph sequence/lock checks, DL118–DL122 value-level
+dataflow checks) — and prints one ``path:line: RULE message`` finding
+per line. Exit status: 0 clean, 1 findings, 2 usage error.
 
 Usage::
 
@@ -18,6 +18,11 @@ Usage::
     python tools/dlint.py --all --write-baseline tools/dlint_baseline.json
     python tools/dlint.py --changed             # only files in the git diff
     python tools/dlint.py --all --report-suppressions
+    python tools/dlint.py --all --timings dlint_timings.json
+
+``--timings`` records per-pass wall time; the suite compares a full
+``--all`` run against the budget in ``tools/dlint_budget.json`` so a
+new pass cannot silently eat the tier-1 verify window.
 
 ``--baseline`` gates on NEW findings only: anything fingerprinted in
 the baseline file passes (the ratchet — old debt burns down
@@ -99,6 +104,10 @@ def main(argv=None):
     ap.add_argument("--report-suppressions", action="store_true",
                     help="list '# dlint: disable' comments that "
                          "suppressed zero findings (exit 1 if any)")
+    ap.add_argument("--timings", metavar="FILE", default=None,
+                    help="write per-pass wall-time JSON to FILE "
+                         "('-' for stderr) — CI compares the total "
+                         "against tools/dlint_budget.json")
     ap.add_argument("--hlo", metavar="FILE", default=None,
                     help="also run argument-free HLO passes on a saved "
                          "compiled.as_text() dump")
@@ -152,8 +161,24 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
+    import time as _time
+    t_run = _time.perf_counter()
     run = run_lint(paths, rules=rules, only=only) if paths else None
+    t_run = _time.perf_counter() - t_run
     findings = run.findings if run is not None else []
+
+    if args.timings and run is not None:
+        payload = {
+            "total_seconds": round(t_run, 3),
+            "passes": {k: round(v, 4)
+                       for k, v in sorted(run.timings.items())},
+        }
+        text = json.dumps(payload, indent=2, sort_keys=True) + "\n"
+        if args.timings == "-":
+            sys.stderr.write(text)
+        else:
+            with open(args.timings, "w", encoding="utf-8") as fh:
+                fh.write(text)
 
     if args.write_baseline:
         write_baseline(args.write_baseline, findings, root=repo)
@@ -171,8 +196,9 @@ def main(argv=None):
         gated = filter_new(findings, known, root=repo)
 
     if args.fmt == "sarif":
-        print(json.dumps(to_sarif(gated, root=repo), indent=2,
-                         sort_keys=True))
+        sups = run.suppressions if run is not None else None
+        print(json.dumps(to_sarif(gated, root=repo, suppressions=sups),
+                         indent=2, sort_keys=True))
     else:
         for f in gated:
             print(f.format())
