@@ -506,6 +506,119 @@ def main():
     except Exception as e:  # never sink the headline metric
         record["fleet_conveyor_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # migration gate (docs/serving.md#draining-and-migration), folded
+    # into the same JSON line. Three structural claims: (1) a stream
+    # frozen mid-decode by export_session and adopted over the f32
+    # session wire (manifest format 3) finishes BITWISE the
+    # single-engine stream, with every token billed exactly once
+    # across the two engines; (2) both session wire formats report
+    # exact payload bytes, and the int8-block session wire holds the
+    # same <= 0.27x ratio as the prefill handoff wire; (3) Router.drain
+    # under a corrupt-once chaos wire (the NACK re-send heals it — no
+    # replay fallback) lands the replica DRAINED with every stream
+    # bitwise and the fleet-wide token count conserved: zero dropped,
+    # zero duplicated.
+    try:
+        from chainermn_tpu.fleet.handoff import (decode_handoff,
+                                                 encode_handoff,
+                                                 handoff_payload_bytes)
+        from chainermn_tpu.resilience import chaos as _chaos
+
+        mrng = np.random.RandomState(17)
+        mig_prompts = [mrng.randint(0, 64, (8,)).astype(np.int32)
+                       for _ in range(12)]
+        mig_new = 16                   # room to export past token 1
+
+        ref_eng = Engine(lm, lp, _fleet_cfg())
+        rr = [ref_eng.submit(p, max_new_tokens=mig_new)
+              for p in mig_prompts]
+        ref_eng.run_until_drained()
+        mig_ref = [list(r.tokens) for r in rr]
+
+        src = Engine(lm, lp, _fleet_cfg())
+        dst = Engine(lm, lp, _fleet_cfg())
+        mreqs = [src.submit(p, max_new_tokens=mig_new)
+                 for p in mig_prompts[:2]]
+        # export at a BLOCK-ALIGNED fill: each KV row is 32 elements
+        # (4 kv heads x d_head 8), so fill % 8 == 0 makes every leaf an
+        # exact multiple of the 256-element quant block and the 0.27x
+        # wire ratio is the same claim as the prefill-handoff gate
+        # (unaligned fills pad the last block — pinned in tests, not
+        # gated here)
+        for _ in range(200):
+            ntok = len(mreqs[0].tokens)
+            if (mreqs[0].slot is not None
+                    and src.active.get(mreqs[0].slot) is mreqs[0]
+                    and ntok >= 1
+                    and (8 + ntok - 1) % 8 == 0):
+                break
+            src.step()
+        session = src.export_session(mreqs[0])
+        mig_bytes = {}
+        mig_exact = True
+        for wfmt in ("f32", "int8-block"):
+            m, blob = encode_handoff(session, wfmt)
+            mig_bytes[wfmt] = len(blob)
+            mig_exact = mig_exact and handoff_payload_bytes(m) == len(blob)
+        m, blob = encode_handoff(session, "f32")
+        adopted = dst.import_session(decode_handoff(m, blob),
+                                     mig_prompts[0])
+        src.release_held(mreqs[0])
+        src.run_until_drained()
+        dst.run_until_drained()
+        mig_streams = [list(adopted.tokens), list(mreqs[1].tokens)]
+        mig_bitwise = mig_streams == mig_ref[:2]
+        mig_conserved = (src.report.raw()["tokens_emitted"]
+                         + dst.report.raw()["tokens_emitted"]
+                         == sum(len(t) for t in mig_streams))
+        mig_ratio = (mig_bytes["int8-block"] / mig_bytes["f32"]
+                     if mig_bytes["f32"] else 1.0)
+
+        drill = [Engine(lm, lp, _fleet_cfg()),
+                 Engine(lm, lp, _fleet_cfg())]
+        os.environ[_chaos.ENV_VAR] = "corrupt_handoff@offset=0,times=1"
+        try:
+            with Router(drill) as router:
+                futs = [router.submit(p, max_new_tokens=mig_new)
+                        for p in mig_prompts]
+                # don't let drain win the race with the dispatch loop:
+                # the drill is only a drill once the victim holds work
+                t_wait = time.monotonic() + 30.0
+                while (drill[1].report.submitted == 0
+                       and time.monotonic() < t_wait):
+                    time.sleep(0.002)
+                dout = router.drain(1, deadline_ms=120_000)
+                drained = [list(router.result(f, timeout_ms=120_000)
+                                .tokens) for f in futs]
+                states = router.summary()["fleet"]["replica_states"]
+        finally:
+            os.environ.pop(_chaos.ENV_VAR, None)
+        drain_bitwise = drained == mig_ref
+        drain_conserved = (sum(e.report.raw()["tokens_emitted"]
+                               for e in drill)
+                           == sum(len(t) for t in drained))
+        record["migration_bitwise"] = bool(mig_bitwise)
+        record["migration_tokens_conserved"] = bool(mig_conserved)
+        record["migration_wire_bytes_exact"] = bool(mig_exact)
+        record["migration_f32_bytes"] = mig_bytes["f32"]
+        record["migration_int8_bytes"] = mig_bytes["int8-block"]
+        record["migration_int8_vs_f32"] = round(mig_ratio, 6)
+        record["migration_drain_state"] = states[1]
+        record["migration_drain_bitwise"] = bool(drain_bitwise)
+        record["migration_drain_conserved"] = bool(drain_conserved)
+        record["migration_drain_migrated"] = dout["migrated"]
+        record["migration_drain_requeued"] = dout["requeued"]
+        record["migration_drain_fallbacks"] = (
+            router.report.migration_fallbacks)
+        record["migration_gate_ok"] = bool(
+            mig_bitwise and mig_conserved and mig_exact
+            and mig_ratio <= 0.27 and drain_bitwise
+            and states[1] == "DRAINED" and drain_conserved
+            and dout["migrated"] + dout["requeued"] > 0
+            and router.report.migration_fallbacks == 0)
+    except Exception as e:  # never sink the headline metric
+        record["migration_gate_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # async checkpoint plane gate
     # (docs/fault_tolerance.md#checkpoint-cadence), folded into the same
     # JSON line: the per-step stall of saving through

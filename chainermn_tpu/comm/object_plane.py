@@ -456,8 +456,13 @@ class FsObjectPlane:
       file the reader ignores;
     * the sender derives its next sequence from the files already on
       disk, so a restarted incarnation continues the channel instead of
-      overwriting it (consumed files are never deleted — receiver
-      positions are process-local);
+      overwriting it; when :meth:`gc` has pruned every consumed file,
+      the per-channel ``HWM`` high-water mark supplies the floor, so a
+      reborn sender still never reuses a sequence number;
+    * the receiver may :meth:`gc` a channel after resolving frames:
+      the high-water mark is committed atomically BEFORE any file is
+      unlinked, and a reborn receiver seeds its position from it — a
+      crash between the two steps at worst re-deletes, never re-reads;
     * every receive is deadline-sliced exactly like the KV-store path
       (``TimeoutError`` on a miss; ``try_recv_obj`` commits the reader
       position only on success).
@@ -482,15 +487,33 @@ class FsObjectPlane:
         return _os.path.join(self.root, f"p2p_{src}_{dst}_{tag}")
 
     @staticmethod
-    def _on_disk(chan_dir: str) -> int:
-        """Messages already published on a channel (restart-safe seq)."""
+    def _read_hwm(chan_dir: str) -> int:
+        """The channel's GC high-water mark: every seq below it has
+        been consumed and pruned (0 when the channel was never GCed)."""
+        import os as _os
+
+        try:
+            with open(_os.path.join(chan_dir, "HWM")) as f:
+                return int(f.read().strip() or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    @classmethod
+    def _next_seq(cls, chan_dir: str) -> int:
+        """Next unused sequence on a channel (restart-safe): one past
+        the highest frame still on disk, falling back to the GC
+        high-water mark when every consumed frame has been pruned —
+        counting files would re-issue seqs after a :meth:`gc`."""
         import os as _os
 
         try:
             names = _os.listdir(chan_dir)
         except FileNotFoundError:
             return 0
-        return sum(1 for n in names if n.endswith(".obj"))
+        seqs = [int(n[:-4]) for n in names if n.endswith(".obj")]
+        if seqs:
+            return max(seqs) + 1
+        return cls._read_hwm(chan_dir)
 
     def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
         import os as _os
@@ -498,7 +521,7 @@ class FsObjectPlane:
 
         chan = self._chan_dir(self.process_index, dest, tag)
         _os.makedirs(chan, exist_ok=True)
-        seq = self._on_disk(chan)
+        seq = self._next_seq(chan)
         fd, tmp = tempfile.mkstemp(dir=chan, suffix=".tmp")
         try:
             with _os.fdopen(fd, "wb") as f:
@@ -537,18 +560,64 @@ class FsObjectPlane:
             # probe-sliced sleep would add whole probe windows of latency
             time.sleep(min(left, 0.005))
 
-    def recv_obj(self, src: int, tag: int = 0) -> Any:
+    def _pos(self, src: int, tag: int) -> int:
+        """Current reader position, seeded from the channel's GC
+        high-water mark on first access — a reborn receiver must not
+        wait on frames :meth:`gc` already unlinked."""
         chan = (src, tag)
-        seq = self._recv_pos.get(chan, 0)
-        self._recv_pos[chan] = seq + 1
+        if chan not in self._recv_pos:
+            self._recv_pos[chan] = self._read_hwm(
+                self._chan_dir(src, self.process_index, tag))
+        return self._recv_pos[chan]
+
+    def recv_obj(self, src: int, tag: int = 0) -> Any:
+        seq = self._pos(src, tag)
+        self._recv_pos[(src, tag)] = seq + 1
         return pickle.loads(self._read_at(src, tag, seq, None))
 
     def try_recv_obj(self, src: int, tag: int = 0,
                      timeout_ms: Optional[int] = None) -> Any:
         """Bounded receive; the reader position advances only on
         success, so a timed-out poll retries the same slot later."""
-        chan = (src, tag)
-        seq = self._recv_pos.get(chan, 0)
+        seq = self._pos(src, tag)
         data = self._read_at(src, tag, seq, timeout_ms)
-        self._recv_pos[chan] = seq + 1
+        self._recv_pos[(src, tag)] = seq + 1
         return pickle.loads(data)
+
+    def gc(self, src: int, tag: int = 0) -> int:
+        """Prune this receiver's consumed frames on channel
+        ``src → self``. Commits ``HWM = position`` atomically FIRST,
+        then unlinks every ``.obj`` below it; returns the number
+        pruned. Crash-safe in both orders: a crash before the mark
+        leaves extra files (re-GCed later), a crash after it leaves a
+        mark that only covers already-consumed frames. Unconsumed
+        frames (seq >= position) are never touched, so a sender
+        mid-flight loses nothing."""
+        import os as _os
+        import tempfile
+
+        chan_dir = self._chan_dir(src, self.process_index, tag)
+        pos = self._pos(src, tag)
+        _os.makedirs(chan_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=chan_dir, suffix=".tmp")
+        try:
+            with _os.fdopen(fd, "w") as f:
+                f.write(str(pos))
+                f.flush()
+                _os.fsync(f.fileno())
+            _os.replace(tmp, _os.path.join(chan_dir, "HWM"))
+        except BaseException:
+            try:
+                _os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        pruned = 0
+        for name in _os.listdir(chan_dir):
+            if name.endswith(".obj") and int(name[:-4]) < pos:
+                try:
+                    _os.unlink(_os.path.join(chan_dir, name))
+                    pruned += 1
+                except OSError:
+                    pass                # concurrent GC: already gone
+        return pruned

@@ -13,9 +13,11 @@ module turns that dict into ``(manifest, blob)`` and back:
   prices);
 * the **manifest** is a JSON-able dict under the same versioned grammar
   as ``serving/weights.py``: ``format`` 1 (raw) or 2 (blockwise
-  quantized), ``sha256`` + ``bytes`` over the blob, an ``arrays`` table
-  (name/dtype/shape/offset), a ``codec`` block for format 2, and the
-  scalar ``meta`` (cursor, tokens, knobs).
+  quantized) for prefill handoffs, 3/4 for decode→decode SESSION
+  migrations (same payload grammar plus the remaining ``max_new_tokens``
+  budget in ``meta``), ``sha256`` + ``bytes`` over the blob, an
+  ``arrays`` table (name/dtype/shape/offset), a ``codec`` block for the
+  quantized formats, and the scalar ``meta`` (cursor, tokens, knobs).
 
 Wire formats:
 
@@ -43,16 +45,29 @@ import numpy as np
 
 __all__ = ["HandoffError", "encode_handoff", "decode_handoff",
            "handoff_payload_bytes", "HANDOFF_FORMAT_RAW",
-           "HANDOFF_FORMAT_QUANT", "HANDOFF_WIRE_FORMATS"]
+           "HANDOFF_FORMAT_QUANT", "HANDOFF_FORMAT_SESSION_RAW",
+           "HANDOFF_FORMAT_SESSION_QUANT", "HANDOFF_WIRE_FORMATS"]
 
 HANDOFF_FORMAT_RAW = 1
 HANDOFF_FORMAT_QUANT = 2
-_ACCEPTED_FORMATS = (HANDOFF_FORMAT_RAW, HANDOFF_FORMAT_QUANT)
+# decode→decode session migration (Engine.export_session): the same
+# array payload plus the remaining-budget meta — a distinct format id
+# so a mixed-version fleet REFUSES instead of silently dropping the
+# budget (decode_handoff's unknown-format contract)
+HANDOFF_FORMAT_SESSION_RAW = 3
+HANDOFF_FORMAT_SESSION_QUANT = 4
+_ACCEPTED_FORMATS = (HANDOFF_FORMAT_RAW, HANDOFF_FORMAT_QUANT,
+                     HANDOFF_FORMAT_SESSION_RAW,
+                     HANDOFF_FORMAT_SESSION_QUANT)
+_QUANT_FORMATS = (HANDOFF_FORMAT_QUANT, HANDOFF_FORMAT_SESSION_QUANT)
+_SESSION_FORMATS = (HANDOFF_FORMAT_SESSION_RAW,
+                    HANDOFF_FORMAT_SESSION_QUANT)
 
 #: wire formats encode_handoff accepts (f32 = raw bytes, bitwise)
 HANDOFF_WIRE_FORMATS = ("f32", "int8-block")
 
-#: meta keys every manifest must carry (decode validates the set)
+#: meta keys every manifest must carry (decode validates the set);
+#: session formats additionally carry ``max_new_tokens``
 _META_KEYS = ("cursor", "tokens", "prompt_len", "eos_id", "temperature",
               "top_k", "seed")
 
@@ -113,14 +128,24 @@ def encode_handoff(handoff: dict,
                                       "size": int(arr.size)}
     pk.put("key", np.asarray(handoff["key"], np.uint32))
     blob = b"".join(pk.chunks)
+    # a dict carrying max_new_tokens is a decode-session export
+    # (Engine.export_session); plain prefill handoffs keep format 1/2
+    session = "max_new_tokens" in handoff
+    if wire_format == "f32":
+        fmt = HANDOFF_FORMAT_SESSION_RAW if session else HANDOFF_FORMAT_RAW
+    else:
+        fmt = (HANDOFF_FORMAT_SESSION_QUANT if session
+               else HANDOFF_FORMAT_QUANT)
+    meta = ({k: handoff[k] for k in _META_KEYS if k != "cursor"}
+            | {"cursor": int(handoff["cursor"])})
+    if session:
+        meta["max_new_tokens"] = int(handoff["max_new_tokens"])
     manifest: Dict[str, Any] = {
-        "format": (HANDOFF_FORMAT_RAW if wire_format == "f32"
-                   else HANDOFF_FORMAT_QUANT),
+        "format": fmt,
         "bytes": len(blob),
         "sha256": hashlib.sha256(blob).hexdigest(),
         "arrays": pk.arrays,
-        "meta": {k: handoff[k] for k in _META_KEYS if k != "cursor"}
-                | {"cursor": int(handoff["cursor"])},
+        "meta": meta,
     }
     if wire_format != "f32":
         from chainermn_tpu.collectives.quantized import QUANT_BLOCK
@@ -163,7 +188,7 @@ def decode_handoff(manifest: dict, blob: bytes) -> dict:
                 raw, dtype=dt).reshape(ent["shape"])
         meta = manifest["meta"]
         pages: Dict[str, Dict[str, np.ndarray]] = {}
-        if fmt == HANDOFF_FORMAT_RAW:
+        if fmt not in _QUANT_FORMATS:
             for name, arr in flat.items():
                 if name == "key":
                     continue
@@ -182,7 +207,7 @@ def decode_handoff(manifest: dict, blob: bytes) -> dict:
                 block, leaf = base.rsplit("/", 1)
                 pages.setdefault(block, {})[leaf] = deq.reshape(
                     spec["shape"])
-        return {
+        out = {
             "pages": pages,
             "cursor": int(meta["cursor"]),
             "tokens": list(meta["tokens"]),
@@ -193,6 +218,11 @@ def decode_handoff(manifest: dict, blob: bytes) -> dict:
             "top_k": meta["top_k"],
             "seed": meta["seed"],
         }
+        if fmt in _SESSION_FORMATS:
+            # the remaining-budget meta is what MAKES it a session; a
+            # session manifest without it is structurally broken
+            out["max_new_tokens"] = int(meta["max_new_tokens"])
+        return out
     except HandoffError:
         raise
     except Exception as e:   # broken manifest structure → same contract

@@ -48,9 +48,13 @@ class FleetReport:
         self.rejected = 0             # AdmissionRejected at the router
         self.requeued = 0             # requests moved off a dead replica
         self.replicas_dead = 0
+        self.replicas_drained = 0     # Router.drain decommissions
         self.handoffs = 0
         self.handoff_fallbacks = 0    # HandoffError → clean re-prefill
         self.handoff_wire_bytes: Dict[str, int] = {}   # wire_format → B
+        self.migrations = 0           # decode sessions adopted by a peer
+        self.migration_fallbacks = 0  # migrate failed → replay from seed
+        self.migration_wire_bytes: Dict[str, int] = {}  # wire_format → B
 
     # ----------------------------------------------------------------
     # router / pool hooks
@@ -73,12 +77,29 @@ class FleetReport:
     def record_fallback(self) -> None:
         self.handoff_fallbacks += 1
 
+    def record_drained(self) -> None:
+        self.replicas_drained += 1
+
+    def record_migration(self, wire_format: str, nbytes: int) -> None:
+        """One decode session adopted by a peer; ``nbytes`` is the
+        exact encoded blob length that crossed the wire."""
+        self.migrations += 1
+        self.migration_wire_bytes[wire_format] = (
+            self.migration_wire_bytes.get(wire_format, 0) + int(nbytes))
+
+    def record_migration_fallback(self) -> None:
+        """A migration that could not complete (transport budget, no
+        free destination slot, undecodable frame) — the session fell
+        back to the PR 11 replay-from-seed path."""
+        self.migration_fallbacks += 1
+
     # ----------------------------------------------------------------
     # wire serialization (cross-process fleet merge)
     # ----------------------------------------------------------------
 
     #: bump on any change to the counter schema below
-    WIRE_VERSION = 1
+    #: (2: migration/drain counters — PR 17 session migration)
+    WIRE_VERSION = 2
 
     def to_wire(self) -> dict:
         """Version-tagged JSON-safe envelope of the fleet counters —
@@ -91,9 +112,14 @@ class FleetReport:
                     "rejected": self.rejected,
                     "requeued": self.requeued,
                     "replicas_dead": self.replicas_dead,
+                    "replicas_drained": self.replicas_drained,
                     "handoffs": self.handoffs,
                     "handoff_fallbacks": self.handoff_fallbacks,
                     "handoff_wire_bytes": dict(self.handoff_wire_bytes),
+                    "migrations": self.migrations,
+                    "migration_fallbacks": self.migration_fallbacks,
+                    "migration_wire_bytes": dict(
+                        self.migration_wire_bytes),
                 }}
 
     @classmethod
@@ -110,10 +136,15 @@ class FleetReport:
         out.rejected = int(c["rejected"])
         out.requeued = int(c["requeued"])
         out.replicas_dead = int(c["replicas_dead"])
+        out.replicas_drained = int(c["replicas_drained"])
         out.handoffs = int(c["handoffs"])
         out.handoff_fallbacks = int(c["handoff_fallbacks"])
         out.handoff_wire_bytes = {str(k): int(v) for k, v
                                   in c["handoff_wire_bytes"].items()}
+        out.migrations = int(c["migrations"])
+        out.migration_fallbacks = int(c["migration_fallbacks"])
+        out.migration_wire_bytes = {str(k): int(v) for k, v
+                                    in c["migration_wire_bytes"].items()}
         return out
 
     def absorb(self, other: "FleetReport") -> None:
@@ -123,11 +154,17 @@ class FleetReport:
         self.rejected += other.rejected
         self.requeued += other.requeued
         self.replicas_dead += other.replicas_dead
+        self.replicas_drained += other.replicas_drained
         self.handoffs += other.handoffs
         self.handoff_fallbacks += other.handoff_fallbacks
         for fmt, nbytes in other.handoff_wire_bytes.items():
             self.handoff_wire_bytes[fmt] = (
                 self.handoff_wire_bytes.get(fmt, 0) + int(nbytes))
+        self.migrations += other.migrations
+        self.migration_fallbacks += other.migration_fallbacks
+        for fmt, nbytes in other.migration_wire_bytes.items():
+            self.migration_wire_bytes[fmt] = (
+                self.migration_wire_bytes.get(fmt, 0) + int(nbytes))
 
     # ----------------------------------------------------------------
     # aggregation
@@ -186,9 +223,13 @@ class FleetReport:
             "rejected": self.rejected,
             "requeued": self.requeued,
             "replicas_dead": self.replicas_dead,
+            "replicas_drained": self.replicas_drained,
             "handoffs": self.handoffs,
             "handoff_fallbacks": self.handoff_fallbacks,
             "handoff_wire_bytes": dict(self.handoff_wire_bytes),
+            "migrations": self.migrations,
+            "migration_fallbacks": self.migration_fallbacks,
+            "migration_wire_bytes": dict(self.migration_wire_bytes),
         }
         return out
 

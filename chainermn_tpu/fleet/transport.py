@@ -272,6 +272,10 @@ class ObjectPlaneTransport:
       so a poll can come back later;
     * ``process_index`` — this host's rank.
 
+    If the plane also exposes ``gc(src, tag)`` (FsObjectPlane), the
+    transport calls it after each resolved frame/ack so a long drill
+    does not accumulate one file per frame on disk.
+
     Restart tolerance: adoption is keyed by ``stream_id``, not by
     sequence number, so a restarted sender (fresh seq counter, replayed
     streams) is answered with ``duplicate`` acks for everything the
@@ -321,11 +325,25 @@ class ObjectPlaneTransport:
                                         tag=self.data_tag)
             status = self._await_ack(seq)
             if status in _ACK_STATUSES:
+                self._gc_plane(self.ack_tag)
                 return status
             if attempt + 1 < self.max_attempts:
                 time.sleep(self.policy.backoff_ms(attempt) / 1000.0)
         self.stats["send_failed"] += 1
         return "failed"
+
+    def _gc_plane(self, tag: int) -> None:
+        """Prune consumed frame files on planes that support it
+        (FsObjectPlane) — a long drill must not accumulate one file
+        per frame forever. Best-effort: a racing unlink is not an
+        error, and memory planes simply have no ``gc``."""
+        gc = getattr(self.plane, "gc", None)
+        if gc is None:
+            return
+        try:
+            gc(self.peer, tag=tag)
+        except OSError:
+            pass
 
     def _await_ack(self, seq: int) -> Optional[str]:
         """Wait (bounded) for the ack of frame ``seq``. Acks arrive in
@@ -405,6 +423,7 @@ class ObjectPlaneTransport:
             self.plane.send_obj({"kind": "ack", "seq": seq,
                                  "status": status}, self.peer,
                                 tag=self.ack_tag)
+            self._gc_plane(self.data_tag)
         return arrival
 
     def resolve(self, stream_id: int) -> None:

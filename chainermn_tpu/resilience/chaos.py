@@ -132,6 +132,12 @@ FAULT_KINDS: Dict[str, str] = {
                     "dedup by stream — a double adoption would emit "
                     "duplicated tokens): [times=N][,after=K][,prob=P]"
                     "[,seed=S][,rank=R|*]"),
+    "kill_dest": ("kill the MIGRATION DESTINATION replica right after "
+                  "it adopts a migrated session, before the source "
+                  "releases its slot (the adopt-before-ack crash "
+                  "window — the router's sweep must replay the stream "
+                  "from seed on a survivor): [times=N][,after=K]"
+                  "[,prob=P][,seed=S][,rank=R|*]"),
 }
 
 #: every fault kind also accepts ``run=K`` — fire only in supervised
@@ -497,6 +503,25 @@ class ChaosPlan:
                 data = self._damage_handoff(f, data)
         return (verdict, data)
 
+    def on_migration(self, stream_id: int,
+                     rank: Optional[int] = None) -> bool:
+        """Migration hook (fleet/router.py ``drain``): called right
+        after the DESTINATION replica adopts a migrated session and
+        before the source slot is released — the adopt-before-ack
+        window. Returns True when a matching ``kill_dest`` fault fires;
+        the caller must kill the destination replica, whose sweep then
+        re-queues the adopted session for a replay from seed."""
+        rank = _own_rank() if rank is None else rank
+        for f in self.faults:
+            if f.kind != "kill_dest":
+                continue
+            if not self._wire_gate(f, rank):
+                continue
+            f.fired += 1
+            self.log.append(f"kill_dest stream={stream_id}")
+            return True
+        return False
+
     #: pipeline stage → fault kind for :meth:`on_offload`
     _OFFLOAD_STAGES = {"offload": "slow_offload", "writer": "stall_writer"}
 
@@ -606,3 +631,11 @@ def on_wire(data: bytes) -> tuple:
         if plan is not None:
             return plan.on_wire(data)
     return ("deliver", data)
+
+
+def on_migration(stream_id: int) -> bool:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            return plan.on_migration(stream_id)
+    return False

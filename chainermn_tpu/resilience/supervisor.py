@@ -89,8 +89,13 @@ def classify_exit(returncode: int) -> str:
     A death by unhandled SIGTERM (-15) still counts as ``preempted``:
     the platform sent the signal but the child had no handler installed
     — restarting it is right, billing the crash budget for the
-    platform's preemption is not."""
-    if returncode == 0:
+    platform's preemption is not. A death by unhandled SIGUSR1 (-10)
+    counts as ``clean``: SIGUSR1 is the fleet's drain request
+    (``tools/serve_lm.py`` / ``tools/fleet_lm.py`` catch it, finish or
+    migrate their sessions, and exit 0) — a serving binary too old to
+    carry the handler must not bill the crash budget for being asked
+    to retire."""
+    if returncode == 0 or returncode == -signal.SIGUSR1:
         return "clean"
     if returncode == PREEMPTED_EXIT_CODE or returncode == -signal.SIGTERM:
         return "preempted"

@@ -293,6 +293,76 @@ class Engine:
             "seed": req.seed,
         }
 
+    def export_session(self, req: Request) -> dict:
+        """Freeze an ACTIVELY DECODING request at its current token
+        boundary and package it for another engine — the decode→decode
+        generalization of ``export_handoff``. The slot moves to 'held'
+        (decode stops advancing it; ``_decode``'s park pins its cursor),
+        so the exported KV rows, PRNG key row, and token history are a
+        consistent snapshot no matter how many steps run while the
+        bytes are in flight. The dict is ``export_handoff``'s plus the
+        remaining-budget field ``max_new_tokens``; ``import_session``
+        on the adopting engine continues the stream BITWISE (raw wire),
+        because the per-slot key already consumed exactly one split per
+        sampled token. Terminal outcomes mirror the prefill conveyor:
+        ``release_held`` after the peer adopts, ``abort_held`` if the
+        transport gives up (the stream then replays from seed), or
+        ``resume_session`` to keep decoding here."""
+        if req.state == "held" and self.held.get(req.slot) is req:
+            raise ValueError(
+                f"request {req.request_id} is a held prefill-handoff "
+                "slot — migrate it with export_handoff (the "
+                "prefill→decode conveyor); export_session moves "
+                "actively DECODING slots")
+        if req.slot is not None and self.prefilling.get(req.slot) is req:
+            raise ValueError(
+                f"request {req.request_id} is mid-prefill — a "
+                "partially written slot cannot migrate; let prefill "
+                "finish (first token sampled) or re-queue the request "
+                "on the destination")
+        if req.slot is None or self.active.get(req.slot) is not req:
+            raise ValueError(
+                f"request {req.request_id} is not actively decoding on "
+                f"this engine (state={req.state!r})")
+        self.active.pop(req.slot)
+        req.state = "held"
+        self.held[req.slot] = req
+        out = self.export_handoff(req)
+        out["max_new_tokens"] = int(req.max_new_tokens)
+        return out
+
+    def resume_session(self, req: Request) -> None:
+        """Un-freeze a session ``export_session`` held: the slot's KV
+        rows, cursor, key, and sampling rows never moved, so decoding
+        continues here exactly where it stopped (the migration was
+        abandoned before the destination adopted)."""
+        if req.state != "held" or self.held.get(req.slot) is not req:
+            raise ValueError(
+                f"request {req.request_id} is not held by this engine")
+        hit_eos = (req.eos_id is not None and req.tokens
+                   and req.tokens[-1] == req.eos_id)
+        if hit_eos or len(req.tokens) >= req.max_new_tokens:
+            raise ValueError(
+                f"request {req.request_id} is terminal (a prefill-hold "
+                "park, not a frozen session) — release_held it")
+        del self.held[req.slot]
+        req.state = "running"
+        self.active[req.slot] = req
+        self.cur_tokens[req.slot] = req.tokens[-1]
+
+    def import_session(self, session: dict, prompt) -> Request:
+        """Adopt a migrated decode session (``export_session``'s dict,
+        wire-decoded by ``fleet/handoff.py``). The per-request budget
+        travels IN the session — the continued stream stops exactly
+        where the unmigrated one would have."""
+        if "max_new_tokens" not in session:
+            raise ValueError(
+                "not a decode-session export (no max_new_tokens) — "
+                "prefill handoffs are adopted with import_handoff")
+        return self.import_handoff(
+            session, prompt,
+            max_new_tokens=int(session["max_new_tokens"]))
+
     def import_handoff(self, handoff: dict, prompt,
                        max_new_tokens: Optional[int] = None) -> Request:
         """Adopt an exported slot: bind a free slot, write the KV rows

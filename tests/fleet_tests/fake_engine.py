@@ -183,6 +183,44 @@ class FakeEngine:
             self.active[req.slot] = req
         return req
 
+    # ----------------------------------------------------------------
+    # migration face (fleet/router.py drain) — mirrors the real
+    # engine's export_session/import_session/resume_session contract
+    # ----------------------------------------------------------------
+
+    def export_session(self, req: Request) -> dict:
+        """Freeze an active decode slot (active → held) and export it
+        with the remaining budget; the adopting fake continues
+        ``expected_tokens`` from the same position — bitwise."""
+        if req.state == "held" and self.held.get(req.slot) is req:
+            raise ValueError(
+                f"request {req.request_id} is a held prefill-handoff "
+                "slot — migrate it with export_handoff")
+        if req.slot is None or self.active.get(req.slot) is not req:
+            raise ValueError(
+                f"request {req.request_id} is not actively decoding on "
+                f"this engine (state={req.state!r})")
+        del self.active[req.slot]
+        req.state = "held"
+        self.held[req.slot] = req
+        out = self.export_handoff(req)
+        out["max_new_tokens"] = int(req.max_new_tokens)
+        return out
+
+    def resume_session(self, req: Request) -> None:
+        self._check_held(req)
+        del self.held[req.slot]
+        req.state = "running"
+        self.active[req.slot] = req
+
+    def import_session(self, session: dict, prompt) -> Request:
+        if "max_new_tokens" not in session:
+            raise ValueError(
+                "not a decode-session export (no max_new_tokens)")
+        return self.import_handoff(
+            session, prompt,
+            max_new_tokens=int(session["max_new_tokens"]))
+
     def release_held(self, req: Request, aborted: bool = False) -> None:
         self._check_held(req)
         slot = req.slot

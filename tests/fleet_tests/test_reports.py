@@ -96,12 +96,19 @@ def test_counters_and_summary_shape():
     fr.record_handoff("int8-block", 260)
     fr.record_handoff("int8-block", 260)
     fr.record_fallback()
+    fr.record_drained()
+    fr.record_migration("f32", 800)
+    fr.record_migration("f32", 800)
+    fr.record_migration_fallback()
     ra = _report([0.001], tokens=5, host_bytes=20, span_s=1.0)
     out = fr.summary([ra])
     assert out["fleet"] == {
         "rejected": 1, "requeued": 3, "replicas_dead": 1,
+        "replicas_drained": 1,
         "handoffs": 3, "handoff_fallbacks": 1,
         "handoff_wire_bytes": {"f32": 1000, "int8-block": 520},
+        "migrations": 2, "migration_fallbacks": 1,
+        "migration_wire_bytes": {"f32": 1600},
     }
     assert out["replicas"] == 1
     assert np.isfinite(out["tokens_per_s"])

@@ -316,3 +316,88 @@ def test_fs_plane_carries_the_full_transport_protocol(tmp_path):
     (arr,) = arrivals
     assert decode_handoff(arr.manifest, arr.blob)["tokens"] \
         == handoff["tokens"]
+
+
+# ---------------------------------------------------------------------------
+# FsObjectPlane GC: consumed frames prune, fences and seqs survive
+# ---------------------------------------------------------------------------
+
+
+def _objs(chan_dir):
+    import os
+    try:
+        return sorted(n for n in os.listdir(chan_dir)
+                      if n.endswith(".obj"))
+    except FileNotFoundError:
+        return []
+
+
+def test_fs_plane_gc_prunes_consumed_frames_only(tmp_path):
+    """gc unlinks exactly the frames this receiver already consumed:
+    the unread tail stays on disk and still delivers afterwards."""
+    a = FsObjectPlane(str(tmp_path), 0, 2)
+    b = FsObjectPlane(str(tmp_path), 1, 2)
+    for n in (1, 2, 3):
+        a.send_obj({"n": n}, 1, tag=4)  # dlint: disable=DL102
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 1
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 2
+    assert b.gc(0, tag=4) == 2
+    assert _objs(b._chan_dir(0, 1, 4)) == ["00000002.obj"]
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 3
+    assert b.gc(0, tag=4) == 1         # and the tail prunes next round
+    assert _objs(b._chan_dir(0, 1, 4)) == []
+
+
+def test_fs_plane_gc_reborn_sender_continues_past_the_prune(tmp_path):
+    """After a FULL prune the channel directory holds no .obj to count
+    — a reborn sender must take its next seq from the HWM file, or it
+    would re-issue seq 0 and the receiver (already past it) would hang
+    forever on a slot that can never fill again."""
+    a = FsObjectPlane(str(tmp_path), 0, 2)
+    b = FsObjectPlane(str(tmp_path), 1, 2)
+    a.send_obj({"n": 1}, 1, tag=4)  # dlint: disable=DL102
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 1
+    assert b.gc(0, tag=4) == 1
+    reborn = FsObjectPlane(str(tmp_path), 0, 2)      # SIGKILL + restart
+    reborn.send_obj({"n": 2}, 1, tag=4)  # dlint: disable=DL102
+    assert _objs(b._chan_dir(0, 1, 4)) == ["00000001.obj"]
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 2
+
+
+def test_fs_plane_gc_reborn_receiver_seeds_from_hwm(tmp_path):
+    """A restarted receiver's position starts at the HWM, not 0 — it
+    must not wait on frames gc already unlinked."""
+    a = FsObjectPlane(str(tmp_path), 0, 2)
+    b = FsObjectPlane(str(tmp_path), 1, 2)
+    a.send_obj({"n": 1}, 1, tag=4)  # dlint: disable=DL102
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 1
+    b.gc(0, tag=4)
+    a.send_obj({"n": 2}, 1, tag=4)  # dlint: disable=DL102
+    reborn = FsObjectPlane(str(tmp_path), 1, 2)      # SIGKILL + restart
+    assert reborn.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 2
+
+
+def test_transport_gc_fence_survives_reborn_sender_after_prune(tmp_path):
+    """The long-haul composition: the transport's built-in GC prunes
+    both channels after a clean adopt, and a reborn sender replaying
+    the resolved stream with a fresh counter STILL answers
+    ``duplicate`` — the fence outlives the frames it was built from."""
+    (manifest, blob), _ = _fake_handoff()
+    sender = ObjectPlaneTransport(FsObjectPlane(str(tmp_path), 0, 2),
+                                  peer=1, pol=_FAST)
+    receiver = ObjectPlaneTransport(FsObjectPlane(str(tmp_path), 1, 2),
+                                    peer=0, pol=_FAST)
+    data_chan = receiver.plane._chan_dir(0, 1, HANDOFF_DATA_TAG)
+    ack_chan = sender.plane._chan_dir(1, 0, HANDOFF_ACK_TAG)
+    stop, th, arrivals = _with_receiver(receiver)
+    try:
+        assert sender.send(3, manifest, blob) == "adopted"
+        assert _objs(data_chan) == []    # receiver GCed the data frame
+        assert _objs(ack_chan) == []     # sender GCed the consumed ack
+        reborn = ObjectPlaneTransport(FsObjectPlane(str(tmp_path), 0, 2),
+                                      peer=1, pol=_FAST)  # seq resets
+        assert reborn.send(3, manifest, blob) == "duplicate"
+    finally:
+        stop.set()
+        th.join()
+    assert len(arrivals) == 1          # the replay never re-surfaced

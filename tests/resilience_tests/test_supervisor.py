@@ -29,6 +29,10 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 
 def test_classify_exit():
     assert classify_exit(0) == "clean"
+    # SIGUSR1 is the fleet's drain request — a replica killed by the
+    # signal itself (no handler installed yet) still retired on purpose,
+    # so a supervisor must not bill the crash budget for it
+    assert classify_exit(-signal.SIGUSR1) == "clean"
     assert classify_exit(PREEMPTED_EXIT_CODE) == "preempted"
     assert classify_exit(-signal.SIGTERM) == "preempted"
     assert classify_exit(ABORTED_EXIT_CODE) == "aborted"

@@ -1,0 +1,365 @@
+"""Decode→decode session migration at the engine layer: a stream
+frozen mid-decode by ``export_session``, shipped through the fleet
+wire codec, and adopted by ``import_session`` finishes BITWISE equal
+to the stream the unmigrated engine would have produced — at every
+scheduler shape (decode_k × monolithic/chunked prefill × budgeted),
+greedy and sampled. The quantized session wire (format 4) is bounded
+by the same calibrated logit-error envelope as prefill handoffs, and
+every misuse — migrating a held prefill park, a mid-prefill slot, a
+request that is not decoding, adopting a budget-less dict — is
+REFUSED with actionable guidance instead of tearing a slot.
+
+Fast FakeEngine router drills live in tests/fleet_tests/
+test_migration.py; this file owns the real engine's export/import
+unit matrix plus the slow real-engine ``Router.drain`` capstone."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.collectives.quantized import (QUANT_BLOCK,
+                                                 block_quantize)
+from chainermn_tpu.fleet.handoff import (decode_handoff, encode_handoff,
+                                         handoff_payload_bytes)
+from chainermn_tpu.models.transformer import TransformerLM, generate
+from chainermn_tpu.serving.engine import Engine, EngineConfig
+
+VOCAB = 43
+N_NEW = 10
+LENS = [4, 5]
+
+
+def _model(**kw):
+    base = dict(vocab=VOCAB, d_model=32, n_heads=4, n_layers=1, d_ff=48,
+                max_len=64, attention="reference", pos_emb="rope")
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+@functools.lru_cache(maxsize=None)
+def _setup(seed=0):
+    model = _model()
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _cfg(**kw):
+    base = dict(n_slots=2, capacity=32, max_new_tokens=N_NEW,
+                prefill_cohort=1, buckets=sorted(set(LENS)) + [32])
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _prompts(seed=0, lens=LENS):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, (l,)).astype(np.int32) for l in lens]
+
+
+def _until_mid_decode(eng, req, min_tokens=2, max_steps=200):
+    """Step until ``req`` is actively decoding with at least
+    ``min_tokens`` committed — a mid-stream export point."""
+    for _ in range(max_steps):
+        if (req.slot is not None and eng.active.get(req.slot) is req
+                and len(req.tokens) >= min_tokens):
+            return
+        eng.step()  # dlint: disable=DL104
+    raise AssertionError(f"request {req.request_id} never reached "
+                         f"mid-decode (state={req.state!r})")
+
+
+def _migrate(src, dst, req, prompt, wire="f32"):
+    """export_session → wire → import_session, releasing the source
+    slot once the destination adopts (the transport's success path)."""
+    session = src.export_session(req)
+    manifest, blob = encode_handoff(session, wire)
+    assert manifest["format"] == (3 if wire == "f32" else 4)
+    assert handoff_payload_bytes(manifest) == len(blob)
+    adopted = dst.import_session(decode_handoff(manifest, blob), prompt)
+    src.release_held(req)
+    return adopted
+
+
+# ---------------------------------------------------------------------------
+# the bitwise matrix: migration is invisible at every scheduler shape
+# ---------------------------------------------------------------------------
+
+
+SHAPES = [
+    dict(),                                          # decode_k=1, monolithic
+    dict(decode_k=3),
+    dict(prefill_chunk=2),
+    dict(decode_k=2, prefill_chunk=3, token_budget=8),
+]
+
+
+@pytest.mark.parametrize("shape", SHAPES,
+                         ids=["k1-mono", "k3-mono", "k1-chunk2",
+                              "k2-chunk3-budget8"])
+def test_mid_stream_migration_is_bitwise_and_counts_every_token(shape):
+    """Freeze request 0 mid-decode on engine A, adopt it on engine B:
+    both streams end equal to an unmigrated run of the same config,
+    and A's + B's token counters sum to exactly the tokens emitted —
+    zero dropped, zero double-counted."""
+    model, params = _setup()
+    prompts = _prompts()
+
+    base = Engine(model, params, _cfg(**shape))
+    refs = [base.submit(p) for p in prompts]
+    base.run_until_drained()
+    want = [list(r.tokens) for r in refs]
+    if not shape:          # the shape test_engine.py pins to generate()
+        for p, w in zip(prompts, want):
+            oracle = np.asarray(generate(model, params, p[None],
+                                         N_NEW))[0, len(p):]
+            np.testing.assert_array_equal(np.asarray(w), oracle)
+
+    a = Engine(model, params, _cfg(**shape))
+    b = Engine(model, params, _cfg(**shape))
+    r0, r1 = [a.submit(p) for p in prompts]
+    _until_mid_decode(a, r0)
+    n_at_export = len(r0.tokens)
+    assert 0 < n_at_export < N_NEW      # genuinely mid-stream
+    adopted = _migrate(a, b, r0, prompts[0])
+    a.run_until_drained()
+    b.run_until_drained()
+
+    assert adopted.state == "done" and r1.state == "done"
+    assert list(adopted.tokens) == want[0]
+    assert list(r1.tokens) == want[1]
+    # continuity: every token billed once, on the engine that made it
+    a_tok = a.report.raw()["tokens_emitted"]
+    b_tok = b.report.raw()["tokens_emitted"]
+    assert a_tok == n_at_export + len(want[1])
+    assert b_tok == len(want[0]) - n_at_export
+    assert a_tok + b_tok == sum(len(w) for w in want)
+
+
+def test_sampled_session_migrates_bitwise():
+    """The handed-off PRNG key row continues the stream (one split per
+    sampled token already consumed), so a migrated SAMPLED stream is
+    token-for-token the unmigrated one."""
+    model, params = _setup()
+    prompts = _prompts(seed=3)
+    knobs = dict(temperature=1.2, top_k=7)
+
+    base = Engine(model, params, _cfg())
+    refs = [base.submit(p, seed=100 + i, **knobs)
+            for i, p in enumerate(prompts)]
+    base.run_until_drained()
+    want = [list(r.tokens) for r in refs]
+    assert any(len(set(w)) > 1 for w in want)    # actually sampling
+
+    a = Engine(model, params, _cfg())
+    b = Engine(model, params, _cfg())
+    r0, r1 = [a.submit(p, seed=100 + i, **knobs)
+              for i, p in enumerate(prompts)]
+    _until_mid_decode(a, r0, min_tokens=3)
+    adopted = _migrate(a, b, r0, prompts[0])
+    a.run_until_drained()
+    b.run_until_drained()
+    assert list(adopted.tokens) == want[0]
+    assert list(r1.tokens) == want[1]
+
+
+# ---------------------------------------------------------------------------
+# quantized session wire (format 4)
+# ---------------------------------------------------------------------------
+
+
+def test_quant_session_budget_travels_and_logit_error_calibrated():
+    """format-4 sessions carry the remaining budget exactly, and the
+    int8-block KV perturbs next-step logits by no more than the same
+    small multiple of the quantization step test_handoff.py pins for
+    prefill handoffs — migration adds no codec error of its own."""
+    model, params = _setup()
+    a = Engine(model, params, _cfg())
+    req = a.submit(_prompts()[0])
+    _until_mid_decode(a, req)
+    session = a.export_session(req)
+    assert session["max_new_tokens"] == N_NEW
+
+    max_step = 0.0
+    for page in session["pages"].values():
+        for leaf in ("k", "v"):
+            v = np.asarray(page[leaf], np.float32).reshape(-1)
+            _q, s = block_quantize(jnp.asarray(v), "int8-block")
+            max_step = max(max_step, float(np.asarray(s).max()) / 2)
+
+    logits = {}
+    for wf in ("f32", "int8-block"):
+        manifest, blob = encode_handoff(session, wf)
+        out = decode_handoff(manifest, blob)
+        assert out["max_new_tokens"] == N_NEW
+        eng = Engine(model, params, _cfg())
+        got = eng.import_session(out, _prompts()[0])
+        eng.step()  # dlint: disable=DL104
+        logits[wf] = eng.last_logits[got.slot].copy()
+    dlogit = np.abs(logits["int8-block"] - logits["f32"]).max()
+    assert 0 < dlogit <= 10 * max_step, (dlogit, max_step)
+
+
+# ---------------------------------------------------------------------------
+# terminal-at-adoption edges
+# ---------------------------------------------------------------------------
+
+
+def test_terminal_sessions_retire_at_adoption_without_decoding():
+    """A session whose budget is already spent — or whose last token
+    IS the eos — retires the moment it is adopted: state done, not one
+    extra token, and the destination's slot frees immediately."""
+    model, params = _setup()
+    a = Engine(model, params, _cfg())
+    req = a.submit(_prompts()[0])
+    _until_mid_decode(a, req)
+    session = a.export_session(req)
+    a.release_held(req)
+
+    spent = dict(session, max_new_tokens=len(session["tokens"]))
+    b = Engine(model, params, _cfg())
+    got = b.import_session(spent, _prompts()[0])
+    assert got.state == "done"
+    assert got.tokens == session["tokens"]
+    assert sorted(b.free_slots) == [0, 1] and b.idle()
+
+    eosed = dict(session, eos_id=session["tokens"][-1])
+    c = Engine(model, params, _cfg())
+    got = c.import_session(eosed, _prompts()[0])
+    assert got.state == "done"
+    assert got.tokens == session["tokens"]
+    assert sorted(c.free_slots) == [0, 1] and c.idle()
+
+
+# ---------------------------------------------------------------------------
+# refusals: every misuse names the right tool
+# ---------------------------------------------------------------------------
+
+
+def test_export_session_refuses_held_prefill_park():
+    """A hold=True park is the prefill→decode conveyor's slot — the
+    error sends the caller to export_handoff, not a generic state."""
+    model, params = _setup()
+    eng = Engine(model, params, _cfg())
+    req = eng.submit(_prompts()[0], max_new_tokens=1, hold=True)
+    while not eng.held:
+        eng.step()  # dlint: disable=DL104
+    with pytest.raises(ValueError, match="export_handoff"):
+        eng.export_session(req)
+    eng.release_held(req)
+
+
+def test_export_session_refuses_mid_prefill_slot():
+    model, params = _setup()
+    eng = Engine(model, params, _cfg(prefill_chunk=2))
+    req = eng.submit(_prompts()[1])          # len 5: 3 chunks
+    eng.step()
+    assert eng.prefilling, "chunked prefill should span steps"
+    with pytest.raises(ValueError, match="mid-prefill"):
+        eng.export_session(req)
+    eng.run_until_drained()
+
+
+def test_export_session_refuses_non_decoding_requests():
+    model, params = _setup()
+    eng = Engine(model, params, _cfg(n_slots=1))
+    first = eng.submit(_prompts()[0])
+    queued = eng.submit(_prompts()[1])
+    _until_mid_decode(eng, first)
+    with pytest.raises(ValueError, match="not actively decoding"):
+        eng.export_session(queued)
+    eng.run_until_drained()
+    with pytest.raises(ValueError, match="not actively decoding"):
+        eng.export_session(first)           # done now
+
+
+def test_import_session_refuses_budget_less_handoffs():
+    """A prefill handoff (format 1/2, no max_new_tokens) must go
+    through import_handoff — adopting it as a session would invent a
+    budget the exporter never granted."""
+    model, params = _setup()
+    eng = Engine(model, params, _cfg())
+    req = eng.submit(_prompts()[0], max_new_tokens=1, hold=True)
+    while not eng.held:
+        eng.step()  # dlint: disable=DL104
+    handoff = eng.export_handoff(req)
+    manifest, blob = encode_handoff(handoff, "f32")
+    assert manifest["format"] == 1
+    dst = Engine(model, params, _cfg())
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        dst.import_session(decode_handoff(manifest, blob), _prompts()[0])
+    eng.release_held(req)
+
+
+# ---------------------------------------------------------------------------
+# resume: the abandoned-migration path
+# ---------------------------------------------------------------------------
+
+
+def test_resume_session_continues_bitwise_after_freeze():
+    """While frozen the slot does not advance (however many steps run);
+    resume_session un-parks it and the finished stream is the one the
+    never-frozen engine produces — an abandoned migration is free."""
+    model, params = _setup()
+    prompts = _prompts()
+    eng = Engine(model, params, _cfg())
+    r0, r1 = [eng.submit(p) for p in prompts]
+    _until_mid_decode(eng, r0)
+    eng.export_session(r0)                  # freeze; bytes never leave
+    n_frozen = len(r0.tokens)
+    for _ in range(4):
+        eng.step()  # dlint: disable=DL104
+    assert len(r0.tokens) == n_frozen       # parked, not decoding
+    eng.resume_session(r0)
+    eng.run_until_drained()
+    for p, req in zip(prompts, (r0, r1)):
+        oracle = np.asarray(generate(model, params, p[None],
+                                     N_NEW))[0, len(p):]
+        np.testing.assert_array_equal(np.asarray(req.tokens), oracle)
+
+
+def test_resume_session_refuses_terminal_holds():
+    """A prefill park whose budget is spent is a conveyor hand-out,
+    not a frozen session — resuming it would decode past the budget."""
+    model, params = _setup()
+    eng = Engine(model, params, _cfg())
+    req = eng.submit(_prompts()[0], max_new_tokens=1, hold=True)
+    while not eng.held:
+        eng.step()  # dlint: disable=DL104
+    with pytest.raises(ValueError, match="terminal"):
+        eng.resume_session(req)
+    eng.release_held(req)
+
+
+# ---------------------------------------------------------------------------
+# the capstone: Router.drain over real engines
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_router_drain_real_engines_stays_bitwise():
+    """Drain a real serving replica mid-fleet: every stream — migrated
+    decode→decode, requeued, or untouched — finishes bitwise equal to
+    generate(), and the replica lands DRAINED, not dead."""
+    from chainermn_tpu.fleet import Router
+
+    model, params = _setup()
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, VOCAB, (l,)).astype(np.int32)
+               for l in [4, 5, 4, 5, 4, 5]]
+    engines = [Engine(model, params, _cfg()) for _ in range(2)]
+    with Router(engines) as router:
+        futs = [router.submit(p, max_new_tokens=6) for p in prompts]
+        out = router.drain(1, deadline_ms=120_000)
+        assert out["state"] == "DRAINED"
+        reqs = [router.result(f, timeout_ms=120_000) for f in futs]
+        assert router.summary()["fleet"]["replica_states"][1] == "DRAINED"
+    for p, req in zip(prompts, reqs):
+        oracle = np.asarray(generate(model, params, p[None],
+                                     6))[0, len(p):]
+        np.testing.assert_array_equal(np.asarray(req.tokens), oracle)
+    assert router.report.replicas_dead == 0
+    assert router.report.replicas_drained == 1

@@ -39,6 +39,16 @@ never appends to a file a SIGKILL may have torn mid-line); ``_done_ids``
 merges base + parts and skips torn trailing lines. Exit status follows
 the supervisor contract: 0 clean, 75 on a watchdog abort, anything else
 is a crash.
+
+Signal contract: **SIGUSR1 requests a graceful drain** (same contract
+as serve_lm.py; ``classify_exit`` bills neither the exit nor an
+unhandled -SIGUSR1 to the crash budget). Router mode sheds the
+never-placed backlog (``Router.shed_pending``) and finishes every
+in-flight stream — in-flight sessions on a replica being RETIRED move
+with ``Router.drain``'s live migration, not this signal; the
+disaggregated and ``--hosts`` modes finish their in-flight sessions.
+Either way the process flushes its reports and exits 0, and the shed
+ids are re-submitted by the next incarnation's idempotent replay.
 """
 
 import argparse
@@ -149,8 +159,27 @@ def _pending_prompts(args):
     return prompts
 
 
+def _drain_flag():
+    """Install the SIGUSR1 graceful-drain handler (module docstring).
+    The handler only flips the flag; serving loops act on it at their
+    next iteration boundary, so a signal never tears engine state."""
+    import signal
+
+    drain = {"requested": False}
+
+    def _on_drain(signum, frame):
+        drain["requested"] = True
+
+    try:
+        signal.signal(signal.SIGUSR1, _on_drain)
+    except ValueError:
+        pass                           # not the main thread (tests)
+    return drain
+
+
 def serve(args):
     from chainermn_tpu.fleet import DisaggregatedFleet, FleetReport, Router
+    from chainermn_tpu.serving import DeadlineExceeded
 
     if args.hosts:
         return serve_hosts(args)
@@ -158,6 +187,8 @@ def serve(args):
     engine = _engine_factory(args)
     prompts = _pending_prompts(args)
     report = FleetReport()
+    drain = _drain_flag()
+    shed = False
     kw = dict(max_new_tokens=args.max_new_tokens,
               temperature=args.temperature, top_k=args.top_k)
 
@@ -171,6 +202,9 @@ def serve(args):
         with open(args.out, "a") as out:
             emitted = set()
             while not fleet.idle():
+                if drain["requested"] and not shed:
+                    shed = True
+                    _log("SIGUSR1: drain — finishing in-flight sessions")
                 # each engine step syncs internally (int32 token pulls)
                 fleet.step()  # dlint: disable=DL104
                 for i, s in streams.items():
@@ -185,13 +219,29 @@ def serve(args):
                     report=report) as router:
             futs = {i: router.submit(p, seed=args.seed + i, **kw)
                     for i, p in emit_order(prompts)}
+            pending = dict(futs)
             with open(args.out, "a") as out:
-                for i, fut in futs.items():
-                    req = router.result(fut)
-                    _emit(out, i, prompts[i], req.tokens)
+                while pending:
+                    if drain["requested"] and not shed:
+                        shed = True
+                        n = router.shed_pending()
+                        _log(f"SIGUSR1: drain — shed {n} queued "
+                             "request(s), finishing in-flight streams")
+                    for i in sorted(pending):
+                        fut = pending[i]
+                        if fut.cancelled():
+                            del pending[i]   # shed: next incarnation's
+                            continue         # replay re-submits it
+                        try:
+                            req = router.result(fut, timeout_ms=100)
+                        except DeadlineExceeded:
+                            continue     # still decoding; poll the rest
+                        del pending[i]
+                        _emit(out, i, prompts[i], req.tokens)
             summary = router.summary()
 
-    _log(f"drained; fleet report: {json.dumps(summary, sort_keys=True)}")
+    _log(("drained (SIGUSR1 retirement); " if shed else "drained; ")
+         + f"fleet report: {json.dumps(summary, sort_keys=True)}")
     if args.report:
         with open(args.report, "w") as f:
             f.write(json.dumps(summary, sort_keys=True))
@@ -232,6 +282,7 @@ def serve_hosts(args):
     engine = _engine_factory(args)()
     prompts = _pending_prompts(args)
     report = FleetReport()
+    drain = _drain_flag()              # SIGUSR1: finish in flight, exit 0
     owner = lambda i: 1 + (i % (n - 1))  # noqa: E731 — one-line mapping
     kw = dict(temperature=args.temperature, top_k=args.top_k)
     budget_s = args.handoff_deadline_s + 120.0   # hard stop for any loop
@@ -246,6 +297,8 @@ def serve_hosts(args):
         deadline = time.monotonic() + budget_s
         it = 0
         while not engine.idle() or engine.held:
+            if drain.pop("requested", None):
+                _log("SIGUSR1: drain — finishing in-flight prefills")
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"prefill host failed to drain within {budget_s}s")
@@ -281,6 +334,8 @@ def serve_hosts(args):
         placed, emitted, backlog = set(), set(), []
         with open(part, "a") as out:
             while len(emitted) < len(owned):
+                if drain.pop("requested", None):
+                    _log("SIGUSR1: drain — finishing in-flight decodes")
                 if time.monotonic() > deadline:
                     raise RuntimeError(
                         f"decode host {rank} failed to drain within "

@@ -22,6 +22,15 @@ Wrap it in the per-host restart loop for the fleet drill::
 
 Exit status follows the supervisor contract (resilience/supervisor.py):
 0 clean, 75 on a watchdog abort, anything else is a crash.
+
+Signal contract: **SIGUSR1 requests a graceful drain.** The replica
+stops admitting (queued requests are shed — the next incarnation's
+idempotent JSONL replay re-submits exactly the ids not yet on disk),
+finishes every in-flight stream, flushes its report, and exits 0 —
+which ``classify_exit`` counts as ``clean``, so a supervisor never
+bills the crash budget for a requested retirement. SIGUSR1 is the
+single-replica half of the fleet's drain story; ``tools/fleet_lm.py``
+additionally MIGRATES in-flight sessions to surviving replicas.
 """
 
 import argparse
@@ -101,9 +110,34 @@ def serve(args):
     _log(f"queued {len(reqs)} of {args.requests} requests "
          f"({len(done)} already drained)")
 
+    # SIGUSR1 = graceful drain (see module docstring). The handler only
+    # flips a flag; the scheduler loop does the actual shedding at its
+    # next iteration boundary, so a signal mid-step never tears state.
+    import signal
+    drain = {"requested": False}
+
+    def _on_drain(signum, frame):
+        drain["requested"] = True
+
+    try:
+        signal.signal(signal.SIGUSR1, _on_drain)
+    except ValueError:
+        pass                           # not the main thread (tests)
+
     emitted = {}
+    shed = False
     with open(args.out, "a") as out:
         while not eng.idle():
+            if drain["requested"] and not shed:
+                shed = True
+                dropped = 0
+                while eng.queue:
+                    req = eng.queue.popleft()
+                    req.state = "aborted"
+                    eng.report.record_retire(req.request_id, aborted=True)
+                    dropped += 1
+                _log(f"SIGUSR1: drain — shed {dropped} queued, finishing "
+                     f"{len(eng.active) + len(eng.prefilling)} in flight")
             eng.step()                 # chaos.on_step fires in here
             for i, (req, prompt) in reqs.items():
                 if req.state == "done" and i not in emitted:
@@ -114,7 +148,8 @@ def serve(args):
                          "tokens": req.tokens}) + "\n")
                     out.flush()
                     os.fsync(out.fileno())
-    _log(f"drained; report: {eng.report.json()}")
+    _log(("drained (SIGUSR1 retirement); " if shed else "drained; ")
+         + f"report: {eng.report.json()}")
     if args.report:
         with open(args.report, "w") as f:
             f.write(eng.report.json())
