@@ -506,6 +506,80 @@ def main():
     except Exception as e:  # never sink the headline metric
         record["fleet_conveyor_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # netplane gate (docs/serving.md#transports): the streamed (format-5
+    # per-layer chunk) conveyor must clear the SAME overlap bar as the
+    # monolithic async gate above (stall <= 0.5x sync on the canned 5 ms
+    # wire), and the m×n fleet over a REAL localhost TCP wire
+    # (SocketObjectPlane, 2 prefill × 2 decode pools, streamed + async)
+    # must land every stream bitwise the single-engine reference with
+    # byte-exact streamed wire accounting (chunks + closing == the
+    # monolithic blob, per handoff) — wire-health counters recorded.
+    try:
+        from chainermn_tpu.comm.socket_plane import (SocketObjectPlane,
+                                                     pick_free_endpoints)
+        from chainermn_tpu.fleet import (ObjectPlaneTransport,
+                                         PairedTransport)
+
+        def _streamed_conveyor(asynchronous):
+            dfl = DisaggregatedFleet(
+                Engine(lm, lp, _fleet_cfg()), Engine(lm, lp, _fleet_cfg()),
+                transport=InProcessTransport(wire_delay_ms=5.0),
+                streamed=True, async_conveyor=asynchronous, max_pending=2)
+            streams = [dfl.submit(p, max_new_tokens=n_new)
+                       for p in fleet_prompts]
+            dfl.run_until_drained()
+            if asynchronous:
+                dfl.close()
+            return dfl.stats["stall_ms_total"], [list(s.tokens)
+                                                 for s in streams]
+
+        st_sync, st_sync_toks = _streamed_conveyor(False)
+        st_async, st_async_toks = _streamed_conveyor(True)
+        st_ratio = st_async / st_sync if st_sync > 0 else float("inf")
+        streamed_bitwise = (st_sync_toks == fleet_ref
+                            and st_async_toks == fleet_ref)
+
+        eps = pick_free_endpoints(2)
+        pa, pb = SocketObjectPlane(eps, 0), SocketObjectPlane(eps, 1)
+        try:
+            pairs = [PairedTransport(
+                ObjectPlaneTransport(pa, peer=1, data_tag=7100 + 10 * d,
+                                     ack_tag=7101 + 10 * d),
+                ObjectPlaneTransport(pb, peer=0, data_tag=7100 + 10 * d,
+                                     ack_tag=7101 + 10 * d))
+                for d in range(2)]
+            net_rep = FleetReport()
+            dfl = DisaggregatedFleet(
+                [Engine(lm, lp, _fleet_cfg()), Engine(lm, lp, _fleet_cfg())],
+                [Engine(lm, lp, _fleet_cfg()), Engine(lm, lp, _fleet_cfg())],
+                transport=pairs, report=net_rep, streamed=True,
+                async_conveyor=True, max_pending=2)
+            streams = [dfl.submit(p, max_new_tokens=n_new)
+                       for p in fleet_prompts]
+            dfl.run_until_drained()
+            dfl.close()
+            net_toks = [list(s.tokens) for s in streams]
+            net_totals = dfl.transport_totals()
+            net_bytes = net_rep.handoff_wire_bytes.get("f32", 0)
+        finally:
+            pa.close()
+            pb.close()
+        net_bitwise = net_toks == fleet_ref
+        # streamed wire accounting is byte-EXACT: the same workload's
+        # monolithic f32 handoffs moved identical bytes
+        exact_bytes = net_bytes == record.get("fleet_handoff_f32_bytes")
+        record["netplane_streamed_stall_ratio"] = round(st_ratio, 6)
+        record["netplane_socket_bitwise"] = bool(net_bitwise)
+        record["netplane_streamed_wire_bytes"] = net_bytes
+        record["netplane_retransmits"] = net_totals["retransmits"]
+        record["netplane_reconnects"] = net_totals["reconnects"]
+        record["netplane_chunk_nacks"] = net_totals["chunk_nacks"]
+        record["netplane_gate_ok"] = bool(streamed_bitwise and net_bitwise
+                                          and exact_bytes
+                                          and st_ratio <= 0.5)
+    except Exception as e:  # never sink the headline metric
+        record["netplane_gate_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # migration gate (docs/serving.md#draining-and-migration), folded
     # into the same JSON line. Three structural claims: (1) a stream
     # frozen mid-decode by export_session and adopted over the f32

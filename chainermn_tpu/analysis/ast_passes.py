@@ -1481,3 +1481,114 @@ def check_unbounded_retry_loop(tree, src, path) -> List[Finding]:
 
 register(Rule("DL117", "unbounded-retry-loop", f"{_DOC}#dl117",
               check_unbounded_retry_loop))
+
+
+# ---------------------------------------------------------------------------
+# DL123 — socket-without-timeout
+# ---------------------------------------------------------------------------
+
+#: calls that mint a socket object worth tracking: constructors, the
+#: dial helper, and ``accept()`` (whose returned conn is a NEW socket
+#: that does NOT inherit a deadline discipline worth relying on)
+_SOCKET_CREATORS = {"socket", "create_connection", "create_server",
+                    "accept"}
+
+#: operations on a socket that block until the peer acts — each one is
+#: an indefinite hang against a half-open peer unless a timeout is set
+_SOCKET_BLOCKING_OPS = {"recv", "recv_into", "recvfrom", "accept",
+                        "connect", "sendall", "send", "makefile"}
+
+
+def _sock_name(node: ast.expr) -> Optional[str]:
+    """The trackable name of a socket receiver/target: a bare ``Name``
+    or the final attribute of ``self.x``-style access."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def check_socket_without_timeout(tree, src, path) -> List[Finding]:
+    """A blocking socket op on a socket that never got a timeout.
+
+    TCP gives no notification for a peer that is SIGKILLed, wedged, or
+    partitioned mid-connection: a ``recv``/``accept``/``connect`` on a
+    default (blocking, no-timeout) socket hangs FOREVER — the network
+    twin of the DL117 unbounded retry. The discipline
+    (``comm/socket_plane.py``): every socket gets ``settimeout`` right
+    after creation, sized from the ``RpcPolicy`` probe budget, so every
+    wire wait is a bounded probe slice that re-checks liveness.
+
+    Flagged shape: a name assigned from ``socket()``/
+    ``create_connection()``/``create_server()`` or an ``accept()``
+    result, later used for a blocking op (``recv``/``accept``/
+    ``connect``/``sendall``/...) with no ``settimeout``/
+    ``setblocking`` call on that name anywhere in the file. One
+    finding per socket name, at its first blocking use.
+
+    NOT flagged: ``create_connection(addr, timeout)`` /
+    ``timeout=`` (the dial is bounded at birth — but the returned
+    socket still needs ``settimeout`` for its LATER reads, so only the
+    tracked dial itself is excused when the timeout rides along);
+    files that call ``socket.setdefaulttimeout`` (a process-wide
+    bound); names that ``setblocking(False)`` (non-blocking I/O has
+    its own readiness discipline). Tracking is per-file and by name —
+    over-approximate on purpose, same trade as DL117.
+    """
+    for n in ast.walk(tree):
+        if (isinstance(n, ast.Call)
+                and _callee_name(n) == "setdefaulttimeout"):
+            return []                   # process-wide bound
+    created: Dict[str, int] = {}        # name → creation line
+    safe: Set[str] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            callee = _callee_name(n.value)
+            if callee not in _SOCKET_CREATORS or len(n.targets) != 1:
+                continue
+            target = n.targets[0]
+            if callee == "accept" and isinstance(target, ast.Tuple):
+                target = target.elts[0] if target.elts else target
+            tname = _sock_name(target)
+            if tname is None:
+                continue
+            created.setdefault(tname, n.lineno)
+            if callee == "create_connection" and (
+                    len(n.value.args) >= 2
+                    or any(kw.arg == "timeout"
+                           for kw in n.value.keywords)):
+                safe.add(tname)         # bounded at birth
+        elif (isinstance(n, ast.Call)
+              and isinstance(n.func, ast.Attribute)
+              and n.func.attr in ("settimeout", "setblocking")):
+            tname = _sock_name(n.func.value)
+            if tname is not None:
+                safe.add(tname)
+    findings: List[Finding] = []
+    reported: Set[str] = set()
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _SOCKET_BLOCKING_OPS):
+            continue
+        tname = _sock_name(n.func.value)
+        if (tname is None or tname not in created or tname in safe
+                or tname in reported):
+            continue
+        reported.add(tname)
+        findings.append(Finding(
+            "DL123", path, n.lineno,
+            f"'{tname}.{n.func.attr}' blocks on a socket that never "
+            f"got a timeout (created at line {created[tname]}) — "
+            "against a SIGKILLed or partitioned peer this waits "
+            "forever, the network twin of the DL117 unbounded retry. "
+            "Call settimeout right after creating it, sized from the "
+            "RpcPolicy probe budget (comm/socket_plane.py), so every "
+            f"wire wait is a bounded probe slice ({_DOC}#dl123)."))
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+register(Rule("DL123", "socket-without-timeout", f"{_DOC}#dl123",
+              check_socket_without_timeout))

@@ -46,7 +46,11 @@ import numpy as np
 __all__ = ["HandoffError", "encode_handoff", "decode_handoff",
            "handoff_payload_bytes", "HANDOFF_FORMAT_RAW",
            "HANDOFF_FORMAT_QUANT", "HANDOFF_FORMAT_SESSION_RAW",
-           "HANDOFF_FORMAT_SESSION_QUANT", "HANDOFF_WIRE_FORMATS"]
+           "HANDOFF_FORMAT_SESSION_QUANT", "HANDOFF_FORMAT_STREAMED",
+           "HANDOFF_WIRE_FORMATS", "encode_handoff_streamed",
+           "decode_handoff_streamed", "streamed_wire_bytes",
+           "streamed_chunk_sid", "streamed_parent_sid",
+           "CHUNKS_PER_STREAM"]
 
 HANDOFF_FORMAT_RAW = 1
 HANDOFF_FORMAT_QUANT = 2
@@ -56,6 +60,12 @@ HANDOFF_FORMAT_QUANT = 2
 # budget (decode_handoff's unknown-format contract)
 HANDOFF_FORMAT_SESSION_RAW = 3
 HANDOFF_FORMAT_SESSION_QUANT = 4
+# chunked/streamed prefill handoff (TACCL/GC3 chunk pipelining applied
+# to the handoff path): per-layer KV frames shipped as they are ready
+# plus a closing manifest committing to every chunk's digest. A
+# monolithic ``decode_handoff`` REFUSES format 5 (it cannot verify a
+# blob it only holds a piece of) — use ``decode_handoff_streamed``.
+HANDOFF_FORMAT_STREAMED = 5
 _ACCEPTED_FORMATS = (HANDOFF_FORMAT_RAW, HANDOFF_FORMAT_QUANT,
                      HANDOFF_FORMAT_SESSION_RAW,
                      HANDOFF_FORMAT_SESSION_QUANT)
@@ -228,4 +238,213 @@ def decode_handoff(manifest: dict, blob: bytes) -> dict:
     except Exception as e:   # broken manifest structure → same contract
         raise HandoffError(
             f"undecodable handoff manifest: {type(e).__name__}: {e}"
+        ) from e
+
+
+# -- format 5: streamed (chunked) handoffs --------------------------------
+
+#: chunk stream-id address space per parent stream (a handoff with more
+#: KV blocks than this cannot be streamed — encode refuses)
+CHUNKS_PER_STREAM = 4096
+
+
+def streamed_chunk_sid(stream_id: int, index: int) -> int:
+    """Transport stream id for chunk ``index`` of ``stream_id``.
+
+    Chunk frames ride the SAME transport protocol as whole handoffs —
+    per-frame SHA verify, NACK → bounded re-send, duplicate fencing —
+    so each needs its own id. Client stream ids are non-negative
+    (``itertools.count``/request ids), so the chunk space is the
+    negative integers: collision-free by sign, and invertible."""
+    if not 0 <= index < CHUNKS_PER_STREAM:
+        raise ValueError(f"chunk index {index} outside "
+                         f"[0, {CHUNKS_PER_STREAM})")
+    return -(int(stream_id) * CHUNKS_PER_STREAM + index + 1)
+
+
+def streamed_parent_sid(chunk_sid: int) -> Tuple[int, int]:
+    """Invert :func:`streamed_chunk_sid` → ``(stream_id, index)``."""
+    if chunk_sid >= 0:
+        raise ValueError(f"{chunk_sid} is not a chunk stream id")
+    flat = -int(chunk_sid) - 1
+    return flat // CHUNKS_PER_STREAM, flat % CHUNKS_PER_STREAM
+
+
+def encode_handoff_streamed(
+        handoff: dict, wire_format: str = "f32",
+) -> Tuple[List[Tuple[dict, bytes]], dict, bytes]:
+    """Serialize one handoff as independently verifiable per-layer
+    frames: returns ``(chunks, closing_manifest, closing_blob)`` where
+    ``chunks[i] = (chunk_manifest, chunk_blob)`` carries one KV block's
+    leaves and the closing manifest carries the scalar meta, the PRNG
+    key, and a ``chunks`` table committing to every chunk's byte count
+    and digest — so a receiver can prove it assembled exactly the
+    handoff the sender encoded, and a corrupt chunk costs one chunk's
+    re-send, not the whole blob's."""
+    if wire_format not in HANDOFF_WIRE_FORMATS:
+        raise ValueError(
+            f"unknown handoff wire_format {wire_format!r} — known: "
+            + ", ".join(HANDOFF_WIRE_FORMATS))
+    if "max_new_tokens" in handoff:
+        raise ValueError("session exports migrate whole (format 3/4); "
+                         "streaming is for prefill handoffs")
+    blocks = sorted(handoff["pages"])
+    if len(blocks) > CHUNKS_PER_STREAM:
+        raise ValueError(f"{len(blocks)} KV blocks exceed the streamed "
+                         f"chunk space ({CHUNKS_PER_STREAM})")
+    chunks: List[Tuple[dict, bytes]] = []
+    table: List[Dict[str, Any]] = []
+    for i, block in enumerate(blocks):
+        pk = _Packer()
+        codec_leaves: Dict[str, dict] = {}
+        for leaf in ("k", "v"):
+            name = f"{block}/{leaf}"
+            arr = np.asarray(handoff["pages"][block][leaf])
+            if wire_format == "f32":
+                pk.put(name, arr)
+            else:
+                from chainermn_tpu.collectives.quantized import \
+                    block_quantize
+                q, s = block_quantize(arr.reshape(-1), wire_format)
+                pk.put(name + "::q", np.asarray(q))
+                pk.put(name + "::scale", np.asarray(s, np.float32))
+                codec_leaves[name] = {"shape": list(arr.shape),
+                                      "dtype": arr.dtype.name,
+                                      "size": int(arr.size)}
+        blob = b"".join(pk.chunks)
+        digest = hashlib.sha256(blob).hexdigest()
+        man: Dict[str, Any] = {
+            "format": HANDOFF_FORMAT_STREAMED, "kind": "chunk",
+            "layer": block, "index": i,
+            "bytes": len(blob), "sha256": digest, "arrays": pk.arrays,
+        }
+        if wire_format != "f32":
+            from chainermn_tpu.collectives.quantized import QUANT_BLOCK
+            man["codec"] = {"wire_format": wire_format,
+                            "block": QUANT_BLOCK, "leaves": codec_leaves}
+        chunks.append((man, blob))
+        table.append({"layer": block, "index": i,
+                      "bytes": len(blob), "sha256": digest})
+    pk = _Packer()
+    pk.put("key", np.asarray(handoff["key"], np.uint32))
+    closing_blob = b"".join(pk.chunks)
+    meta = ({k: handoff[k] for k in _META_KEYS if k != "cursor"}
+            | {"cursor": int(handoff["cursor"])})
+    closing: Dict[str, Any] = {
+        "format": HANDOFF_FORMAT_STREAMED, "kind": "closing",
+        "bytes": len(closing_blob),
+        "sha256": hashlib.sha256(closing_blob).hexdigest(),
+        "arrays": pk.arrays, "meta": meta, "chunks": table,
+        "wire_format": wire_format,
+    }
+    return chunks, closing, closing_blob
+
+
+def streamed_wire_bytes(closing_manifest: dict) -> int:
+    """Exact wire bytes of the whole streamed handoff: the closing blob
+    plus every chunk the closing table commits to (the streamed sibling
+    of :func:`handoff_payload_bytes`, same bench-gate pricing role)."""
+    return int(closing_manifest["bytes"]) + sum(
+        int(c["bytes"]) for c in closing_manifest["chunks"])
+
+
+def decode_handoff_streamed(closing_manifest: dict, closing_blob: bytes,
+                            chunks: List[Tuple[dict, bytes]]) -> dict:
+    """Verify + assemble streamed frames back to the
+    ``Engine.import_handoff`` dict.
+
+    Every chunk must verify against BOTH its own manifest and the
+    closing table's commitment (byte count + digest + layer name) —
+    transport-level SHA checks already rejected torn frames, but only
+    the closing table proves the SET of chunks is complete and is THIS
+    handoff's (a chunk swapped in from another stream has a valid
+    self-manifest and still fails the table). Any defect raises
+    :class:`HandoffError`: the caller re-prefills, never adopts."""
+    try:
+        if closing_manifest.get("format") != HANDOFF_FORMAT_STREAMED \
+                or closing_manifest.get("kind") != "closing":
+            raise HandoffError(
+                "not a streamed closing manifest: format="
+                f"{closing_manifest.get('format')!r} "
+                f"kind={closing_manifest.get('kind')!r}")
+        if len(closing_blob) != int(closing_manifest["bytes"]):
+            raise HandoffError(
+                f"truncated closing frame: {len(closing_blob)} bytes, "
+                f"manifest says {closing_manifest['bytes']}")
+        if hashlib.sha256(closing_blob).hexdigest() \
+                != closing_manifest["sha256"]:
+            raise HandoffError("corrupt closing frame: sha256 mismatch")
+        table = closing_manifest["chunks"]
+        if len(chunks) != len(table):
+            raise HandoffError(
+                f"incomplete stream: {len(chunks)} chunks arrived, "
+                f"closing manifest commits to {len(table)}")
+        by_index: Dict[int, Tuple[dict, bytes]] = {}
+        for man, blob in chunks:
+            if man.get("format") != HANDOFF_FORMAT_STREAMED \
+                    or man.get("kind") != "chunk":
+                raise HandoffError(
+                    f"not a streamed chunk manifest: {man.get('kind')!r}")
+            by_index[int(man["index"])] = (man, blob)
+        pages: Dict[str, Dict[str, np.ndarray]] = {}
+        for ent in table:
+            idx = int(ent["index"])
+            if idx not in by_index:
+                raise HandoffError(f"missing chunk {idx} "
+                                   f"(layer {ent['layer']!r})")
+            man, blob = by_index[idx]
+            if (man["layer"] != ent["layer"]
+                    or len(blob) != int(ent["bytes"])
+                    or hashlib.sha256(blob).hexdigest() != ent["sha256"]
+                    or man["sha256"] != ent["sha256"]):
+                raise HandoffError(
+                    f"chunk {idx} (layer {ent['layer']!r}) does not "
+                    "match the closing manifest's commitment")
+            flat: Dict[str, np.ndarray] = {}
+            for a in man["arrays"]:
+                raw = blob[a["offset"]:a["offset"] + a["nbytes"]]
+                flat[a["name"]] = np.frombuffer(
+                    raw, dtype=_dtype(a["dtype"])).reshape(a["shape"])
+            codec = man.get("codec")
+            if codec is None:
+                for name, arr in flat.items():
+                    block, leaf = name.rsplit("/", 1)
+                    pages.setdefault(block, {})[leaf] = arr
+            else:
+                from chainermn_tpu.collectives.quantized import \
+                    block_dequantize
+                blk = int(codec.get("block", 256))
+                for base, spec in codec["leaves"].items():
+                    deq = np.asarray(block_dequantize(
+                        flat[base + "::q"], flat[base + "::scale"],
+                        int(spec["size"]), codec["wire_format"],
+                        _dtype(spec["dtype"]), blk))
+                    block, leaf = base.rsplit("/", 1)
+                    pages.setdefault(block, {})[leaf] = deq.reshape(
+                        spec["shape"])
+        meta = closing_manifest["meta"]
+        key = None
+        for a in closing_manifest["arrays"]:
+            if a["name"] == "key":
+                raw = closing_blob[a["offset"]:a["offset"] + a["nbytes"]]
+                key = np.frombuffer(raw, dtype=_dtype(a["dtype"])
+                                    ).reshape(a["shape"])
+        if key is None:
+            raise HandoffError("closing manifest carries no PRNG key")
+        return {
+            "pages": pages,
+            "cursor": int(meta["cursor"]),
+            "tokens": list(meta["tokens"]),
+            "key": key,
+            "prompt_len": int(meta["prompt_len"]),
+            "eos_id": meta["eos_id"],
+            "temperature": meta["temperature"],
+            "top_k": meta["top_k"],
+            "seed": meta["seed"],
+        }
+    except HandoffError:
+        raise
+    except Exception as e:   # broken manifest structure → same contract
+        raise HandoffError(
+            f"undecodable streamed handoff: {type(e).__name__}: {e}"
         ) from e

@@ -58,12 +58,19 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from chainermn_tpu.fleet.handoff import (HandoffError, decode_handoff,
-                                         encode_handoff)
+from chainermn_tpu.fleet.handoff import (HANDOFF_FORMAT_STREAMED,
+                                         HandoffError, decode_handoff,
+                                         decode_handoff_streamed,
+                                         encode_handoff,
+                                         encode_handoff_streamed,
+                                         streamed_chunk_sid,
+                                         streamed_parent_sid,
+                                         streamed_wire_bytes)
 from chainermn_tpu.fleet.reports import FleetReport
 from chainermn_tpu.fleet.transport import InProcessTransport
 
-__all__ = ["Stream", "PrefillPool", "DecodePool", "DisaggregatedFleet"]
+__all__ = ["Stream", "PrefillPool", "DecodePool", "DisaggregatedFleet",
+           "StreamAssembler"]
 
 
 class Stream:
@@ -81,6 +88,7 @@ class Stream:
         self.tokens: List[int] = []
         self.state = "queued"         # queued|prefill|decode|done
         self.fell_back = False        # handoff failed → re-prefilled
+        self.fallback_reason: Optional[str] = None  # why the wire failed
 
     @property
     def finished(self) -> bool:
@@ -99,6 +107,11 @@ class PrefillPool:
                                  hold=True, **stream.kw)
         self._by_id[req.request_id] = stream
         stream.state = "prefill"
+
+    def depth(self) -> int:
+        """Streams submitted here and not yet released — the
+        least-depth signal for prefill-pool choice."""
+        return len(self._by_id)
 
     def step(self) -> bool:
         """Advance iff there is prefill work (held slots alone are not
@@ -142,6 +155,12 @@ class DecodePool:
     def has_room(self) -> bool:
         return bool(self.engine.free_slots)
 
+    def depth(self) -> int:
+        """Streams this pool is currently responsible for — the
+        router's least-depth placement signal, applied to decode-pool
+        choice in the m×n conveyor."""
+        return len(self._inflight)
+
     def place(self, stream: Stream, handoff: dict) -> None:
         """Adopt a VERIFIED handoff: the imported slot resumes the
         exporting engine's exact stream."""
@@ -150,16 +169,20 @@ class DecodePool:
         stream.state = "decode"
         self._inflight.append((req, stream))
 
-    def fallback(self, stream: Stream) -> None:
+    def fallback(self, stream: Stream,
+                 reason: Optional[str] = None) -> None:
         """Handoff failed verification or delivery → CLEAN re-prefill
         of the full prompt on this engine. Same seed, so the per-token
         key-split contract replays the identical stream; the suspect
-        bytes never touch a slot."""
+        bytes never touch a slot. ``reason`` is the wire's defect
+        history (transport NACK reasons / codec error) so the fallback
+        log says WHY, not just that it happened."""
         req = self.engine.submit(stream.prompt,
                                  max_new_tokens=stream.max_new_tokens,
                                  **stream.kw)
         stream.state = "decode"
         stream.fell_back = True
+        stream.fallback_reason = reason or "delivery failed"
         self._inflight.append((req, stream))
 
     def step(self) -> bool:
@@ -178,6 +201,43 @@ class DecodePool:
         return worked
 
 
+class StreamAssembler:
+    """Receiver-side reassembly of streamed (format-5) handoffs.
+
+    Chunk frames ride the transport under their own (negative) stream
+    ids — per-frame SHA verify, NACK/re-send, and duplicate fencing all
+    apply per chunk — and park here until the closing frame commits the
+    stream. ``decode_handoff_streamed`` then proves the set against the
+    closing table; a chunk that never survived its delivery budget is
+    simply missing at assembly time, which fails verification and
+    becomes a clean re-prefill — chunk-level loss can never poison a
+    decode slot, and its defect history rides along for the log."""
+
+    def __init__(self) -> None:
+        self.chunks: Dict[int, Dict[int, Tuple[dict, bytes]]] = {}
+        self.defects: Dict[int, List[str]] = {}
+
+    def add_chunk(self, arrival) -> None:
+        """File one chunk arrival under its parent stream."""
+        sid, idx = streamed_parent_sid(arrival.stream_id)
+        if arrival.failed:
+            why = "; ".join(arrival.defects) or "delivery failed"
+            self.defects.setdefault(sid, []).append(
+                f"chunk {idx}: {why}")
+            return
+        self.chunks.setdefault(sid, {})[idx] = (arrival.manifest,
+                                                arrival.blob)
+
+    def take(self, sid: int) -> Tuple[List[Tuple[dict, bytes]],
+                                      List[str]]:
+        """Pop everything held for ``sid``: ``(chunks_in_index_order,
+        defect_notes)``. Called exactly once per closing frame (or on
+        the stream's failure), so fenced streams leave no residue."""
+        held = self.chunks.pop(sid, {})
+        return ([held[i] for i in sorted(held)],
+                self.defects.pop(sid, []))
+
+
 class DisaggregatedFleet:
     """The conveyor: submit → prefill → handoff transport → decode.
 
@@ -189,6 +249,25 @@ class DisaggregatedFleet:
     InProcessTransport` (pass one with ``wire_delay_ms`` to model DCN
     latency, or wire the pools across processes via
     ``tools/fleet_lm.py --hosts``).
+
+    **m×n pools** — both engine arguments accept a single engine or a
+    list. Every prefill pool feeds every decode pool: the destination
+    for each handoff is chosen at transfer time by the router's
+    least-depth logic over the decode pools, with the saturated-
+    survivor precheck — when NO decode pool has a free slot the slot
+    stays held (``stats["deferred"]``) instead of shipping bytes that
+    would have nowhere to adopt. One transport per decode pool
+    (``transport`` may be a matching list); arrivals adopt on the pool
+    whose transport delivered them.
+
+    **streamed handoffs** (``streamed=True``) — each handoff ships as
+    format-5 per-layer chunk frames plus a closing manifest
+    (:func:`~chainermn_tpu.fleet.handoff.encode_handoff_streamed`).
+    Every chunk is its own transport frame — SHA-verified, NACKed, and
+    re-sent independently, so a corrupt chunk costs one chunk's
+    re-send — and the receiver's :class:`StreamAssembler` holds them
+    until the closing frame proves the set. Any gap fails assembly and
+    falls back to a clean re-prefill.
 
     With ``async_conveyor=True`` the encode+send leg runs on a worker
     thread behind a bounded queue — see the module docstring for the
@@ -208,26 +287,55 @@ class DisaggregatedFleet:
                  transport=None,
                  async_conveyor: bool = False,
                  max_pending: int = 2,
-                 backpressure: str = "block"):
+                 backpressure: str = "block",
+                 streamed: bool = False):
         if backpressure not in ("block", "skip"):
             raise ValueError(
                 f"backpressure must be 'block' or 'skip': {backpressure!r}")
-        self.prefill = PrefillPool(prefill_engine)
-        self.decode = DecodePool(decode_engine)
+        pre = (list(prefill_engine)
+               if isinstance(prefill_engine, (list, tuple))
+               else [prefill_engine])
+        dec = (list(decode_engine)
+               if isinstance(decode_engine, (list, tuple))
+               else [decode_engine])
+        if not pre or not dec:
+            raise ValueError("need at least one engine per side")
+        self.prefills = [PrefillPool(e) for e in pre]
+        self.decodes = [DecodePool(e) for e in dec]
+        # the 1×1 aliases older callers (and half the tests) use
+        self.prefill = self.prefills[0]
+        self.decode = self.decodes[0]
         self.wire_format = wire_format
+        self.streamed = bool(streamed)
         self.report = report or FleetReport()
-        self.transport = transport or InProcessTransport()
+        if transport is None:
+            self.transports = [InProcessTransport() for _ in self.decodes]
+        elif isinstance(transport, (list, tuple)):
+            if len(transport) != len(self.decodes):
+                raise ValueError(
+                    f"{len(transport)} transports for "
+                    f"{len(self.decodes)} decode pools")
+            self.transports = list(transport)
+        else:
+            if len(self.decodes) != 1:
+                raise ValueError("a single transport needs a single "
+                                 "decode pool — pass one per pool")
+            self.transports = [transport]
+        self.transport = self.transports[0]
         self.async_conveyor = bool(async_conveyor)
         self.backpressure = backpressure
         self._ids = itertools.count()
         self.streams: List[Stream] = []
         self._by_sid: Dict[int, Stream] = {}
-        self._pending_place: list = []        # verified Arrivals, no room yet
-        self.stats = {"transfers": 0, "skipped": 0,
+        self._asm = StreamAssembler()
+        self._pending_place: list = []   # (decode_idx, Arrival) buffered
+        self.stats = {"transfers": 0, "skipped": 0, "deferred": 0,
+                      "streamed_chunks": 0,
                       "stall_ms_total": 0.0, "transfer_ms_total": 0.0}
         if self.async_conveyor:
             self._q: queue.Queue = queue.Queue(max(1, int(max_pending)))
-            self._inflight: Dict[int, object] = {}   # sid → held req
+            # sid → (owning prefill pool, held req)
+            self._inflight: Dict[int, Tuple[PrefillPool, object]] = {}
             self._done: collections.deque = collections.deque()
             self._error: Optional[BaseException] = None
             self._stop = threading.Event()
@@ -242,40 +350,107 @@ class DisaggregatedFleet:
         stream = Stream(next(self._ids), prompt, mnt, kw)
         self.streams.append(stream)
         self._by_sid[stream.stream_id] = stream
-        self.prefill.submit(stream)
+        # least-depth over the prefill pools (ties break by index)
+        pool = min(enumerate(self.prefills),
+                   key=lambda e: (e[1].depth(), e[0]))[1]
+        pool.submit(stream)
         return stream
+
+    # -- destination choice (m×n) ----------------------------------------
+
+    def _pick_dest(self) -> Optional[int]:
+        """Least-depth decode pool WITH a free slot (ties break by
+        index — deterministic, like the router's ``_pick_dest``).
+        ``None`` means every pool is saturated: the saturated-survivor
+        precheck — shipping bytes now would leave them with nowhere to
+        adopt, so the held slot defers until someone drains."""
+        cands = [(pool.depth(), di)
+                 for di, pool in enumerate(self.decodes)
+                 if pool.has_room()]
+        if not cands:
+            return None
+        return min(cands)[1]
+
+    def _send_handoff(self, di: int, sid: int, handoff: dict) -> str:
+        """Encode + ship one handoff on ``transports[di]``; returns
+        the terminal status of the frame that commits the stream.
+
+        Streamed mode ships each KV block as its own transport frame
+        (chunk stream ids) — verified, NACKed, and re-sent per chunk —
+        then the closing frame under the real stream id. A chunk that
+        exhausts its budget is NOT fatal here: the receiver's assembly
+        check catches the gap at adoption and re-prefills cleanly."""
+        transport = self.transports[di]
+        if not self.streamed:
+            manifest, blob = encode_handoff(handoff, self.wire_format)
+            self.report.record_handoff(self.wire_format, len(blob))
+            return transport.send(sid, manifest, blob)
+        chunks, closing, closing_blob = encode_handoff_streamed(
+            handoff, self.wire_format)
+        self.report.record_handoff(self.wire_format,
+                                   streamed_wire_bytes(closing))
+        for i, (man, blob) in enumerate(chunks):
+            transport.send(streamed_chunk_sid(sid, i), man, blob)
+            self.stats["streamed_chunks"] += 1
+        return transport.send(sid, closing, closing_blob)
 
     # -- arrivals (both modes; step thread only) -------------------------
 
     def _pump_arrivals(self) -> None:
-        self._pending_place.extend(self.transport.poll())
+        for di, transport in enumerate(self.transports):
+            for arr in transport.poll():
+                self._pending_place.append((di, arr))
 
     def _place(self) -> bool:
-        """Adopt or fall back every buffered arrival the decode pool
+        """Adopt or fall back every buffered arrival its decode pool
         has room for (fallback re-submits through the engine queue, so
-        it never needs a free slot up front)."""
+        it never needs a free slot up front). Chunk frames file into
+        the assembler; the closing frame adopts the whole stream."""
         placed = False
         still = []
-        for arr in self._pending_place:
-            stream = self._by_sid.get(arr.stream_id)
-            if stream is None:
-                continue          # fenced/unknown stream: nothing to do
-            if arr.failed:
-                self.report.record_fallback()
-                self.decode.fallback(stream)
+        for di, arr in self._pending_place:
+            if arr.stream_id < 0:
+                self._asm.add_chunk(arr)
                 placed = True
                 continue
-            if not self.decode.has_room():
-                still.append(arr)
-                continue
-            try:
-                self.decode.place(stream,
-                                  decode_handoff(arr.manifest, arr.blob))
-            except HandoffError:
-                # wire-verified but structurally unusable (format skew):
-                # same clean-re-prefill answer as a failed delivery
+            stream = self._by_sid.get(arr.stream_id)
+            if stream is None or stream.state != "prefill":
+                continue          # fenced/unknown stream: nothing to do
+            pool = self.decodes[di]
+            if arr.failed:
+                _, notes = self._asm.take(arr.stream_id)
+                reason = "; ".join(arr.defects) or "delivery failed"
+                if notes:
+                    reason += " [" + "; ".join(notes) + "]"
                 self.report.record_fallback()
-                self.decode.fallback(stream)
+                pool.fallback(stream, reason)
+                placed = True
+                continue
+            if not pool.has_room():
+                still.append((di, arr))
+                continue
+            manifest = arr.manifest
+            notes: List[str] = []
+            try:
+                if (isinstance(manifest, dict)
+                        and manifest.get("format")
+                        == HANDOFF_FORMAT_STREAMED):
+                    chunks, notes = self._asm.take(arr.stream_id)
+                    handoff = decode_handoff_streamed(
+                        manifest, arr.blob, chunks)
+                else:
+                    handoff = decode_handoff(manifest, arr.blob)
+                pool.place(stream, handoff)
+            except HandoffError as e:
+                # wire-verified but structurally unusable (format skew,
+                # missing/foreign chunk): same clean-re-prefill answer
+                # as a failed delivery — with the per-chunk defect
+                # history attached, so the log says WHY
+                reason = str(e)
+                if notes:
+                    reason += " [" + "; ".join(notes) + "]"
+                self.report.record_fallback()
+                pool.fallback(stream, reason)
             placed = True
         self._pending_place = still
         return placed
@@ -283,30 +458,32 @@ class DisaggregatedFleet:
     # -- synchronous conveyor --------------------------------------------
 
     def _transfer(self) -> bool:
-        """Move every exportable held slot the decode pool has room
+        """Move every exportable held slot some decode pool has room
         for: export → encode → transport (seq/SHA frames, bounded
         re-send) → place, with delivery failure answered by a clean
         re-prefill. The step thread pays the wire inline — all of it
         booked as stall so the async path has an honest baseline."""
         moved = False
-        for stream, req in self.prefill.ready():
-            if not self.decode.has_room():
-                break
-            handoff = self.prefill.export(req)
-            manifest, blob = encode_handoff(handoff, self.wire_format)
-            self.report.record_handoff(self.wire_format, len(blob))
-            t0 = time.monotonic()
-            status = self.transport.send(stream.stream_id, manifest, blob)
-            spent_ms = (time.monotonic() - t0) * 1000.0
-            self.stats["transfer_ms_total"] += spent_ms
-            self.stats["stall_ms_total"] += spent_ms
-            self.stats["transfers"] += 1
-            self.prefill.release(req, aborted=(status == "failed"))
-            # place immediately so has_room stays accurate for the next
-            # held slot in this same pass
-            self._pump_arrivals()
-            self._place()
-            moved = True
+        for pool in self.prefills:
+            for stream, req in pool.ready():
+                di = self._pick_dest()
+                if di is None:
+                    self.stats["deferred"] += 1
+                    return moved
+                handoff = pool.export(req)
+                t0 = time.monotonic()
+                status = self._send_handoff(di, stream.stream_id,
+                                            handoff)
+                spent_ms = (time.monotonic() - t0) * 1000.0
+                self.stats["transfer_ms_total"] += spent_ms
+                self.stats["stall_ms_total"] += spent_ms
+                self.stats["transfers"] += 1
+                pool.release(req, aborted=(status == "failed"))
+                # place immediately so has_room stays accurate for the
+                # next held slot in this same pass
+                self._pump_arrivals()
+                self._place()
+                moved = True
         return moved
 
     # -- asynchronous conveyor -------------------------------------------
@@ -317,14 +494,12 @@ class DisaggregatedFleet:
         captured and re-raised from the next ``step()``."""
         while not self._stop.is_set():
             try:
-                sid, handoff = self._q.get(timeout=self._POLL_S)
+                sid, handoff, di = self._q.get(timeout=self._POLL_S)
             except queue.Empty:
                 continue
             try:
-                manifest, blob = encode_handoff(handoff, self.wire_format)
-                self.report.record_handoff(self.wire_format, len(blob))
                 t0 = time.monotonic()
-                status = self.transport.send(sid, manifest, blob)
+                status = self._send_handoff(di, sid, handoff)
                 self.stats["transfer_ms_total"] += (
                     (time.monotonic() - t0) * 1000.0)
                 self._done.append((sid, status))
@@ -343,37 +518,45 @@ class DisaggregatedFleet:
 
     def _offer(self) -> bool:
         """Export ready held slots on the step thread and hand them to
-        the worker. ``skip`` backpressure leaves the slot held on a
-        full queue (it re-offers next step); ``block`` waits — that
-        wait is the only stall the async conveyor books."""
+        the worker (destination decode pool chosen here, at offer
+        time, by least depth). ``skip`` backpressure leaves the slot
+        held on a full queue (it re-offers next step); ``block`` waits
+        — that wait is the only stall the async conveyor books. When
+        every decode pool is saturated the slot defers instead."""
         offered = False
-        for stream, req in self.prefill.ready():
-            sid = stream.stream_id
-            if sid in self._inflight:
-                continue           # already on the wire; release pending
-            if self.backpressure == "skip" and self._q.full():
-                self.stats["skipped"] += 1
-                break
-            handoff = self.prefill.export(req)
-            if self.backpressure == "skip":
-                try:
-                    self._q.put_nowait((sid, handoff))
-                except queue.Full:  # raced the check above: same answer
+        for pool in self.prefills:
+            for stream, req in pool.ready():
+                sid = stream.stream_id
+                if sid in self._inflight:
+                    continue       # already on the wire; release pending
+                di = self._pick_dest()
+                if di is None:
+                    self.stats["deferred"] += 1
+                    return offered
+                if self.backpressure == "skip" and self._q.full():
                     self.stats["skipped"] += 1
-                    break
-            else:
-                t0 = time.monotonic()
-                while True:
-                    self._raise_pending()   # a dead worker never drains
+                    return offered
+                handoff = pool.export(req)
+                if self.backpressure == "skip":
                     try:
-                        self._q.put((sid, handoff), timeout=self._POLL_S)
-                        break
-                    except queue.Full:
-                        continue
-                self.stats["stall_ms_total"] += (
-                    (time.monotonic() - t0) * 1000.0)
-            self._inflight[sid] = req
-            offered = True
+                        self._q.put_nowait((sid, handoff, di))
+                    except queue.Full:  # raced the check: same answer
+                        self.stats["skipped"] += 1
+                        return offered
+                else:
+                    t0 = time.monotonic()
+                    while True:
+                        self._raise_pending()  # dead worker never drains
+                        try:
+                            self._q.put((sid, handoff, di),
+                                        timeout=self._POLL_S)
+                            break
+                        except queue.Full:
+                            continue
+                    self.stats["stall_ms_total"] += (
+                        (time.monotonic() - t0) * 1000.0)
+                self._inflight[sid] = (pool, req)
+                offered = True
         return offered
 
     def _reap(self) -> bool:
@@ -383,9 +566,10 @@ class DisaggregatedFleet:
         reaped = False
         while self._done:
             sid, status = self._done.popleft()
-            req = self._inflight.pop(sid, None)
-            if req is not None:
-                self.prefill.release(req, aborted=(status == "failed"))
+            ent = self._inflight.pop(sid, None)
+            if ent is not None:
+                pool, req = ent
+                pool.release(req, aborted=(status == "failed"))
             reaped = True
         return reaped
 
@@ -429,26 +613,39 @@ class DisaggregatedFleet:
     def step(self) -> bool:
         """One conveyor iteration; returns whether anything advanced."""
         if not self.async_conveyor:
-            worked = self.prefill.step()
+            worked = False
+            for pool in self.prefills:
+                # each pool step syncs internally (int32 token pulls)
+                worked = pool.step() or worked  # dlint: disable=DL104
             worked = self._transfer() or worked
             self._pump_arrivals()
             worked = self._place() or worked
-            worked = self.decode.step() or worked
+            for pool in self.decodes:
+                # each pool step syncs internally (int32 token pulls)
+                worked = pool.step() or worked  # dlint: disable=DL104
             return worked
         self._raise_pending()
-        worked = self.prefill.step()
+        worked = False
+        for pool in self.prefills:
+            # each pool step syncs internally (int32 token pulls)
+            worked = pool.step() or worked  # dlint: disable=DL104
         worked = self._reap() or worked
         worked = self._offer() or worked
         self._pump_arrivals()
         worked = self._place() or worked
-        worked = self.decode.step() or worked
+        for pool in self.decodes:
+            # each pool step syncs internally (int32 token pulls)
+            worked = pool.step() or worked  # dlint: disable=DL104
         return worked
 
     def idle(self) -> bool:
-        if (not self.prefill.engine.idle()
-                or self.prefill.engine.held
-                or not self.decode.engine.idle()
-                or self._pending_place):
+        for pool in self.prefills:
+            if not pool.engine.idle() or pool.engine.held:
+                return False
+        for pool in self.decodes:
+            if not pool.engine.idle():
+                return False
+        if self._pending_place:
             return False
         if self.async_conveyor and (self._inflight or self._done
                                     or self._q.unfinished_tasks):
@@ -480,7 +677,35 @@ class DisaggregatedFleet:
                             1.0 - self.stats["stall_ms_total"] / xfer))
 
     def reports(self):
-        return [self.prefill.engine.report, self.decode.engine.report]
+        return ([pool.engine.report for pool in self.prefills]
+                + [pool.engine.report for pool in self.decodes])
+
+    def transport_totals(self) -> dict:
+        """Live wire-health counters folded across every transport:
+        retransmits (delivery attempts beyond the first), reconnects
+        (socket planes), duplicate-fenced frames, and streamed-chunk
+        NACKs — the numbers that prove per-chunk re-send granularity
+        and restart fencing actually engaged."""
+        tot = {"retransmits": 0, "reconnects": 0, "dup_fenced": 0,
+               "chunk_nacks": 0}
+        for transport in self.transports:
+            s = getattr(transport, "stats", {})
+            tot["retransmits"] += max(
+                0, int(s.get("attempts", 0)) - int(s.get("sent", 0)))
+            r = transport.receiver_stats
+            tot["dup_fenced"] += int(r.get("duplicates", 0))
+            tot["chunk_nacks"] += int(r.get("chunk_nacked", 0))
+            plane_stats = getattr(getattr(transport, "plane", None),
+                                  "stats", None)
+            if plane_stats:
+                tot["reconnects"] += int(plane_stats.get("reconnects", 0))
+        return tot
 
     def summary(self) -> dict:
-        return self.report.summary(self.reports())
+        out = self.report.summary(self.reports())
+        # fold the LIVE transport counters on top of whatever finished
+        # transports were already recorded into the report
+        live = out["fleet"]["transport"]
+        for key, val in self.transport_totals().items():
+            live[key] += val
+        return out

@@ -55,6 +55,11 @@ class FleetReport:
         self.migrations = 0           # decode sessions adopted by a peer
         self.migration_fallbacks = 0  # migrate failed → replay from seed
         self.migration_wire_bytes: Dict[str, int] = {}  # wire_format → B
+        # transport wire health (PR 18 socket plane + streamed chunks)
+        self.transport_retransmits = 0   # delivery attempts beyond 1st
+        self.transport_reconnects = 0    # socket-plane redials
+        self.transport_dup_fenced = 0    # frames answered `duplicate`
+        self.streamed_chunk_nacks = 0    # format-5 chunk-only re-sends
 
     # ----------------------------------------------------------------
     # router / pool hooks
@@ -93,13 +98,31 @@ class FleetReport:
         back to the PR 11 replay-from-seed path."""
         self.migration_fallbacks += 1
 
+    def record_transport(self, sender_stats: dict = (),
+                         receiver_stats: dict = (),
+                         plane_stats: dict = ()) -> None:
+        """Fold one transport's lifetime counters into the fleet
+        tallies: retransmits (attempts beyond each frame's first),
+        reconnects (socket plane redials), duplicate-fenced frames,
+        and streamed-chunk NACKs. Call once per transport at the end
+        of its run (the stats are lifetime totals, not deltas)."""
+        s = dict(sender_stats or {})
+        r = dict(receiver_stats or {})
+        p = dict(plane_stats or {})
+        self.transport_retransmits += max(
+            0, int(s.get("attempts", 0)) - int(s.get("sent", 0)))
+        self.transport_reconnects += int(p.get("reconnects", 0))
+        self.transport_dup_fenced += int(r.get("duplicates", 0))
+        self.streamed_chunk_nacks += int(r.get("chunk_nacked", 0))
+
     # ----------------------------------------------------------------
     # wire serialization (cross-process fleet merge)
     # ----------------------------------------------------------------
 
     #: bump on any change to the counter schema below
-    #: (2: migration/drain counters — PR 17 session migration)
-    WIRE_VERSION = 2
+    #: (2: migration/drain counters — PR 17 session migration;
+    #:  3: transport wire-health counters — PR 18 socket plane)
+    WIRE_VERSION = 3
 
     def to_wire(self) -> dict:
         """Version-tagged JSON-safe envelope of the fleet counters —
@@ -120,6 +143,10 @@ class FleetReport:
                     "migration_fallbacks": self.migration_fallbacks,
                     "migration_wire_bytes": dict(
                         self.migration_wire_bytes),
+                    "transport_retransmits": self.transport_retransmits,
+                    "transport_reconnects": self.transport_reconnects,
+                    "transport_dup_fenced": self.transport_dup_fenced,
+                    "streamed_chunk_nacks": self.streamed_chunk_nacks,
                 }}
 
     @classmethod
@@ -145,6 +172,10 @@ class FleetReport:
         out.migration_fallbacks = int(c["migration_fallbacks"])
         out.migration_wire_bytes = {str(k): int(v) for k, v
                                     in c["migration_wire_bytes"].items()}
+        out.transport_retransmits = int(c["transport_retransmits"])
+        out.transport_reconnects = int(c["transport_reconnects"])
+        out.transport_dup_fenced = int(c["transport_dup_fenced"])
+        out.streamed_chunk_nacks = int(c["streamed_chunk_nacks"])
         return out
 
     def absorb(self, other: "FleetReport") -> None:
@@ -165,6 +196,10 @@ class FleetReport:
         for fmt, nbytes in other.migration_wire_bytes.items():
             self.migration_wire_bytes[fmt] = (
                 self.migration_wire_bytes.get(fmt, 0) + int(nbytes))
+        self.transport_retransmits += other.transport_retransmits
+        self.transport_reconnects += other.transport_reconnects
+        self.transport_dup_fenced += other.transport_dup_fenced
+        self.streamed_chunk_nacks += other.streamed_chunk_nacks
 
     # ----------------------------------------------------------------
     # aggregation
@@ -230,6 +265,12 @@ class FleetReport:
             "migrations": self.migrations,
             "migration_fallbacks": self.migration_fallbacks,
             "migration_wire_bytes": dict(self.migration_wire_bytes),
+            "transport": {
+                "retransmits": self.transport_retransmits,
+                "reconnects": self.transport_reconnects,
+                "dup_fenced": self.transport_dup_fenced,
+                "chunk_nacks": self.streamed_chunk_nacks,
+            },
         }
         return out
 

@@ -676,6 +676,20 @@ class Router:
         out["fleet"]["replica_states"] = states
         out["fleet"]["draining"] = sorted(
             rid for rid, s in states.items() if s == "DRAINING")
+        # live wire-health counters off the migration transport (the
+        # FleetReport block carries the fold of FINISHED transports;
+        # this one is the router's own, still-running wire)
+        mig = self._mig_transport
+        sender = getattr(mig, "stats", {})
+        recv = mig.receiver_stats
+        plane_stats = getattr(getattr(mig, "plane", None), "stats", {})
+        live = out["fleet"]["transport"]
+        live["retransmits"] += max(0, int(sender.get("attempts", 0))
+                                   - int(sender.get("sent", 0)))
+        live["reconnects"] += int((plane_stats or {}).get(
+            "reconnects", 0))
+        live["dup_fenced"] += int(recv.get("duplicates", 0))
+        live["chunk_nacks"] += int(recv.get("chunk_nacked", 0))
         return out
 
     # ----------------------------------------------------------------

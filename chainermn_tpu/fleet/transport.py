@@ -57,7 +57,7 @@ from chainermn_tpu.resilience import chaos
 from chainermn_tpu.resilience.policy import RpcPolicy, policy
 
 __all__ = ["TransportError", "Arrival", "InProcessTransport",
-           "ObjectPlaneTransport", "LoopbackPlane",
+           "ObjectPlaneTransport", "LoopbackPlane", "PairedTransport",
            "HANDOFF_DATA_TAG", "HANDOFF_ACK_TAG"]
 
 #: object-plane tags for the two handoff channels (data and acks ride
@@ -77,15 +77,20 @@ class Arrival:
     """One verified receiver-side outcome. ``manifest is None`` means
     the frame could not be delivered intact within the attempt budget —
     the caller must answer with a clean re-prefill (the blob never
-    touches an engine)."""
+    touches an engine). ``defects`` is then the per-attempt defect
+    history (every ``_frame_defect`` reason this frame's seq
+    accumulated), so the fallback log can say WHY the wire failed
+    instead of just that it did."""
 
-    __slots__ = ("stream_id", "manifest", "blob")
+    __slots__ = ("stream_id", "manifest", "blob", "defects")
 
     def __init__(self, stream_id: int, manifest: Optional[dict],
-                 blob: Optional[bytes]):
+                 blob: Optional[bytes],
+                 defects: Tuple[str, ...] = ()):
         self.stream_id = int(stream_id)
         self.manifest = manifest
         self.blob = blob
+        self.defects = tuple(defects)
 
     @property
     def failed(self) -> bool:
@@ -117,8 +122,9 @@ class _ReceiverState:
         self.resolved: set = set()          # stream_ids fenced off
         self.expect_seq = 0                 # next frame seq (stats only)
         self.nacks: Dict[int, int] = {}     # seq → failed deliveries
+        self.defects: Dict[int, List[str]] = {}  # seq → defect history
         self.stats = {"delivered": 0, "duplicates": 0, "nacked": 0,
-                      "reordered": 0, "failed": 0}
+                      "reordered": 0, "failed": 0, "chunk_nacked": 0}
 
     def admit(self, seq: int, stream_id: int, manifest: dict,
               blob: bytes) -> Tuple[str, Optional[Arrival]]:
@@ -138,17 +144,28 @@ class _ReceiverState:
             self.expect_seq = max(self.expect_seq, seq + 1)
             self.resolved.add(stream_id)
             self.stats["delivered"] += 1
+            self.defects.pop(seq, None)
             return "adopted", Arrival(stream_id, manifest, blob)
+        self.defects.setdefault(seq, []).append(defect)
         bad = self.nacks.get(seq, 0) + 1
         self.nacks[seq] = bad
         if bad >= self.max_attempts:
             # give up on the wire for this frame: fence the stream and
-            # hand it back for a clean re-prefill
+            # hand it back for a clean re-prefill — with the full
+            # defect history attached, so the fallback log names the
+            # wire's failure mode instead of just the outcome
             self.expect_seq = max(self.expect_seq, seq + 1)
             self.resolved.add(stream_id)
             self.stats["failed"] += 1
-            return "failed", Arrival(stream_id, None, None)
+            return "failed", Arrival(stream_id, None, None,
+                                     defects=tuple(
+                                         self.defects.pop(seq, ())))
         self.stats["nacked"] += 1
+        if isinstance(manifest, dict) and manifest.get("format") == 5 \
+                and manifest.get("kind") == "chunk":
+            # a streamed chunk re-sends alone — the counter the
+            # fleet-report gate uses to prove per-chunk granularity
+            self.stats["chunk_nacked"] += 1
         return "nack", None
 
 
@@ -178,6 +195,9 @@ class InProcessTransport:
         self._send_seq = 0
         self.stats = {"sent": 0, "attempts": 0, "dropped": 0,
                       "send_failed": 0}
+        #: defect history of the most recent ``failed`` send (why the
+        #: wire failed, not just that it did)
+        self.last_send_defects: Tuple[str, ...] = ()
 
     # -- sender face -----------------------------------------------------
 
@@ -212,10 +232,15 @@ class InProcessTransport:
         # attempts exhausted with no intact delivery: fence + fallback
         with self._lock:
             self.stats["send_failed"] += 1
+            defects = tuple(self._recv.defects.pop(seq, ())) or (
+                f"no intact delivery in {self.max_attempts} attempts "
+                "(frames dropped in flight)",)
+            self.last_send_defects = defects
             if stream_id not in self._recv.resolved:
                 self._recv.resolved.add(stream_id)
                 self._recv.stats["failed"] += 1
-                self._arrivals.append(Arrival(stream_id, None, None))
+                self._arrivals.append(
+                    Arrival(stream_id, None, None, defects=defects))
         return "failed"
 
     def _deliver(self, seq: int, stream_id: int, manifest: dict,
@@ -298,8 +323,13 @@ class ObjectPlaneTransport:
         self._recv = _ReceiverState(max_attempts)
         self._send_seq = 0
         self._acks: Dict[int, str] = {}     # seq → status (sender side)
+        self._nack_reasons: Dict[int, List[str]] = {}  # seq → defects
         self.stats = {"sent": 0, "attempts": 0, "ack_timeouts": 0,
                       "send_failed": 0}
+        #: defect history of the most recent ``failed`` send — the
+        #: receiver's NACK reasons plus local ack timeouts, so the
+        #: fallback log can say WHY the wire failed
+        self.last_send_defects: Tuple[str, ...] = ()
 
     # -- sender face -----------------------------------------------------
 
@@ -326,10 +356,12 @@ class ObjectPlaneTransport:
             status = self._await_ack(seq)
             if status in _ACK_STATUSES:
                 self._gc_plane(self.ack_tag)
+                self._nack_reasons.pop(seq, None)
                 return status
             if attempt + 1 < self.max_attempts:
                 time.sleep(self.policy.backoff_ms(attempt) / 1000.0)
         self.stats["send_failed"] += 1
+        self.last_send_defects = tuple(self._nack_reasons.pop(seq, ()))
         return "failed"
 
     def _gc_plane(self, tag: int) -> None:
@@ -359,6 +391,9 @@ class ObjectPlaneTransport:
             left_ms = (deadline - time.monotonic()) * 1000.0
             if left_ms <= 0:
                 self.stats["ack_timeouts"] += 1
+                self._nack_reasons.setdefault(seq, []).append(
+                    f"no ack within {int(budget_ms)} ms "
+                    "(frame or ack lost in flight)")
                 return None
             try:
                 ack = self.plane.try_recv_obj(
@@ -370,6 +405,8 @@ class ObjectPlaneTransport:
             if not isinstance(ack, dict) or "seq" not in ack:
                 continue                      # unintelligible: ignore
             if ack.get("kind") == "nack" and int(ack["seq"]) == seq:
+                self._nack_reasons.setdefault(seq, []).append(
+                    str(ack.get("reason", "receiver NACK")))
                 return None                   # damaged in flight: re-send
             if ack.get("kind") == "ack":
                 if int(ack["seq"]) == seq:
@@ -417,7 +454,9 @@ class ObjectPlaneTransport:
             return None
         status, arrival = self._recv.admit(seq, stream_id, manifest, blob)
         if status == "nack":
-            self.plane.send_obj({"kind": "nack", "seq": seq}, self.peer,
+            hist = self._recv.defects.get(seq) or ["frame defect"]
+            self.plane.send_obj({"kind": "nack", "seq": seq,
+                                 "reason": hist[-1]}, self.peer,
                                 tag=self.ack_tag)
         else:
             self.plane.send_obj({"kind": "ack", "seq": seq,
@@ -435,6 +474,50 @@ class ObjectPlaneTransport:
     @property
     def receiver_stats(self) -> dict:
         return dict(self._recv.stats)
+
+    def close(self) -> None:
+        pass
+
+
+class PairedTransport:
+    """Two :class:`ObjectPlaneTransport` endpoints glued into the
+    single-object transport interface ``DisaggregatedFleet`` expects.
+
+    A real object plane has one process per end, so the sender face
+    and the receiver face of a channel live in different transports.
+    When one process holds BOTH ends — the bench's localhost-socket
+    drill, the tier-1 socket harness — this adapter routes ``send``
+    to the sender-side transport and ``poll``/``resolve`` to the
+    receiver-side one, while forwarding the stats surfaces
+    (``stats``, ``receiver_stats``, ``last_send_defects``, ``plane``)
+    the fleet's wire-health accounting reads."""
+
+    def __init__(self, sender: ObjectPlaneTransport,
+                 receiver: ObjectPlaneTransport):
+        self.sender = sender
+        self.receiver = receiver
+        self.plane = sender.plane
+
+    def send(self, stream_id: int, manifest: dict, blob: bytes) -> str:
+        return self.sender.send(stream_id, manifest, blob)
+
+    def poll(self, timeout_ms: int = 0) -> List[Arrival]:
+        return self.receiver.poll(timeout_ms=timeout_ms)
+
+    def resolve(self, stream_id: int) -> None:
+        self.receiver.resolve(stream_id)
+
+    @property
+    def stats(self) -> dict:
+        return self.sender.stats
+
+    @property
+    def receiver_stats(self) -> dict:
+        return self.receiver.receiver_stats
+
+    @property
+    def last_send_defects(self):
+        return self.sender.last_send_defects
 
     def close(self) -> None:
         pass

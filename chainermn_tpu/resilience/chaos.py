@@ -25,6 +25,12 @@ deterministic schedule, so the suite can prove the stack survives them:
 * ``corrupt_handoff`` — damage a prefill→decode KV handoff blob on the
   wire (flip or truncate), which the decode pool's manifest verification
   must catch and answer with a clean re-prefill;
+* ``reset_conn`` / ``partial_write`` / ``stall_accept`` — socket-level
+  connection faults for the TCP object plane (:func:`on_socket`,
+  ``comm/socket_plane.py``): a connection dies with a frame in flight,
+  a frame is torn mid-write, the listener wedges. The plane's framing
+  (length + SHA), bounded reconnect, and re-handshake must contain
+  every one to a re-sent frame — never a torn delivery;
 * ``drop_handoff`` / ``delay_handoff`` / ``dup_handoff`` — wire-level
   delivery faults for the fleet transport (:func:`on_wire`): a frame
   vanishes, arrives late, or arrives twice. The transport's sequence
@@ -138,6 +144,23 @@ FAULT_KINDS: Dict[str, str] = {
                   "window — the router's sweep must replay the stream "
                   "from seed on a survivor): [times=N][,after=K]"
                   "[,prob=P][,seed=S][,rank=R|*]"),
+    "reset_conn": ("abruptly close a SocketObjectPlane connection "
+                   "before a frame is written (a peer RST / dead NAT "
+                   "entry — the sender must reconnect with backoff and "
+                   "the ack machinery must re-send the lost frame): "
+                   "[times=N][,after=K][,prob=P][,seed=S][,rank=R|*]"),
+    "partial_write": ("write only HALF a socket frame then close the "
+                      "connection (a torn TCP stream — the receiver's "
+                      "length/SHA framing must reject the fragment and "
+                      "resync on the reconnect, never deliver torn "
+                      "bytes): [times=N][,after=K][,prob=P][,seed=S]"
+                      "[,rank=R|*]"),
+    "stall_accept": ("sleep in the SocketObjectPlane acceptor before "
+                     "accept() (a wedged listener — connect attempts "
+                     "must time out under the RpcPolicy budget and "
+                     "retry with jittered backoff): [ms=M (default "
+                     "2000)][,times=N][,after=K][,prob=P][,seed=S]"
+                     "[,rank=R|*]"),
 }
 
 #: every fault kind also accepts ``run=K`` — fire only in supervised
@@ -503,6 +526,45 @@ class ChaosPlan:
                 data = self._damage_handoff(f, data)
         return (verdict, data)
 
+    #: socket-plane op → fault kinds that can fire there
+    _SOCKET_OPS = {"send": ("reset_conn", "partial_write"),
+                   "accept": ("stall_accept",)}
+
+    def on_socket(self, op: str,
+                  rank: Optional[int] = None) -> Optional[str]:
+        """Socket-level wire hook (comm/socket_plane.py) — the
+        connection-layer extension of :meth:`on_wire`, for faults the
+        verdict-over-bytes contract cannot express. ``op`` names the
+        plane operation:
+
+        * ``"send"`` — before a frame is written. Returns
+          ``"reset_conn"`` (the plane must close the connection and
+          lose the frame — a peer RST) or ``"partial_write"`` (the
+          plane must write half the frame then close — a torn stream),
+          else None.
+        * ``"accept"`` — in the acceptor loop. ``stall_accept`` sleeps
+          ``ms`` (default 2000) inline; always returns None.
+
+        One fault per call (first match wins), gated like every wire
+        fault: rank + run + ``after=`` + ``times=`` + probability."""
+        kinds = self._SOCKET_OPS.get(op)
+        if kinds is None:
+            raise ValueError(f"unknown socket op {op!r} — known: "
+                             + ", ".join(sorted(self._SOCKET_OPS)))
+        rank = _own_rank() if rank is None else rank
+        for f in self.faults:
+            if f.kind not in kinds:
+                continue
+            if not self._wire_gate(f, rank):
+                continue
+            f.fired += 1
+            self.log.append(f.kind)
+            if f.kind == "stall_accept":
+                self._sleep((f.ms if f.ms is not None else 2000) / 1000.0)
+                return None
+            return f.kind
+        return None
+
     def on_migration(self, stream_id: int,
                      rank: Optional[int] = None) -> bool:
         """Migration hook (fleet/router.py ``drain``): called right
@@ -631,6 +693,14 @@ def on_wire(data: bytes) -> tuple:
         if plan is not None:
             return plan.on_wire(data)
     return ("deliver", data)
+
+
+def on_socket(op: str) -> Optional[str]:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            return plan.on_socket(op)
+    return None
 
 
 def on_migration(stream_id: int) -> bool:

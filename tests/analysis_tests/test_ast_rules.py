@@ -25,7 +25,7 @@ def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
             "DL107", "DL108", "DL109", "DL110", "DL111", "DL112",
             "DL113", "DL114", "DL115", "DL116", "DL117", "DL118",
-            "DL119", "DL120", "DL121", "DL122",
+            "DL119", "DL120", "DL121", "DL122", "DL123",
             "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
@@ -1348,3 +1348,122 @@ def test_dl117_budget_object_does_not_mask_other_loops():
     fs = _only(_lint(src), "DL117")
     assert len(fs) == 1
     assert "recv_obj" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# DL123 — socket-without-timeout
+# ---------------------------------------------------------------------------
+
+
+def test_dl123_flags_blocking_recv_on_naked_socket():
+    src = """\
+    import socket
+
+    def pull(addr):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.connect(addr)
+        return sock.recv(4096)
+    """
+    fs = _only(_lint(src), "DL123")
+    assert len(fs) == 1
+    assert fs[0].line == 5                 # first blocking use
+    assert "sock.connect" in fs[0].message
+    assert "settimeout" in fs[0].message
+    assert "docs/static_analysis.md#dl123" in fs[0].message
+
+
+def test_dl123_flags_accept_conn_without_timeout():
+    """The conn accept() returns is a NEW socket — the server socket's
+    own timeout does not ride along."""
+    src = """\
+    def serve(srv):
+        srv.settimeout(1.0)
+        conn, addr = srv.accept()
+        return conn.recv(64)
+    """
+    fs = _only(_lint(src), "DL123")
+    assert len(fs) == 1
+    assert "conn.recv" in fs[0].message
+
+
+def test_dl123_clean_with_settimeout_after_creation():
+    src = """\
+    import socket
+
+    def pull(addr, probe_s):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(probe_s)
+        sock.connect(addr)
+        conn, _ = sock.accept()
+        conn.settimeout(probe_s)
+        return conn.recv(4096)
+    """
+    assert _only(_lint(src), "DL123") == []
+
+
+def test_dl123_clean_create_connection_with_timeout():
+    src = """\
+    import socket
+
+    def dial(addr, probe_s):
+        sock = socket.create_connection(addr, timeout=probe_s)
+        sock.sendall(b"hello")
+    """
+    assert _only(_lint(src), "DL123") == []
+
+
+def test_dl123_flags_create_connection_without_timeout():
+    src = """\
+    import socket
+
+    def dial(addr):
+        sock = socket.create_connection(addr)
+        sock.sendall(b"hello")
+    """
+    fs = _only(_lint(src), "DL123")
+    assert len(fs) == 1
+    assert "sock.sendall" in fs[0].message
+
+
+def test_dl123_clean_under_setdefaulttimeout():
+    src = """\
+    import socket
+
+    socket.setdefaulttimeout(5.0)
+
+    def pull(addr):
+        sock = socket.socket()
+        sock.connect(addr)
+        return sock.recv(64)
+    """
+    assert _only(_lint(src), "DL123") == []
+
+
+def test_dl123_clean_nonblocking_socket():
+    src = """\
+    import socket
+
+    def pump(addr):
+        sock = socket.socket()
+        sock.setblocking(False)
+        sock.connect(addr)
+    """
+    assert _only(_lint(src), "DL123") == []
+
+
+def test_dl123_tracks_self_attribute_sockets():
+    src = """\
+    import socket
+
+    class Plane:
+        def __init__(self, ep):
+            self._srv = socket.socket()
+            self._srv.bind(ep)
+
+        def loop(self):
+            conn, _ = self._srv.accept()
+            return conn
+    """
+    fs = _only(_lint(src), "DL123")
+    assert len(fs) == 1
+    assert "_srv.accept" in fs[0].message

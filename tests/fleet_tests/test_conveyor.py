@@ -180,3 +180,73 @@ def test_close_is_idempotent_and_engines_still_step():
     fleet.close()
     fleet.close()
     assert fleet.step() is False       # drained fleet: nothing advances
+
+
+# ---------------------------------------------------------------------------
+# Streamed (format-5) handoffs over the conveyor
+# ---------------------------------------------------------------------------
+
+from chainermn_tpu.fleet.handoff import streamed_chunk_sid
+from chainermn_tpu.fleet.pools import StreamAssembler
+from chainermn_tpu.fleet.transport import Arrival
+
+
+def test_stream_assembler_orders_chunks_and_keeps_defects():
+    asm = StreamAssembler()
+    asm.add_chunk(Arrival(streamed_chunk_sid(7, 1), {"index": 1}, b"B"))
+    asm.add_chunk(Arrival(streamed_chunk_sid(7, 0), {"index": 0}, b"A"))
+    asm.add_chunk(Arrival(streamed_chunk_sid(7, 2), None, None,
+                          defects=("sha256 mismatch",)))
+    asm.add_chunk(Arrival(streamed_chunk_sid(8, 0), {"index": 0}, b"X"))
+    chunks, notes = asm.take(7)
+    assert [b for _m, b in chunks] == [b"A", b"B"]   # index order
+    assert notes == ["chunk 2: sha256 mismatch"]     # the WHY survives
+    assert asm.take(7) == ([], [])                   # take drains
+    chunks8, notes8 = asm.take(8)                    # stream 8 untouched
+    assert [b for _m, b in chunks8] == [b"X"] and notes8 == []
+
+
+@pytest.mark.parametrize("asynchronous", [False, True])
+def test_streamed_conveyor_is_bitwise(asynchronous):
+    fleet = DisaggregatedFleet(
+        FakeEngine(2), FakeEngine(2),
+        transport=InProcessTransport(wire_delay_ms=1.0),
+        streamed=True, async_conveyor=asynchronous,
+        max_pending=2)
+    _check_bitwise(_run(fleet))
+    assert fleet.stats["transfers"] == len(PROMPTS)
+    assert not any(s.fell_back for s in fleet.streams)
+
+
+def test_streamed_corrupt_chunk_falls_back_with_defect_history(monkeypatch):
+    """Persistent chunk corruption exhausts the per-chunk NACK budget;
+    the stream's fallback must carry the per-frame defect history —
+    WHICH chunk died and WHY — not just that delivery failed."""
+    monkeypatch.setenv(chaos.ENV_VAR, "corrupt_handoff@offset=8")
+    fleet = DisaggregatedFleet(
+        FakeEngine(2), FakeEngine(2),
+        transport=InProcessTransport(), streamed=True)
+    streams = _run(fleet)
+    _check_bitwise(streams)            # clean re-prefill still matches
+    assert all(s.fell_back for s in streams)
+    for s in streams:
+        assert s.fallback_reason and "chunk 0" in s.fallback_reason, \
+            s.fallback_reason
+        assert "sha" in s.fallback_reason or "byte" in s.fallback_reason
+
+
+def test_streamed_corrupt_once_resends_only_that_chunk(monkeypatch):
+    """The acceptance bar: ONE corrupt chunk frame costs one chunk
+    NACK + one re-send — the stream still adopts (no fallback) and the
+    counters prove the damage stayed chunk-sized."""
+    monkeypatch.setenv(chaos.ENV_VAR, "corrupt_handoff@offset=8,times=1")
+    fleet = DisaggregatedFleet(
+        FakeEngine(2), FakeEngine(2),
+        transport=InProcessTransport(), streamed=True)
+    streams = _run(fleet)
+    _check_bitwise(streams)
+    assert not any(s.fell_back for s in streams)
+    t = fleet.transports[0]
+    assert t.receiver_stats["chunk_nacked"] == 1
+    # exactly one extra delivery attempt: the re-send of the one chunk
+    assert t.stats["attempts"] == t.stats["sent"] + 1

@@ -200,3 +200,106 @@ def test_sampled_handoff_preserves_key_and_knobs():
     # the key must NOT be the fresh request key — a split was consumed
     fresh = np.asarray(request_key(3))
     assert not np.array_equal(out["key"], fresh)
+
+
+# ---------------------------------------------------------------------------
+# Streamed (format-5) handoffs: per-layer chunk frames + closing manifest
+# ---------------------------------------------------------------------------
+
+from chainermn_tpu.fleet.handoff import (CHUNKS_PER_STREAM,
+                                         decode_handoff_streamed,
+                                         encode_handoff_streamed,
+                                         streamed_chunk_sid,
+                                         streamed_parent_sid,
+                                         streamed_wire_bytes)
+
+
+def _multi_handoff(n_blocks=3, seed=7):
+    """A handcrafted multi-block handoff: the streamed codec is pure
+    bytes-in/bytes-out, so it needs page arrays, not a live engine."""
+    rng = np.random.RandomState(seed)
+    pages = {f"block{i}": {
+        "k": rng.rand(8, 2, 4).astype(np.float32),
+        "v": rng.rand(8, 2, 4).astype(np.float32)} for i in range(n_blocks)}
+    return {"pages": pages, "cursor": 8, "tokens": [1, 2],
+            "key": np.asarray([3, 4], np.uint32), "prompt_len": 8,
+            "eos_id": None, "temperature": None, "top_k": None, "seed": 0}
+
+
+def test_streamed_chunk_sid_roundtrips_and_bounds():
+    assert streamed_parent_sid(streamed_chunk_sid(17, 3)) == (17, 3)
+    assert streamed_chunk_sid(0, 0) == -1          # negative: no client sid
+    with pytest.raises(ValueError):
+        streamed_chunk_sid(1, CHUNKS_PER_STREAM)
+    with pytest.raises(ValueError):
+        streamed_parent_sid(5)
+
+
+def test_streamed_roundtrip_is_bitwise_one_chunk_per_block():
+    handoff = _multi_handoff()
+    chunks, closing, closing_blob = encode_handoff_streamed(handoff, "f32")
+    assert len(chunks) == 3 and closing["kind"] == "closing"
+    out = decode_handoff_streamed(closing, closing_blob, chunks)
+    for block in handoff["pages"]:
+        for leaf in ("k", "v"):
+            np.testing.assert_array_equal(out["pages"][block][leaf],
+                                          handoff["pages"][block][leaf])
+    assert out["tokens"] == handoff["tokens"]
+    np.testing.assert_array_equal(out["key"], handoff["key"])
+
+
+def test_streamed_wire_bytes_equal_monolithic_blob():
+    """Chunking must not inflate the priced payload: the sum of chunk
+    bytes plus the closing blob equals the monolithic format-1 blob."""
+    handoff = _multi_handoff()
+    _manifest, blob = encode_handoff(handoff, "f32")
+    _chunks, closing, _cb = encode_handoff_streamed(handoff, "f32")
+    assert streamed_wire_bytes(closing) == len(blob)
+
+
+def test_streamed_int8_roundtrip_error_bounded():
+    handoff = _multi_handoff()
+    chunks, closing, closing_blob = encode_handoff_streamed(
+        handoff, "int8-block")
+    out = decode_handoff_streamed(closing, closing_blob, chunks)
+    for block in handoff["pages"]:
+        for leaf in ("k", "v"):
+            ref = handoff["pages"][block][leaf]
+            step = np.abs(ref).max() / 127.0
+            assert np.abs(out["pages"][block][leaf] - ref).max() \
+                <= step + 1e-7
+
+
+def test_streamed_corrupt_chunk_refused_naming_the_chunk():
+    handoff = _multi_handoff()
+    chunks, closing, closing_blob = encode_handoff_streamed(handoff, "f32")
+    man, blob = chunks[1]
+    chunks[1] = (man, blob[:10] + bytes([blob[10] ^ 0xFF]) + blob[11:])
+    with pytest.raises(HandoffError, match="chunk 1"):
+        decode_handoff_streamed(closing, closing_blob, chunks)
+
+
+def test_streamed_missing_chunk_refused():
+    handoff = _multi_handoff()
+    chunks, closing, closing_blob = encode_handoff_streamed(handoff, "f32")
+    with pytest.raises(HandoffError, match="incomplete stream"):
+        decode_handoff_streamed(closing, closing_blob, chunks[:-1])
+
+
+def test_streamed_chunk_swapped_from_another_stream_refused():
+    """A chunk with a VALID self-manifest lifted from a different
+    handoff still fails the closing table's commitment — completeness
+    is proven against the table, not per-frame checks."""
+    chunks, closing, closing_blob = encode_handoff_streamed(
+        _multi_handoff(seed=7), "f32")
+    other, _c2, _b2 = encode_handoff_streamed(_multi_handoff(seed=8), "f32")
+    chunks[0] = other[0]
+    with pytest.raises(HandoffError, match="chunk 0"):
+        decode_handoff_streamed(closing, closing_blob, chunks)
+
+
+def test_streamed_refuses_session_exports():
+    handoff = _multi_handoff()
+    handoff["max_new_tokens"] = 5      # session migration: whole or not at all
+    with pytest.raises(ValueError, match="migrate whole"):
+        encode_handoff_streamed(handoff, "f32")

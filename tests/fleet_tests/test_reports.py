@@ -100,6 +100,10 @@ def test_counters_and_summary_shape():
     fr.record_migration("f32", 800)
     fr.record_migration("f32", 800)
     fr.record_migration_fallback()
+    fr.record_transport(sender_stats={"sent": 3, "attempts": 5},
+                        receiver_stats={"duplicates": 2,
+                                        "chunk_nacked": 1},
+                        plane_stats={"reconnects": 4})
     ra = _report([0.001], tokens=5, host_bytes=20, span_s=1.0)
     out = fr.summary([ra])
     assert out["fleet"] == {
@@ -109,6 +113,8 @@ def test_counters_and_summary_shape():
         "handoff_wire_bytes": {"f32": 1000, "int8-block": 520},
         "migrations": 2, "migration_fallbacks": 1,
         "migration_wire_bytes": {"f32": 1600},
+        "transport": {"retransmits": 2, "reconnects": 4,
+                      "dup_fenced": 2, "chunk_nacks": 1},
     }
     assert out["replicas"] == 1
     assert np.isfinite(out["tokens_per_s"])

@@ -401,3 +401,83 @@ def test_transport_gc_fence_survives_reborn_sender_after_prune(tmp_path):
         stop.set()
         th.join()
     assert len(arrivals) == 1          # the replay never re-surfaced
+
+
+# ---------------------------------------------------------------------------
+# Satellite hardening: double-resolve and GC racing a reborn receiver
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_called_twice_still_fences(tmp_path):
+    """resolve() is idempotent: the deadline fallback and a late
+    supervisor retry may both fence the same stream, and the second
+    call must neither error nor un-fence — a frame arriving after
+    either call still answers ``duplicate``."""
+    (manifest, blob), _ = _fake_handoff()
+    sender = ObjectPlaneTransport(FsObjectPlane(str(tmp_path), 0, 2),
+                                  peer=1, pol=_FAST)
+    receiver = ObjectPlaneTransport(FsObjectPlane(str(tmp_path), 1, 2),
+                                    peer=0, pol=_FAST)
+    receiver.resolve(9)
+    receiver.resolve(9)                # second call: no-op, no error
+    stop, th, arrivals = _with_receiver(receiver)
+    try:
+        assert sender.send(9, manifest, blob) == "duplicate"
+    finally:
+        stop.set()
+        th.join()
+    assert arrivals == []              # fenced frame never surfaced
+
+
+def test_inprocess_resolve_twice_is_idempotent():
+    (manifest, blob), _ = _fake_handoff()
+    t = InProcessTransport()
+    t.resolve(5)
+    t.resolve(5)
+    assert t.send(5, manifest, blob) == "duplicate"
+    assert t.poll() == []
+
+
+def test_fs_plane_gc_racing_reborn_receiver_seeds_lazily(tmp_path):
+    """A reborn receiver CONSTRUCTED before the dying incarnation's gc
+    commits must still land past the prune: the reader position seeds
+    lazily from the HWM at FIRST ACCESS, not at __init__ — otherwise
+    this interleaving (rebirth, then a straggler gc from the old
+    incarnation) waits forever on frames that no longer exist."""
+    a = FsObjectPlane(str(tmp_path), 0, 2)
+    b = FsObjectPlane(str(tmp_path), 1, 2)
+    a.send_obj({"n": 1}, 1, tag=4)  # dlint: disable=DL102
+    a.send_obj({"n": 2}, 1, tag=4)  # absorbed by the line above
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 1
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 2
+    reborn = FsObjectPlane(str(tmp_path), 1, 2)   # born BEFORE the gc
+    assert b.gc(0, tag=4) == 2                    # straggler gc lands
+    a.send_obj({"n": 3}, 1, tag=4)  # dlint: disable=DL102
+    # first channel access AFTER the prune: seeds from HWM=2, not 0
+    assert reborn.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 3
+
+
+def test_fs_plane_gc_concurrent_with_inflight_recv(tmp_path):
+    """gc never unlinks seq >= position, so a receive in flight on the
+    unread slot survives any number of concurrent gc passes — the
+    frame lands mid-race and is delivered, not re-deleted."""
+    a = FsObjectPlane(str(tmp_path), 0, 2)
+    b = FsObjectPlane(str(tmp_path), 1, 2)
+    a.send_obj({"n": 1}, 1, tag=4)  # dlint: disable=DL102
+    assert b.try_recv_obj(0, tag=4, timeout_ms=500)["n"] == 1
+    got = []
+
+    def _recv():
+        got.append(b.try_recv_obj(0, tag=4, timeout_ms=5000))
+
+    th = threading.Thread(target=_recv, daemon=True)
+    th.start()                         # polls the empty seq-1 slot
+    for _ in range(20):                # gc storms while the poll spins
+        b.gc(0, tag=4)
+        time.sleep(0.002)
+    a.send_obj({"n": 2}, 1, tag=4)  # dlint: disable=DL102
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert got and got[0]["n"] == 2
+    b.gc(0, tag=4)                     # and the consumed frame prunes
+    assert _objs(b._chan_dir(0, 1, 4)) == []

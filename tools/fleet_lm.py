@@ -17,17 +17,28 @@ serve_lm.py), queues a deterministic batch of prompts, and drains:
   KVHandoff wire (``--wire-format`` f32 | int8-block) → decode engine,
   exposed to ``corrupt_handoff`` faults (fallback = clean re-prefill).
   Add ``--async-conveyor`` to overlap the wire with decode steps.
-* ``--hosts N --host-rank R --plane-dir D`` — REAL cross-process
-  disaggregation: rank 0 prefills and ships seq/SHA-framed handoffs
-  over the restart-tolerant ``FsObjectPlane`` wire
-  (``fleet.ObjectPlaneTransport``); ranks 1..N-1 adopt and decode.
-  Wire-level chaos (``drop_handoff``/``delay_handoff``/``dup_handoff``/
-  ``corrupt_handoff``) tears at the frames in flight; ``kill@step=``
-  SIGKILLs the prefill process mid-transfer — under
-  ``resilience.Supervisor`` the restarted incarnation re-prefills
-  every unfinished stream and the receivers' fences answer already-
-  adopted replays with duplicate acks (zero dropped or duplicated
-  tokens).
+* ``--hosts N --host-rank R`` — REAL cross-process disaggregation:
+  ranks 0..P-1 (``--prefill-hosts P``, default 1) prefill and ship
+  seq/SHA-framed handoffs; ranks P..N-1 adopt and decode. The wire is
+  picked by ``--transport``: ``fs`` (default) is the restart-tolerant
+  on-disk ``FsObjectPlane`` under ``--plane-dir``; ``socket`` is the
+  TCP ``comm.socket_plane.SocketObjectPlane`` over the ``--endpoints``
+  host:port list (one per rank). Destination choice is m×n: each
+  prefill host ships every ready handoff to the least-loaded decode
+  host that is not currently suspect (its last send failed — the
+  saturated-survivor precheck), announcing ownership first with an
+  ``{"kind": "expect", "sid": i}`` control frame on tag 7003 and
+  closing its run with one ``{"kind": "eof"}`` per decode host.
+  ``--streamed`` ships each handoff as format-5 per-layer chunk
+  frames + a closing manifest — a corrupt chunk NACKs and re-sends
+  alone. Wire-level chaos (``drop_handoff``/``delay_handoff``/
+  ``dup_handoff``/``corrupt_handoff``, plus the socket-level
+  ``reset_conn``/``partial_write``/``stall_accept``) tears at the
+  frames in flight; ``kill@step=`` SIGKILLs a prefill process
+  mid-transfer — under ``resilience.Supervisor`` the restarted
+  incarnation re-prefills every unfinished stream and the receivers'
+  fences answer already-adopted replays with duplicate acks (zero
+  dropped or duplicated tokens).
 
 Completed streams append to ``--out`` idempotently (request ids already
 on disk are skipped), so a supervised restart heals to the same final
@@ -88,9 +99,14 @@ def _done_ids(path):
     return done
 
 
-def _emit(out, i, prompt, tokens):
-    out.write(json.dumps({"request_id": i, "prompt": prompt.tolist(),
-                          "tokens": list(tokens)}) + "\n")
+def _emit(out, i, prompt, tokens, reason=None):
+    rec = {"request_id": i, "prompt": prompt.tolist(),
+           "tokens": list(tokens)}
+    if reason is not None:
+        # the stream fell back to a clean re-prefill; say WHY — the
+        # per-frame defect history the transport attached to the failure
+        rec["fallback_reason"] = reason
+    out.write(json.dumps(rec) + "\n")
     out.flush()
     os.fsync(out.fileno())
 
@@ -196,7 +212,8 @@ def serve(args):
         fleet = DisaggregatedFleet(engine(), engine(),
                                    wire_format=args.wire_format,
                                    report=report,
-                                   async_conveyor=args.async_conveyor)
+                                   async_conveyor=args.async_conveyor,
+                                   streamed=args.streamed)
         streams = {i: fleet.submit(p, seed=args.seed + i, **kw)
                    for i, p in emit_order(prompts)}
         with open(args.out, "a") as out:
@@ -210,7 +227,8 @@ def serve(args):
                 for i, s in streams.items():
                     if s.finished and i not in emitted:
                         emitted.add(i)
-                        _emit(out, i, prompts[i], s.tokens)
+                        _emit(out, i, prompts[i], s.tokens,
+                              reason=s.fallback_reason)
         fleet.close()
         summary = fleet.summary()
     else:
@@ -248,24 +266,84 @@ def serve(args):
     return None
 
 
-def serve_hosts(args):
-    """One host of a REAL cross-process disaggregated fleet.
+#: control channel for the dynamic-ownership protocol (``--hosts``):
+#: a prefill host announces ``{"kind": "expect", "sid": i}`` to the
+#: decode host it picked BEFORE shipping data frames, and sends one
+#: ``{"kind": "eof"}`` per decode host when its batch is drained.
+CTRL_TAG = 7003
 
-    Rank 0 prefills every pending stream and ships handoffs to their
-    owner decode hosts (stream ``i`` belongs to rank ``1 + i % (N-1)``)
-    over ``ObjectPlaneTransport`` frames on the ``FsObjectPlane`` wire
-    — the file-backed plane, because the jax.distributed coordinator
-    cannot re-admit a SIGKILLed rank and the whole point of this mode
-    is surviving exactly that under the supervisor. Decode hosts adopt
+
+def _parse_endpoints(spec, n):
+    """``host:port,host:port,...`` — one endpoint per rank. A bare
+    ``:port`` binds/dials 127.0.0.1."""
+    eps = []
+    for part in spec.split(","):
+        host, _, port = part.strip().rpartition(":")
+        try:
+            eps.append((host or "127.0.0.1", int(port)))
+        except ValueError:
+            raise SystemExit(f"bad --endpoints entry {part!r} "
+                             "(want host:port)")
+    if len(eps) != n:
+        raise SystemExit(f"--endpoints names {len(eps)} endpoints "
+                         f"for --hosts {n}")
+    return eps
+
+
+def _make_plane(args, rank, n):
+    """The object-plane wire for ``--hosts`` mode: file-backed (``fs``,
+    restart-tolerant by construction) or real TCP (``socket``, restart
+    fencing via incarnation handshake + seq HWM)."""
+    if args.transport == "socket":
+        if not args.endpoints:
+            raise SystemExit("--transport socket needs --endpoints")
+        from chainermn_tpu.comm.socket_plane import SocketObjectPlane
+        return SocketObjectPlane(_parse_endpoints(args.endpoints, n),
+                                 rank)
+    if not args.plane_dir:
+        raise SystemExit("--hosts needs --plane-dir (the shared wire)")
+    from chainermn_tpu.comm.object_plane import FsObjectPlane
+    return FsObjectPlane(args.plane_dir, rank, n)
+
+
+def serve_hosts(args):
+    """One host of a REAL cross-process disaggregated fleet (m×n).
+
+    Ranks 0..P-1 prefill; ranks P..N-1 decode. Any prefill host can
+    feed any decode host: each ready handoff goes to the decode host
+    with the fewest streams shipped to it so far, skipping hosts whose
+    last send failed until they deliver again (the saturated-survivor
+    precheck). Ownership is announced with an ``expect`` control frame
+    on :data:`CTRL_TAG` before the data frames fly, so the receiving
+    host can build the stream and start its arrival deadline; an
+    ``eof`` per prefill rank closes the protocol. Decode hosts adopt
     (or, past ``--handoff-deadline-s``, fence + fall back to a clean
     re-prefill from seed) and append finished streams to their own
-    per-incarnation part file.
+    per-incarnation part file. With ``--streamed`` the data frames are
+    format-5 per-layer chunks + a closing manifest, reassembled by
+    ``StreamAssembler`` — a chunk that misses its delivery budget
+    fails assembly and re-prefills cleanly.
+
+    The ``fs`` wire survives a SIGKILLed rank by construction (the
+    jax.distributed coordinator cannot re-admit one — the whole point
+    of this mode is surviving exactly that under the supervisor); the
+    ``socket`` wire survives it via the reborn peer's incarnation
+    handshake. After a prefill restart, a re-announced stream may pick
+    a DIFFERENT decode host than the dead incarnation did; with one
+    decode host (the drill topology) that is moot, with several the
+    seeded replay keeps every emission bitwise and ``_done_ids``'s
+    merge keeps the final JSONL idempotent.
     """
-    from chainermn_tpu.comm.object_plane import FsObjectPlane
     from chainermn_tpu.fleet import FleetReport
-    from chainermn_tpu.fleet.handoff import (HandoffError, decode_handoff,
-                                             encode_handoff)
-    from chainermn_tpu.fleet.pools import DecodePool, PrefillPool, Stream
+    from chainermn_tpu.fleet.handoff import (HANDOFF_FORMAT_STREAMED,
+                                             HandoffError, decode_handoff,
+                                             decode_handoff_streamed,
+                                             encode_handoff,
+                                             encode_handoff_streamed,
+                                             streamed_chunk_sid,
+                                             streamed_wire_bytes)
+    from chainermn_tpu.fleet.pools import (DecodePool, PrefillPool,
+                                           Stream, StreamAssembler)
     from chainermn_tpu.fleet.transport import ObjectPlaneTransport
     from chainermn_tpu.resilience import chaos
     from chainermn_tpu.resilience.supervisor import restart_count
@@ -275,25 +353,44 @@ def serve_hosts(args):
     if not (0 <= args.host_rank < args.hosts):
         raise SystemExit(f"--host-rank {args.host_rank} outside "
                          f"[0, {args.hosts})")
-    if not args.plane_dir:
-        raise SystemExit("--hosts needs --plane-dir (the shared wire)")
-    rank, n = args.host_rank, args.hosts
-    plane = FsObjectPlane(args.plane_dir, rank, n)
+    rank, n, P = args.host_rank, args.hosts, args.prefill_hosts
+    if not (1 <= P < n):
+        raise SystemExit(f"--prefill-hosts {P} outside [1, {n})")
+    plane = _make_plane(args, rank, n)
     engine = _engine_factory(args)()
     prompts = _pending_prompts(args)
     report = FleetReport()
     drain = _drain_flag()              # SIGUSR1: finish in flight, exit 0
-    owner = lambda i: 1 + (i % (n - 1))  # noqa: E731 — one-line mapping
     kw = dict(temperature=args.temperature, top_k=args.top_k)
     budget_s = args.handoff_deadline_s + 120.0   # hard stop for any loop
+    decode_ranks = list(range(P, n))
 
-    if rank == 0:
+    def _ship(transport, sid, handoff):
+        """Encode + send one handoff; returns the terminal status (the
+        closing frame's, in streamed mode — a chunk that exhausts its
+        budget is caught by the receiver's assembly check instead)."""
+        if not args.streamed:
+            manifest, blob = encode_handoff(handoff, args.wire_format)
+            report.record_handoff(args.wire_format, len(blob))
+            return transport.send(sid, manifest, blob)
+        chunks, closing, closing_blob = encode_handoff_streamed(
+            handoff, args.wire_format)
+        report.record_handoff(args.wire_format,
+                              streamed_wire_bytes(closing))
+        for ci, (man, blob) in enumerate(chunks):
+            transport.send(streamed_chunk_sid(sid, ci), man, blob)
+        return transport.send(sid, closing, closing_blob)
+
+    if rank < P:
         pool = PrefillPool(engine)
         transports = {r: ObjectPlaneTransport(plane, peer=r)
-                      for r in range(1, n)}
-        for i, p in emit_order(prompts):
+                      for r in decode_ranks}
+        mine = {i: p for i, p in prompts.items() if i % P == rank}
+        for i, p in emit_order(mine):
             pool.submit(Stream(i, p, args.max_new_tokens,
                                dict(kw, seed=args.seed + i)))
+        shipped = {r: 0 for r in decode_ranks}
+        suspect = set()                # last send failed: prefer others
         deadline = time.monotonic() + budget_s
         it = 0
         while not engine.idle() or engine.held:
@@ -310,75 +407,140 @@ def serve_hosts(args):
             # host (np.asarray) — that IS the per-iteration sync
             pool.step()  # dlint: disable=DL104
             for stream, req in pool.ready():
-                handoff = pool.export(req)
-                manifest, blob = encode_handoff(handoff, args.wire_format)
-                report.record_handoff(args.wire_format, len(blob))
-                status = transports[owner(stream.stream_id)].send(
-                    stream.stream_id, manifest, blob)
+                sid = stream.stream_id
+                dest = min(decode_ranks,
+                           key=lambda r: (r in suspect, shipped[r], r))
+                plane.send_obj({"kind": "expect", "sid": sid}, dest,
+                               tag=CTRL_TAG)
+                status = _ship(transports[dest], sid, pool.export(req))
+                shipped[dest] += 1
                 if status == "failed":
                     report.record_fallback()
+                    suspect.add(dest)
+                    why = transports[dest].last_send_defects
+                    _log(f"handoff stream={sid} -> h{dest}: failed "
+                         f"({'; '.join(why) or 'no defect history'})")
+                else:
+                    suspect.discard(dest)
+                    _log(f"handoff stream={sid} -> h{dest}: {status}")
                 pool.release(req, aborted=(status == "failed"))
-                _log(f"handoff stream={stream.stream_id} -> "
-                     f"h{owner(stream.stream_id)}: {status}")
+        for r in decode_ranks:
+            plane.send_obj({"kind": "eof"}, r, tag=CTRL_TAG)
+        for t in transports.values():
+            report.record_transport(sender_stats=t.stats)
+        report.record_transport(plane_stats=getattr(plane, "stats", {}))
         summary = report.summary([engine.report])
     else:
         pool = DecodePool(engine)
-        transport = ObjectPlaneTransport(plane, peer=0)
-        owned = {i: p for i, p in prompts.items() if owner(i) == rank}
-        streams = {i: Stream(i, p, args.max_new_tokens,
-                             dict(kw, seed=args.seed + i))
-                   for i, p in owned.items()}
+        transports = {r: ObjectPlaneTransport(plane, peer=r)
+                      for r in range(P)}
+        asm = StreamAssembler()
+        streams = {}                   # sid → Stream (built on expect)
+        src_of = {}                    # sid → announcing prefill rank
+        expected, placed, emitted, eofs = set(), set(), set(), set()
+        backlog = []
         part = f"{args.out}.h{rank}.r{restart_count()}"
         arrive_by = time.monotonic() + args.handoff_deadline_s
         deadline = time.monotonic() + budget_s
-        placed, emitted, backlog = set(), set(), []
+
+        def _fallback(sid, reason):
+            report.record_fallback()
+            pool.fallback(streams[sid], reason)
+            placed.add(sid)
+
         with open(part, "a") as out:
-            while len(emitted) < len(owned):
+            while len(eofs) < P or len(emitted) < len(expected):
                 if drain.pop("requested", None):
                     _log("SIGUSR1: drain — finishing in-flight decodes")
                 if time.monotonic() > deadline:
                     raise RuntimeError(
                         f"decode host {rank} failed to drain within "
-                        f"{budget_s}s ({len(emitted)}/{len(owned)})")
-                backlog.extend(transport.poll(timeout_ms=20))
+                        f"{budget_s}s ({len(emitted)}/{len(expected)} "
+                        f"expected, eof {len(eofs)}/{P})")
+                for pr in range(P):
+                    while True:
+                        try:
+                            msg = plane.try_recv_obj(pr, tag=CTRL_TAG,
+                                                     timeout_ms=1)
+                        except TimeoutError:
+                            break
+                        if msg.get("kind") == "eof":
+                            eofs.add(pr)
+                        elif msg.get("kind") == "expect":
+                            sid = int(msg["sid"])
+                            if sid in expected or sid not in prompts:
+                                continue   # replay of a drained stream
+                            expected.add(sid)
+                            src_of[sid] = pr
+                            streams[sid] = Stream(
+                                sid, prompts[sid], args.max_new_tokens,
+                                dict(kw, seed=args.seed + sid))
+                for t in transports.values():
+                    backlog.extend(t.poll(timeout_ms=10))
                 still = []
                 for arr in backlog:
-                    s = streams.get(arr.stream_id)
-                    if s is None or arr.stream_id in placed:
+                    if arr.stream_id < 0:
+                        asm.add_chunk(arr)     # format-5 chunk frame
+                        continue
+                    sid = arr.stream_id
+                    if sid in placed:
+                        continue
+                    if sid not in streams:
+                        # data outran its expect frame (separate
+                        # channel): hold until the announcement lands
+                        still.append(arr)
                         continue
                     if arr.failed:
-                        report.record_fallback()
-                        pool.fallback(s)
-                    elif pool.has_room():
-                        try:
-                            pool.place(s, decode_handoff(arr.manifest,
-                                                         arr.blob))
-                        except HandoffError:
-                            report.record_fallback()
-                            pool.fallback(s)
-                    else:
+                        _, notes = asm.take(sid)
+                        why = "; ".join(arr.defects) or "delivery failed"
+                        if notes:
+                            why += " [" + "; ".join(notes) + "]"
+                        _fallback(sid, why)
+                        continue
+                    if not pool.has_room():
                         still.append(arr)   # adopted frame waits for room
                         continue
-                    placed.add(arr.stream_id)
+                    notes = []
+                    try:
+                        man = arr.manifest
+                        if (isinstance(man, dict) and man.get("format")
+                                == HANDOFF_FORMAT_STREAMED):
+                            chunks, notes = asm.take(sid)
+                            handoff = decode_handoff_streamed(
+                                man, arr.blob, chunks)
+                        else:
+                            handoff = decode_handoff(man, arr.blob)
+                        pool.place(streams[sid], handoff)
+                        placed.add(sid)
+                    except HandoffError as e:
+                        # attach the per-chunk defect history: the
+                        # fallback log says WHY the wire failed
+                        why = str(e)
+                        if notes:
+                            why += " [" + "; ".join(notes) + "]"
+                        _fallback(sid, why)
                 backlog = still
                 if time.monotonic() > arrive_by:
-                    for i in sorted(set(owned) - placed):
+                    for sid in sorted(expected - placed):
                         # never arrived: fence the stream (a late frame
                         # now acks duplicate) and re-prefill from seed
-                        transport.resolve(i)
-                        report.record_fallback()
-                        pool.fallback(streams[i])
-                        placed.add(i)
-                        _log(f"stream {i} missed the handoff deadline; "
-                             f"fenced + re-prefilled")
-                # each engine step syncs internally (int32 token pulls)
-                pool.step()  # dlint: disable=DL104
-                for i, s in streams.items():
-                    if s.finished and i not in emitted:
-                        emitted.add(i)
-                        _emit(out, i, owned[i], s.tokens)
+                        transports[src_of[sid]].resolve(sid)
+                        _fallback(sid, "missed the handoff deadline")
+                        _log(f"stream {sid} missed the handoff "
+                             f"deadline; fenced + re-prefilled")
+                pool.step()
+                for sid, s in streams.items():
+                    if s.finished and sid not in emitted:
+                        emitted.add(sid)
+                        _emit(out, sid, prompts[sid], s.tokens,
+                              reason=s.fallback_reason)
+        for t in transports.values():
+            report.record_transport(receiver_stats=t.receiver_stats)
+        report.record_transport(plane_stats=getattr(plane, "stats", {}))
         summary = report.summary([engine.report])
 
+    if hasattr(plane, "close"):
+        plane.close()
     _log(f"host {rank} drained; report: "
          f"{json.dumps(summary, sort_keys=True)}")
     if args.report:
@@ -415,15 +577,32 @@ def main(argv=None):
     ap.add_argument("--async-conveyor", action="store_true",
                     help="overlap handoff transfer with decode steps "
                          "(disaggregated mode, bounded worker queue)")
+    ap.add_argument("--streamed", action="store_true",
+                    help="ship handoffs as format-5 per-layer chunk "
+                         "frames + a closing manifest (per-chunk "
+                         "SHA/NACK/re-send granularity)")
     ap.add_argument("--hosts", type=int, default=0,
                     help="cross-PROCESS disaggregation over N hosts "
                          "(this process is one of them; see --host-rank)")
     ap.add_argument("--host-rank", type=int, default=0,
                     help="this process's rank in --hosts mode "
-                         "(0 = prefill host, 1..N-1 = decode hosts)")
+                         "(0..P-1 = prefill hosts, P..N-1 = decode "
+                         "hosts; see --prefill-hosts)")
+    ap.add_argument("--prefill-hosts", type=int, default=1,
+                    help="P prefill ranks in --hosts mode: any prefill "
+                         "host feeds any decode host (least-outstanding "
+                         "destination choice)")
+    ap.add_argument("--transport", default="fs",
+                    choices=["fs", "socket"],
+                    help="--hosts wire: 'fs' = on-disk FsObjectPlane "
+                         "under --plane-dir; 'socket' = TCP "
+                         "SocketObjectPlane over --endpoints")
+    ap.add_argument("--endpoints", default=None,
+                    help="comma list of host:port, one per rank "
+                         "(--transport socket)")
     ap.add_argument("--plane-dir", default=None,
                     help="shared directory backing the FsObjectPlane "
-                         "wire (--hosts mode)")
+                         "wire (--hosts mode, --transport fs)")
     ap.add_argument("--handoff-deadline-s", type=float, default=30.0,
                     help="decode-host budget for a stream's handoff to "
                          "arrive before fencing it and re-prefilling "
