@@ -693,6 +693,101 @@ def main():
     except Exception as e:  # never sink the headline metric
         record["migration_gate_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # rolling-update gate (docs/serving.md#rolling-weight-updates),
+    # folded into the same JSON line. Three structural claims: (1) a
+    # 3-replica fleet under live traffic walks v1 → v2 with every
+    # stream finishing bitwise against exactly ONE version's reference
+    # (the skew fence turns would-be mixed streams into whole replays
+    # — zero dropped, zero duplicated); (2) relay wire accounting is
+    # byte-exact and the publisher's egress is exactly one encoded
+    # snapshot regardless of fleet size (each finished receiver
+    # forwards the next hop); (3) a persistently corrupted relay rolls
+    # a second rollout back through the same drain path, and the fleet
+    # ends fully on v2, still serving bitwise.
+    try:
+        from chainermn_tpu.fleet import RolloutController
+        from chainermn_tpu.resilience import chaos as _chaos
+        from chainermn_tpu.serving.weights import encode_weights
+
+        lp2 = lm.init(jax.random.PRNGKey(1),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+
+        def _oracle(params):
+            eng = Engine(lm, params, _fleet_cfg())
+            rr = [eng.submit(p, max_new_tokens=n_new)
+                  for p in fleet_prompts]
+            eng.run_until_drained()
+            return [list(r.tokens) for r in rr]
+
+        ref_v1, ref_v2 = _oracle(lp), _oracle(lp2)
+        can_p = [(list(p), 0, n_new) for p in fleet_prompts[:2]]
+        can_o = ref_v2[:2]
+
+        def _mk(params, version):
+            return Engine(lm, params, _fleet_cfg(),
+                          weights_version=version)
+
+        ref_v3_0 = None                # v3 canary oracle, minted early
+        lp3 = lm.init(jax.random.PRNGKey(2),
+                      jnp.zeros((1, 4), jnp.int32))["params"]
+        ref_v3_0 = _oracle(lp3)[0]
+
+        # single-host drill: canary tracing holds the GIL, so worker
+        # heartbeats starve — give health a compile-sized timeout
+        ro_engines = [_mk(lp, "v1") for _ in range(3)]
+        with Router(ro_engines, health_timeout_ms=300_000) as router:
+            rc = RolloutController(router, _mk, like=lp,
+                                   chunk_bytes=1 << 16)
+            futs = [router.submit(p, max_new_tokens=n_new)
+                    for p in fleet_prompts]
+            rout = rc.rollout(lp2, "v2", canary_prompts=can_p,
+                              canary_oracle=can_o)
+            ro_streams = [list(router.result(f, timeout_ms=120_000)
+                               .tokens) for f in futs]
+            ro_versions = router.summary()["fleet"]["weights_versions"]
+
+            # wire accounting: egress = the one snapshot's frames
+            _man, _data = encode_weights(lp2, weights_version="v2")
+            _chunks, _closing = rc._frames(_man, _data)
+            snap_bytes = (sum(len(b) for _m, b in _chunks)
+                          + len(_closing[1]))
+            wire_exact = (rout["publisher_egress_bytes"] == snap_bytes
+                          and rout["relay_wire_bytes"]
+                          == 3 * snap_bytes)
+
+            # corrupted second rollout → rolled back, still on v2
+            hop_frames = len(_chunks) + 1
+            os.environ[_chaos.ENV_VAR] = (
+                f"corrupt_rollout_chunk@offset=8,after={hop_frames},"
+                "prob=1.0")
+            try:
+                rout2 = RolloutController(
+                    router, _mk, like=lp, chunk_bytes=1 << 16).rollout(
+                        lp3, "v3", canary_prompts=can_p[:1],
+                        canary_oracle=[ref_v3_0])
+            finally:
+                os.environ.pop(_chaos.ENV_VAR, None)
+            ro_versions2 = router.summary()["fleet"]["weights_versions"]
+            fut = router.submit(fleet_prompts[0], max_new_tokens=n_new)
+            after = list(router.result(fut, timeout_ms=120_000).tokens)
+
+        ro_bitwise = all(s in (r1, r2) for s, r1, r2
+                         in zip(ro_streams, ref_v1, ref_v2))
+        record["rollout_status"] = rout["status"]
+        record["rollout_bitwise"] = bool(ro_bitwise)
+        record["rollout_egress_bytes"] = rout["publisher_egress_bytes"]
+        record["rollout_wire_bytes"] = rout["relay_wire_bytes"]
+        record["rollout_wire_exact"] = bool(wire_exact)
+        record["rollout_rollback_status"] = rout2["status"]
+        record["rollout_gate_ok"] = bool(
+            rout["status"] == "completed" and ro_bitwise and wire_exact
+            and all(v == "v2" for v in ro_versions.values())
+            and rout2["status"] == "rolled_back"
+            and all(v == "v2" for v in ro_versions2.values())
+            and after == ref_v2[0])
+    except Exception as e:  # never sink the headline metric
+        record["rollout_gate_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # async checkpoint plane gate
     # (docs/fault_tolerance.md#checkpoint-cadence), folded into the same
     # JSON line: the per-step stall of saving through

@@ -1592,3 +1592,118 @@ def check_socket_without_timeout(tree, src, path) -> List[Finding]:
 
 register(Rule("DL123", "socket-without-timeout", f"{_DOC}#dl123",
               check_socket_without_timeout))
+
+
+# ---------------------------------------------------------------------------
+# DL124 — unverified-weight-load
+# ---------------------------------------------------------------------------
+
+#: calls that deserialize bytes into arrays/objects — the moment a
+#: torn or tampered snapshot becomes live params if nothing checked it
+_DESERIALIZER_CALLS = {"load", "fromfile"}
+
+#: a weight/snapshot-load-shaped function name: it must say WHAT it
+#: loads (weights or a snapshot) and that it LOADS it
+_WEIGHTY = ("weight", "snapshot")
+_LOADY = ("load", "read", "decode", "restore")
+
+
+def _is_verifyish(name: Optional[str]) -> bool:
+    """A callee name that smells like integrity checking.
+
+    ``sha`` only counts on a token boundary (``sha256``, ``_sha``),
+    so ``read_weight_shards`` is still a loader, not a verifier.
+    """
+    if not name:
+        return False
+    low = name.lower()
+    if any(tok in low for tok in ("verify", "digest", "checksum")):
+        return True
+    for part in low.replace(".", "_").split("_"):
+        if part == "sha" or part.startswith(("sha1", "sha2",
+                                             "sha3", "sha5")):
+            return True
+    return False
+
+
+def check_unverified_weight_load(tree, src, path) -> List[Finding]:
+    """A weight/snapshot loader that deserializes without verifying.
+
+    Weights are the one artifact every replica trusts blindly: a torn
+    ``publish_weights`` rename, a corrupt relay chunk, or a stale ring
+    replica that loads unchecked becomes silently wrong LOGITS — no
+    crash, no NaN, just a fleet bitwise-diverging from its oracle. The
+    discipline (``serving/weights.py``): every snapshot travels with a
+    SHA-256 + byte-count manifest, and every loader calls ``_verify``
+    (or checks the digest inline) BEFORE ``np.load`` touches the
+    payload — a failed check falls back to the next candidate or
+    raises ``WeightsError``, it never half-loads.
+
+    Flagged shape: a function whose name says it loads weights or a
+    snapshot (``load``/``read``/``decode``/``restore`` ×
+    ``weight``/``snapshot``) calling ``np.load``/``fromfile`` while
+    neither calling anything verify-ish (``verify``/``sha``/
+    ``digest``/``checksum``) itself nor calling an in-file helper that
+    does (one level of resolution — the ``load_weights`` → ``_verify``
+    shape). One finding per function, at the deserializing call.
+
+    NOT flagged: functions named like verifiers (they ARE the check);
+    deserialization in functions with other names (checkpoint iterators
+    and manifest peeks have their own disciplines — this rule guards
+    the load-weights face specifically, the trade every DL1xx rule
+    makes: catch the shape that burned us, over-approximate nowhere).
+    """
+    # per-function direct-callee sets, for the one-level resolution
+    callees: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names = set()
+            for n in _walk_excluding_defs(node.body):
+                if isinstance(n, ast.Call):
+                    cn = _callee_name(n)
+                    if cn:
+                        names.add(cn)
+            callees.setdefault(node.name, set()).update(names)
+
+    def _verifies(fname: str, depth: int = 1) -> bool:
+        called = callees.get(fname, set())
+        if any(_is_verifyish(c) for c in called):
+            return True
+        if depth > 0:
+            return any(c in callees and _verifies(c, depth - 1)
+                       for c in called)
+        return False
+
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        low = node.name.lower()
+        if _is_verifyish(node.name):
+            continue                    # the function IS the check
+        if not (any(w in low for w in _WEIGHTY)
+                and any(l in low for l in _LOADY)):
+            continue
+        if _verifies(node.name):
+            continue
+        for n in _walk_excluding_defs(node.body):
+            if (isinstance(n, ast.Call)
+                    and _callee_name(n) in _DESERIALIZER_CALLS):
+                findings.append(Finding(
+                    "DL124", path, n.lineno,
+                    f"'{node.name}' deserializes a weight/snapshot "
+                    "payload with no integrity check in sight — a torn "
+                    "publish, a corrupt relay chunk, or a stale replica "
+                    "loads as silently wrong logits, the failure no "
+                    "crash ever reports. Verify the SHA-256 manifest "
+                    "first (serving/weights.py _verify, or "
+                    "decode_weights' inline digest) and fall back or "
+                    "raise WeightsError on mismatch "
+                    f"({_DOC}#dl124)."))
+                break                   # one finding per function
+    findings.sort(key=lambda f: f.line)
+    return findings
+
+
+register(Rule("DL124", "unverified-weight-load", f"{_DOC}#dl124",
+              check_unverified_weight_load))

@@ -37,6 +37,8 @@ from chainermn_tpu.fleet.pools import (DecodePool, DisaggregatedFleet,
                                        PrefillPool, Stream,
                                        StreamAssembler)
 from chainermn_tpu.fleet.reports import FleetReport
+from chainermn_tpu.fleet.rollout import (DEFAULT_CHUNK_BYTES,
+                                         RolloutController, RolloutError)
 from chainermn_tpu.fleet.router import EngineReplica, Router
 from chainermn_tpu.fleet.transport import (Arrival, InProcessTransport,
                                            LoopbackPlane,
@@ -53,6 +55,7 @@ __all__ = [
     "Stream", "PrefillPool", "DecodePool", "DisaggregatedFleet",
     "StreamAssembler",
     "EngineReplica", "Router",
+    "RolloutController", "RolloutError", "DEFAULT_CHUNK_BYTES",
     "TransportError", "Arrival", "InProcessTransport",
     "ObjectPlaneTransport", "LoopbackPlane", "PairedTransport",
 ]

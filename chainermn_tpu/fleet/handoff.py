@@ -77,7 +77,12 @@ _SESSION_FORMATS = (HANDOFF_FORMAT_SESSION_RAW,
 HANDOFF_WIRE_FORMATS = ("f32", "int8-block")
 
 #: meta keys every manifest must carry (decode validates the set);
-#: session formats additionally carry ``max_new_tokens``
+#: session formats additionally carry ``max_new_tokens``. The OPTIONAL
+#: ``weights_version`` meta (all formats 1–5) stamps which published
+#: weights minted the KV rows: importers refuse a mismatch
+#: (``Engine.import_handoff`` → ``WeightsVersionSkew``) so a rolling
+#: update never mixes model versions inside one stream; manifests
+#: without the field (pre-rollout encoders) stay loadable.
 _META_KEYS = ("cursor", "tokens", "prompt_len", "eos_id", "temperature",
               "top_k", "seed")
 
@@ -150,6 +155,8 @@ def encode_handoff(handoff: dict,
             | {"cursor": int(handoff["cursor"])})
     if session:
         meta["max_new_tokens"] = int(handoff["max_new_tokens"])
+    if handoff.get("weights_version") is not None:
+        meta["weights_version"] = str(handoff["weights_version"])
     manifest: Dict[str, Any] = {
         "format": fmt,
         "bytes": len(blob),
@@ -227,6 +234,7 @@ def decode_handoff(manifest: dict, blob: bytes) -> dict:
             "temperature": meta["temperature"],
             "top_k": meta["top_k"],
             "seed": meta["seed"],
+            "weights_version": meta.get("weights_version"),
         }
         if fmt in _SESSION_FORMATS:
             # the remaining-budget meta is what MAKES it a session; a
@@ -330,6 +338,8 @@ def encode_handoff_streamed(
     closing_blob = b"".join(pk.chunks)
     meta = ({k: handoff[k] for k in _META_KEYS if k != "cursor"}
             | {"cursor": int(handoff["cursor"])})
+    if handoff.get("weights_version") is not None:
+        meta["weights_version"] = str(handoff["weights_version"])
     closing: Dict[str, Any] = {
         "format": HANDOFF_FORMAT_STREAMED, "kind": "closing",
         "bytes": len(closing_blob),
@@ -441,6 +451,7 @@ def decode_handoff_streamed(closing_manifest: dict, closing_blob: bytes,
             "temperature": meta["temperature"],
             "top_k": meta["top_k"],
             "seed": meta["seed"],
+            "weights_version": meta.get("weights_version"),
         }
     except HandoffError:
         raise

@@ -50,6 +50,18 @@ class FleetHealth:
         if replica in self._last_beat and replica not in self._dead:
             self._dead[replica] = reason
 
+    def revive(self, replica: int, reason: str = "readmitted") -> None:
+        """Readmit a decommissioned replica (the READMIT leg of a
+        rolling weight update — ``Router.readmit``): the death verdict
+        is withdrawn and the beat clock restarts NOW, so the deadline
+        sweep gives the fresh worker a full ``timeout_ms`` before it can
+        be declared dead again. Only a replica this tracker knows may
+        come back; reviving a live one is a no-op."""
+        if replica not in self._last_beat:
+            raise ValueError(f"unknown replica {replica} ({reason})")
+        self._last_beat[replica] = self._time()
+        self._dead.pop(replica, None)
+
     def check(self) -> List[int]:
         """Deadline sweep: returns replicas NEWLY declared dead (silent
         past ``timeout_ms``). Idempotent per death — a replica is

@@ -68,6 +68,7 @@ from chainermn_tpu.fleet.handoff import (HANDOFF_FORMAT_STREAMED,
                                          streamed_wire_bytes)
 from chainermn_tpu.fleet.reports import FleetReport
 from chainermn_tpu.fleet.transport import InProcessTransport
+from chainermn_tpu.serving.engine import WeightsVersionSkew
 
 __all__ = ["Stream", "PrefillPool", "DecodePool", "DisaggregatedFleet",
            "StreamAssembler"]
@@ -441,11 +442,14 @@ class DisaggregatedFleet:
                 else:
                     handoff = decode_handoff(manifest, arr.blob)
                 pool.place(stream, handoff)
-            except HandoffError as e:
+            except (HandoffError, WeightsVersionSkew) as e:
                 # wire-verified but structurally unusable (format skew,
-                # missing/foreign chunk): same clean-re-prefill answer
-                # as a failed delivery — with the per-chunk defect
-                # history attached, so the log says WHY
+                # missing/foreign chunk) or minted under a DIFFERENT
+                # weights version than the decode engine serves (a
+                # rollout in flight): same clean-re-prefill answer as a
+                # failed delivery — the re-prefilled stream is entirely
+                # the decode engine's version — with the per-chunk
+                # defect history attached, so the log says WHY
                 reason = str(e)
                 if notes:
                     reason += " [" + "; ".join(notes) + "]"

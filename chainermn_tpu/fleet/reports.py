@@ -60,6 +60,11 @@ class FleetReport:
         self.transport_reconnects = 0    # socket-plane redials
         self.transport_dup_fenced = 0    # frames answered `duplicate`
         self.streamed_chunk_nacks = 0    # format-5 chunk-only re-sends
+        # rolling weight updates (fleet/rollout.py)
+        self.rollouts_completed = 0      # fleet fully on the new version
+        self.rollouts_rolled_back = 0    # failed mid-walk → back to v1
+        self.canary_failures = 0         # canary miscompare → abort
+        self.rollout_wire_bytes = 0      # relay bytes shipped (all hops)
 
     # ----------------------------------------------------------------
     # router / pool hooks
@@ -98,6 +103,26 @@ class FleetReport:
         back to the PR 11 replay-from-seed path."""
         self.migration_fallbacks += 1
 
+    def record_rollout_completed(self) -> None:
+        """Every replica serves the new version (rollout SUCCEEDED)."""
+        self.rollouts_completed += 1
+
+    def record_rollout_rolled_back(self) -> None:
+        """A rollout failed mid-walk (persistent relay corruption, a
+        mid-swap death, ...) and every already-swapped replica walked
+        back to v1 through the same drain path."""
+        self.rollouts_rolled_back += 1
+
+    def record_canary_failure(self) -> None:
+        """The canary's bitwise prompt replay miscompared against the
+        v2 oracle — the rollout aborted with zero traffic moved."""
+        self.canary_failures += 1
+
+    def record_rollout_wire(self, nbytes: int) -> None:
+        """Relay bytes shipped for a rollout (chunk payloads, every
+        hop) — the bench gate prices publisher egress against this."""
+        self.rollout_wire_bytes += int(nbytes)
+
     def record_transport(self, sender_stats: dict = (),
                          receiver_stats: dict = (),
                          plane_stats: dict = ()) -> None:
@@ -121,8 +146,9 @@ class FleetReport:
 
     #: bump on any change to the counter schema below
     #: (2: migration/drain counters — PR 17 session migration;
-    #:  3: transport wire-health counters — PR 18 socket plane)
-    WIRE_VERSION = 3
+    #:  3: transport wire-health counters — PR 18 socket plane;
+    #:  4: rolling-update counters — PR 19 versioned rollout)
+    WIRE_VERSION = 4
 
     def to_wire(self) -> dict:
         """Version-tagged JSON-safe envelope of the fleet counters —
@@ -147,6 +173,10 @@ class FleetReport:
                     "transport_reconnects": self.transport_reconnects,
                     "transport_dup_fenced": self.transport_dup_fenced,
                     "streamed_chunk_nacks": self.streamed_chunk_nacks,
+                    "rollouts_completed": self.rollouts_completed,
+                    "rollouts_rolled_back": self.rollouts_rolled_back,
+                    "canary_failures": self.canary_failures,
+                    "rollout_wire_bytes": self.rollout_wire_bytes,
                 }}
 
     @classmethod
@@ -176,6 +206,10 @@ class FleetReport:
         out.transport_reconnects = int(c["transport_reconnects"])
         out.transport_dup_fenced = int(c["transport_dup_fenced"])
         out.streamed_chunk_nacks = int(c["streamed_chunk_nacks"])
+        out.rollouts_completed = int(c["rollouts_completed"])
+        out.rollouts_rolled_back = int(c["rollouts_rolled_back"])
+        out.canary_failures = int(c["canary_failures"])
+        out.rollout_wire_bytes = int(c["rollout_wire_bytes"])
         return out
 
     def absorb(self, other: "FleetReport") -> None:
@@ -200,6 +234,10 @@ class FleetReport:
         self.transport_reconnects += other.transport_reconnects
         self.transport_dup_fenced += other.transport_dup_fenced
         self.streamed_chunk_nacks += other.streamed_chunk_nacks
+        self.rollouts_completed += other.rollouts_completed
+        self.rollouts_rolled_back += other.rollouts_rolled_back
+        self.canary_failures += other.canary_failures
+        self.rollout_wire_bytes += other.rollout_wire_bytes
 
     # ----------------------------------------------------------------
     # aggregation
@@ -270,6 +308,12 @@ class FleetReport:
                 "reconnects": self.transport_reconnects,
                 "dup_fenced": self.transport_dup_fenced,
                 "chunk_nacks": self.streamed_chunk_nacks,
+            },
+            "rollouts": {
+                "completed": self.rollouts_completed,
+                "rolled_back": self.rollouts_rolled_back,
+                "canary_failures": self.canary_failures,
+                "wire_bytes": self.rollout_wire_bytes,
             },
         }
         return out

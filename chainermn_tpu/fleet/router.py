@@ -36,7 +36,11 @@ with:
   destination, a death mid-migration) falls back to the SAME
   replay-from-seed re-queue a death uses, so a failed migration is
   never worse than a death. The drained replica then decommissions
-  cleanly; ``_sweep_dead`` skips it.
+  cleanly; ``_sweep_dead`` skips it. :meth:`Router.readmit` closes the
+  loop — a DRAINED replica (weights swapped by ``fleet/rollout.py``)
+  re-registers with a fresh worker thread and rejoins placement, the
+  READMIT leg of the rolling-update lifecycle CANARY → DRAIN → SWAP →
+  READMIT.
 
 The router's dispatch loop and ``result()`` keep every wait BOUNDED
 (``get_nowait`` + idle sleep, probe-sliced future waits) — dlint DL111
@@ -486,6 +490,32 @@ class Router:
         return {"migrated": migrated, "requeued": requeued,
                 "state": rep.state()}
 
+    def readmit(self, replica_id: int) -> None:
+        """Bring a cleanly DRAINED replica back into service — the
+        READMIT leg of a rolling weight update (``fleet/rollout.py``
+        drains, swaps the verified snapshot in, then readmits). The
+        engine keeps its identity (and its freshly swapped weights); a
+        NEW worker thread wraps it, the health verdict is withdrawn,
+        and placement sees the replica again on the next dispatch pass.
+        A DEAD replica does not readmit — the supervisor restart path
+        owns dirty exits."""
+        rep = self.replicas.get(int(replica_id))
+        if rep is None:
+            raise ValueError(f"unknown replica {replica_id}")
+        if not rep.drained:
+            raise ValueError(
+                f"replica {replica_id} is {rep.state()} — only a "
+                "cleanly DRAINED replica readmits (a DEAD one restarts "
+                "under the supervisor instead)")
+        new = EngineReplica(rep.replica_id, rep.engine, self.health)
+        # start BEFORE publishing: an unstarted worker reads as dead()
+        # to the sweep, and the _handled_dead fence comes off LAST so
+        # no intermediate state can be mistaken for a fresh death
+        new.start()
+        self.replicas[rep.replica_id] = new
+        self.health.revive(rep.replica_id)
+        self._handled_dead.discard(rep.replica_id)
+
     def _pull_inbox(self, rep: EngineReplica) -> List[_FleetItem]:
         """Drain a replica's never-admitted inbox backlog (these items
         have no engine state — re-queueing them is trivially lossless)."""
@@ -676,6 +706,9 @@ class Router:
         out["fleet"]["replica_states"] = states
         out["fleet"]["draining"] = sorted(
             rid for rid, s in states.items() if s == "DRAINING")
+        out["fleet"]["weights_versions"] = {
+            rid: getattr(rep.engine, "weights_version", None)
+            for rid, rep in sorted(self.replicas.items())}
         # live wire-health counters off the migration transport (the
         # FleetReport block carries the fold of FINISHED transports;
         # this one is the router's own, still-running wire)

@@ -182,13 +182,18 @@ class InProcessTransport:
 
     def __init__(self, max_attempts: int = 4,
                  pol: Optional[RpcPolicy] = None,
-                 wire_delay_ms: float = 0.0, backoff: bool = False):
+                 wire_delay_ms: float = 0.0, backoff: bool = False,
+                 chaos_kind: str = "handoff"):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         self.policy = pol or policy()
         self.max_attempts = max_attempts
         self.wire_delay_ms = float(wire_delay_ms)
         self.backoff = backoff
+        #: which chaos wire faults target this transport's traffic —
+        #: "handoff" (corrupt_handoff) or "rollout"
+        #: (corrupt_rollout_chunk); generic drop/delay/dup hit both
+        self.chaos_kind = chaos_kind
         self._lock = threading.Lock()
         self._recv = _ReceiverState(max_attempts)
         self._arrivals: deque = deque()
@@ -213,7 +218,7 @@ class InProcessTransport:
             self.stats["sent"] += 1
         for attempt in range(self.max_attempts):
             self.stats["attempts"] += 1
-            verdict, wire = chaos.on_wire(blob)
+            verdict, wire = chaos.on_wire(blob, kind=self.chaos_kind)
             if self.wire_delay_ms:
                 time.sleep(self.wire_delay_ms / 1000.0)
             if verdict == "drop":
@@ -311,7 +316,8 @@ class ObjectPlaneTransport:
                  max_attempts: int = 4,
                  pol: Optional[RpcPolicy] = None,
                  data_tag: int = HANDOFF_DATA_TAG,
-                 ack_tag: int = HANDOFF_ACK_TAG):
+                 ack_tag: int = HANDOFF_ACK_TAG,
+                 chaos_kind: str = "handoff"):
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         self.plane = plane
@@ -320,6 +326,7 @@ class ObjectPlaneTransport:
         self.max_attempts = max_attempts
         self.data_tag = data_tag
         self.ack_tag = ack_tag
+        self.chaos_kind = chaos_kind     # see InProcessTransport
         self._recv = _ReceiverState(max_attempts)
         self._send_seq = 0
         self._acks: Dict[int, str] = {}     # seq → status (sender side)
@@ -346,7 +353,7 @@ class ObjectPlaneTransport:
                  "manifest": manifest}
         for attempt in range(self.max_attempts):
             self.stats["attempts"] += 1
-            verdict, wire = chaos.on_wire(blob)
+            verdict, wire = chaos.on_wire(blob, kind=self.chaos_kind)
             if verdict != "drop":
                 self.plane.send_obj(dict(frame, blob=wire), self.peer,
                                     tag=self.data_tag)

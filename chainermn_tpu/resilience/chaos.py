@@ -38,7 +38,15 @@ deterministic schedule, so the suite can prove the stack survives them:
   end every case in exact adoption or a clean re-prefill — never a
   poisoned decode slot or a duplicated token. All wire faults accept
   ``times=N`` (fire at most N times) so a drill can damage exactly one
-  delivery attempt and let the re-send heal.
+  delivery attempt and let the re-send heal;
+* ``corrupt_rollout_chunk`` / ``kill_mid_swap`` / ``canary_mismatch``
+  — rolling-weight-update faults (:mod:`chainermn_tpu.fleet.rollout`):
+  a relay chunk is damaged on the wire (per-chunk SHA must NACK and
+  re-send; persistent damage must end in a rollback to v1), a replica
+  dies inside its swap window (classified as a crash; the restart
+  converges to the version its verified local manifest names), and the
+  canary's bitwise prompt replay miscompares (the rollout must abort
+  with zero traffic moved).
 
 Faults can be pinned to one supervised incarnation with ``run=K``: the
 supervisor (:mod:`chainermn_tpu.resilience.supervisor`) exports
@@ -161,6 +169,26 @@ FAULT_KINDS: Dict[str, str] = {
                      "retry with jittered backoff): [ms=M (default "
                      "2000)][,times=N][,after=K][,prob=P][,seed=S]"
                      "[,rank=R|*]"),
+    "corrupt_rollout_chunk": ("damage a weight-rollout relay chunk on "
+                              "the wire (flip 64 bytes at offset, or "
+                              "truncate when keep= is given — the "
+                              "relay's per-chunk SHA must NACK and "
+                              "re-send; when every attempt is damaged "
+                              "the rollout must fail and roll back to "
+                              "v1): [offset=O][,keep=BYTES][,after=K]"
+                              "[,times=N][,prob=P][,seed=S][,rank=R|*]"),
+    "kill_mid_swap": ("kill ONE replica inside its weight-swap window "
+                      "(after drain, before readmit — the rollout "
+                      "controller must classify the death as a crash, "
+                      "skip the replica, and the restart must converge "
+                      "to whichever version its local manifest "
+                      "verifies): [replica=R|*][,times=N][,after=K]"
+                      "[,prob=P][,seed=S][,rank=R|*]"),
+    "canary_mismatch": ("force the rollout canary's bitwise prompt "
+                        "replay to MISCOMPARE (a bad v2 snapshot — the "
+                        "controller must abort with zero traffic "
+                        "moved): [times=N][,after=K][,prob=P][,seed=S]"
+                        "[,rank=R|*]"),
 }
 
 #: every fault kind also accepts ``run=K`` — fire only in supervised
@@ -452,12 +480,13 @@ class ChaosPlan:
         return False
 
     def _damage_handoff(self, f: Fault, data: bytes) -> bytes:
-        """Apply one fired ``corrupt_handoff``: truncate to ``keep``
-        bytes, or XOR-flip 64 bytes at ``offset``."""
+        """Apply one fired corruption fault (``corrupt_handoff`` or
+        ``corrupt_rollout_chunk``): truncate to ``keep`` bytes, or
+        XOR-flip 64 bytes at ``offset``."""
         if f.keep is not None:
-            self.log.append(f"corrupt_handoff keep={f.keep}")
+            self.log.append(f"{f.kind} keep={f.keep}")
             return data[:max(0, f.keep)]
-        self.log.append(f"corrupt_handoff offset={f.offset}")
+        self.log.append(f"{f.kind} offset={f.offset}")
         buf = bytearray(data)
         end = min(len(buf), f.offset + 64)
         for i in range(f.offset, end):
@@ -493,22 +522,35 @@ class ChaosPlan:
             data = self._damage_handoff(f, data)
         return data
 
+    #: on_wire traffic kind → corruption fault that targets it (the
+    #: generic delivery faults drop/delay/dup fire for every kind)
+    _WIRE_CORRUPT = {"handoff": "corrupt_handoff",
+                     "rollout": "corrupt_rollout_chunk"}
+
     def on_wire(self, data: bytes,
-                rank: Optional[int] = None) -> tuple:
+                rank: Optional[int] = None,
+                kind: str = "handoff") -> tuple:
         """Transport wire hook (fleet/transport.py, once per delivery
         ATTEMPT — a re-send rolls the faults again): returns
         ``(verdict, data)`` with verdict ``"deliver"``, ``"drop"`` (the
         frame vanishes; the sender's RpcPolicy-bounded ack wait must
         notice and re-send), or ``"dup"`` (the frame arrives twice; the
         receiver must dedup by stream). ``delay_handoff`` sleeps the
-        frame in flight, ``corrupt_handoff`` damages the returned
-        bytes. Wire faults honour ``times=N`` so a drill can drop
+        frame in flight; the corruption fault matching ``kind`` damages
+        the returned bytes (``corrupt_handoff`` for KV-handoff traffic,
+        ``corrupt_rollout_chunk`` for weight-rollout relay chunks — a
+        rollout drill must not damage ordinary handoffs, and vice
+        versa). Wire faults honour ``times=N`` so a drill can drop
         exactly one attempt and let the re-send heal."""
+        corrupt_kind = self._WIRE_CORRUPT.get(kind)
+        if corrupt_kind is None:
+            raise ValueError(f"unknown wire kind {kind!r} — known: "
+                             + ", ".join(sorted(self._WIRE_CORRUPT)))
         rank = _own_rank() if rank is None else rank
         verdict = "deliver"
         for f in self.faults:
             if f.kind not in ("drop_handoff", "delay_handoff",
-                              "dup_handoff", "corrupt_handoff"):
+                              "dup_handoff", corrupt_kind):
                 continue
             if not self._wire_gate(f, rank):
                 continue
@@ -581,6 +623,46 @@ class ChaosPlan:
                 continue
             f.fired += 1
             self.log.append(f"kill_dest stream={stream_id}")
+            return True
+        return False
+
+    def on_swap(self, replica: int,
+                rank: Optional[int] = None) -> bool:
+        """Rollout swap hook (fleet/rollout.py): called inside a
+        replica's weight-swap window — after it drained, before it is
+        readmitted. Returns True when a matching ``kill_mid_swap``
+        fault fires; the caller must kill that replica abruptly (the
+        SIGKILL-mid-swap analogue: the rollout controller classifies
+        the death as a crash and skips the replica, and a supervised
+        restart converges to whichever version its local manifest
+        verifies)."""
+        rank = _own_rank() if rank is None else rank
+        for f in self.faults:
+            if f.kind != "kill_mid_swap":
+                continue
+            if f.replica is not None and f.replica != replica:
+                continue
+            if not self._wire_gate(f, rank):
+                continue
+            f.fired += 1
+            self.log.append(f"kill_mid_swap replica={replica}")
+            return True
+        return False
+
+    def on_canary(self, rank: Optional[int] = None) -> bool:
+        """Rollout canary hook (fleet/rollout.py): called right before
+        the canary's bitwise compare against the v2 oracle. Returns
+        True when a ``canary_mismatch`` fault fires — the caller must
+        treat the compare as FAILED (a bad v2 snapshot) and abort the
+        rollout with the fleet untouched."""
+        rank = _own_rank() if rank is None else rank
+        for f in self.faults:
+            if f.kind != "canary_mismatch":
+                continue
+            if not self._wire_gate(f, rank):
+                continue
+            f.fired += 1
+            self.log.append("canary_mismatch")
             return True
         return False
 
@@ -687,11 +769,11 @@ def on_handoff(data: bytes) -> bytes:
     return data
 
 
-def on_wire(data: bytes) -> tuple:
+def on_wire(data: bytes, kind: str = "handoff") -> tuple:
     if os.environ.get(ENV_VAR):
         plan = chaos_from_env()
         if plan is not None:
-            return plan.on_wire(data)
+            return plan.on_wire(data, kind=kind)
     return ("deliver", data)
 
 
@@ -708,4 +790,20 @@ def on_migration(stream_id: int) -> bool:
         plan = chaos_from_env()
         if plan is not None:
             return plan.on_migration(stream_id)
+    return False
+
+
+def on_swap(replica: int) -> bool:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            return plan.on_swap(replica)
+    return False
+
+
+def on_canary() -> bool:
+    if os.environ.get(ENV_VAR):
+        plan = chaos_from_env()
+        if plan is not None:
+            return plan.on_canary()
     return False

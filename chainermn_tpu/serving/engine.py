@@ -50,7 +50,19 @@ from chainermn_tpu.serving.kv_cache import ServingStep
 from chainermn_tpu.serving.reports import ServingReport
 from chainermn_tpu.serving.sampling import init_keys, request_key
 
-__all__ = ["Engine", "EngineConfig", "Request", "default_buckets"]
+__all__ = ["Engine", "EngineConfig", "Request", "WeightsVersionSkew",
+           "default_buckets"]
+
+
+class WeightsVersionSkew(ValueError):
+    """A handoff/session was minted under a different weights version
+    than this engine serves. Adoption is REFUSED — continuing a
+    prefill-v2 stream on a decode-v1 replica would silently mix model
+    versions inside one output. Callers route the refusal through the
+    existing fallbacks: the decode pool re-prefills the stream cleanly
+    (fleet/pools.py), the router replays it from seed on a survivor
+    (fleet/router.py) — either way the stream is entirely ONE version,
+    bitwise against that version's oracle."""
 
 
 def default_buckets(capacity: int, lo: int = 8) -> Tuple[int, ...]:
@@ -127,8 +139,11 @@ class Engine:
 
     def __init__(self, model, params, config: EngineConfig = EngineConfig(),
                  *, mesh=None, axis=None, report: Optional[ServingReport] = None,
-                 time_fn=None):
+                 time_fn=None, weights_version: Optional[str] = None):
         self.config = config
+        #: which published weights this engine serves (None = unversioned
+        #: — every skew check passes, so pre-rollout fleets are unchanged)
+        self.weights_version = weights_version
         if config.decode_k < 1:
             raise ValueError("decode_k must be >= 1")
         if config.prefill_chunk is not None and config.prefill_chunk < 1:
@@ -291,6 +306,7 @@ class Engine:
             "temperature": req.temperature,
             "top_k": req.top_k,
             "seed": req.seed,
+            "weights_version": self.weights_version,
         }
 
     def export_session(self, req: Request) -> dict:
@@ -372,6 +388,14 @@ class Engine:
         format) — the disaggregation contract bench.py gates."""
         if not self.free_slots:
             raise RuntimeError("no free slot to import a handoff into")
+        hv = handoff.get("weights_version")
+        if (hv is not None and self.weights_version is not None
+                and hv != self.weights_version):
+            raise WeightsVersionSkew(
+                f"handoff was minted under weights {hv!r} but this "
+                f"engine serves {self.weights_version!r} — refusing "
+                "the adoption (fall back to a clean re-prefill / "
+                "replay-from-seed so the stream stays one version)")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size != int(handoff["prompt_len"]):
             raise ValueError(
@@ -438,6 +462,37 @@ class Engine:
                 self.report.record_retire(req.request_id, aborted=True)
                 hit.append(req)
         return hit
+
+    def swap_weights(self, params, weights_version: Optional[str] = None,
+                     *, converted: bool = False):
+        """Install new weights on a QUIESCENT engine (the SWAP leg of a
+        rolling update — fleet/rollout.py). Refused while any request
+        is queued, decoding, prefilling, or held: a mid-stream weight
+        change would mix model versions inside one output. Drain first
+        (``Router.drain`` migrates live sessions to survivors), swap,
+        then readmit. No recompile happens — params are per-call
+        arguments to every jitted program (``ServingStep.load_params``).
+
+        Returns ``(old_params, old_version)`` — the previous weights in
+        the engine's INTERNAL (already layout-converted) form, so a
+        failed rollout can walk this replica back with
+        ``swap_weights(old_params, old_version, converted=True)``.
+        ``converted=True`` skips the caller-layout conversion for
+        exactly that round-trip."""
+        if self.queue or self.active or self.prefilling or self.held:
+            raise RuntimeError(
+                "swap_weights requires a drained engine — "
+                f"{len(self.queue)} queued, {len(self.active)} active, "
+                f"{len(self.prefilling)} prefilling, "
+                f"{len(self.held)} held")
+        old_params = self.steps.params
+        old_version = self.weights_version
+        if converted:
+            self.steps.params = params
+        else:
+            self.steps.load_params(params)
+        self.weights_version = weights_version
+        return old_params, old_version
 
     # ----------------------------------------------------------------
     # scheduler iterations

@@ -303,6 +303,7 @@ class ServingStep:
                  cache_dtype: Any = None, mesh=None, axis: Optional[str] = None,
                  donate: bool = True):
         _check_servable(model)
+        self.src_model = model   # caller's layout: load_params converts from it
         if model.qkv_layout == "bhld":
             params = bhld_to_blhd_params(model, params)
             model = model.clone(qkv_layout="blhd")
@@ -580,9 +581,13 @@ class ServingStep:
         self.cache = new_cache
 
     def load_params(self, params):
-        """Swap weights in place (warm restart — serving/weights.py)."""
-        if self.model.qkv_layout == "bhld":
-            params = bhld_to_blhd_params(self.model, params)
+        """Swap weights in place (warm restart / rolling update —
+        serving/weights.py, fleet/rollout.py). ``params`` is in the
+        CALLER's layout, the same one ``__init__`` received; a bhld
+        source is converted exactly as construction did. No recompile:
+        params are per-call arguments to every jitted program."""
+        if self.src_model.qkv_layout == "bhld":
+            params = bhld_to_blhd_params(self.src_model, params)
         self.params = params
 
     def reset(self):

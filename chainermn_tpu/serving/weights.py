@@ -25,6 +25,12 @@ preemption into a visible outage. This module is the
 * ``pull_weights`` — the in-process fast path: fetch the params from a
   live peer over the communicator object plane (``bcast_obj``), for
   replicas joining while the fleet is up.
+* ``encode_weights`` / ``decode_weights`` — the diskless wire form of
+  the same (manifest, payload) pair, for the rolling-update relay
+  (``fleet/rollout.py``): the publisher encodes a snapshot ONCE, ships
+  it replica-to-replica in SHA-chunked frames, and every receiver
+  re-verifies the full-payload manifest before a single byte reaches a
+  serving process.
 * ``load_snapshot_weights`` — warm-reload straight from the TRAINING
   checkpoint directory: the async snapshot plane
   (``checkpointing/async_plane.py``) publishes ``snapshot_iter_<N>``
@@ -47,7 +53,8 @@ import numpy as np
 
 __all__ = ["publish_weights", "load_weights", "pull_weights",
            "weight_candidates", "load_snapshot_weights",
-           "snapshot_candidates", "WeightsError"]
+           "snapshot_candidates", "encode_weights", "decode_weights",
+           "WeightsError"]
 
 _MANIFEST_FORMAT = 1
 #: format 2 = blockwise-quantized payload; the manifest's ``codec`` key
@@ -127,8 +134,63 @@ def _decode_quantized(flat: dict, manifest: dict) -> dict:
     return out
 
 
+def encode_weights(params, wire_format: Optional[str] = None,
+                   weights_version: Optional[str] = None
+                   ) -> Tuple[dict, bytes]:
+    """Serialize ``params`` to ``(manifest, payload)`` without touching
+    disk — the wire form of :func:`publish_weights`, for the rollout
+    relay (``fleet/rollout.py``) that ships a snapshot replica-to-
+    replica. Same manifest grammar (format 1 raw / format 2 blockwise-
+    quantized via ``wire_format``); ``weights_version`` stamps the
+    manifest so receivers can fence version skew. ``decode_weights``
+    is the inverse and REFUSES a payload that fails the manifest's
+    SHA-256."""
+    flat = _flatten(params)
+    codec = None
+    if wire_format not in (None, "f32"):
+        flat, codec = _encode_quantized(flat, wire_format)
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    data = buf.getvalue()
+    manifest = {"format": (_MANIFEST_FORMAT_QUANT if codec
+                           else _MANIFEST_FORMAT),
+                "sha256": hashlib.sha256(data).hexdigest(),
+                "bytes": len(data)}
+    if codec:
+        manifest["codec"] = codec
+    if weights_version is not None:
+        manifest["weights_version"] = str(weights_version)
+    return manifest, data
+
+
+def decode_weights(manifest: dict, data: bytes, like: Any = None):
+    """Verify + deserialize a payload produced by
+    :func:`encode_weights`. The manifest's byte count and SHA-256 gate
+    the load — torn or corrupt bytes raise :class:`WeightsError`, never
+    half-load. With ``like`` the flat keys are folded back into the
+    template pytree; otherwise a flat ``{path: array}`` dict is
+    returned (quantized payloads are dequantized either way)."""
+    if manifest.get("format") not in _ACCEPTED_FORMATS:
+        raise WeightsError(
+            f"unknown weight manifest format {manifest.get('format')!r}")
+    if (len(data) != manifest.get("bytes")
+            or hashlib.sha256(data).hexdigest()
+            != manifest.get("sha256")):
+        raise WeightsError(
+            "weight payload does not match its manifest "
+            "(torn or corrupt bytes)")
+    with np.load(io.BytesIO(data)) as z:
+        flat = {k: z[k] for k in z.files}
+    if manifest.get("format") == _MANIFEST_FORMAT_QUANT:
+        flat = _decode_quantized(flat, manifest)
+    if like is None:
+        return flat
+    return _unflatten_like(like, flat)
+
+
 def publish_weights(params, path: str,
-                    wire_format: Optional[str] = None) -> dict:
+                    wire_format: Optional[str] = None,
+                    weights_version: Optional[str] = None) -> dict:
     """Atomically write ``params`` (any pytree of arrays) to ``path``
     (.npz) with a SHA-256 manifest sidecar ``path + '.json'``. Returns
     the manifest. The rename is the commit point: readers only ever see
@@ -137,26 +199,18 @@ def publish_weights(params, path: str,
     ``wire_format``: ``None``/``'f32'`` store raw arrays (format 1);
     ``'int8-block'``/``'int4-block'`` store blockwise codes + scales
     (format 2) through the collectives' codec — ``load_weights``
-    dequantizes transparently from the manifest-recorded scales."""
+    dequantizes transparently from the manifest-recorded scales.
+    ``weights_version`` (optional) is recorded in the manifest, so a
+    restart can tell WHICH version its local snapshot verifies as
+    (the rollout controller's convergence contract)."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    flat = _flatten(params)
-    codec = None
-    if wire_format not in (None, "f32"):
-        flat, codec = _encode_quantized(flat, wire_format)
-    buf = io.BytesIO()
-    np.savez(buf, **flat)
-    data = buf.getvalue()
+    manifest, data = encode_weights(params, wire_format=wire_format,
+                                    weights_version=weights_version)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
         f.flush()
         os.fsync(f.fileno())
-    sha = hashlib.sha256(data).hexdigest()
-    manifest = {"format": (_MANIFEST_FORMAT_QUANT if codec
-                           else _MANIFEST_FORMAT),
-                "sha256": sha, "bytes": len(data)}
-    if codec:
-        manifest["codec"] = codec
     mtmp = path + ".json.tmp"
     with open(mtmp, "w") as f:
         json.dump(manifest, f)

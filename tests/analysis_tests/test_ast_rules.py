@@ -25,7 +25,7 @@ def test_registry_has_every_documented_rule():
     assert {"DL101", "DL102", "DL103", "DL104", "DL105", "DL106",
             "DL107", "DL108", "DL109", "DL110", "DL111", "DL112",
             "DL113", "DL114", "DL115", "DL116", "DL117", "DL118",
-            "DL119", "DL120", "DL121", "DL122", "DL123",
+            "DL119", "DL120", "DL121", "DL122", "DL123", "DL124",
             "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
@@ -1467,3 +1467,102 @@ def test_dl123_tracks_self_attribute_sockets():
     fs = _only(_lint(src), "DL123")
     assert len(fs) == 1
     assert "_srv.accept" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# DL124 — unverified-weight-load
+# ---------------------------------------------------------------------------
+
+
+def test_dl124_flags_weight_loader_without_verification():
+    src = """\
+    import numpy as np
+
+    def load_weights(path, like=None):
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    """
+    fs = _only(_lint(src), "DL124")
+    assert len(fs) == 1
+    assert fs[0].line == 4
+    assert "load_weights" in fs[0].message
+    assert "docs/static_analysis.md#dl124" in fs[0].message
+
+
+def test_dl124_flags_snapshot_restore_via_fromfile():
+    src = """\
+    import numpy as np
+
+    def restore_snapshot(path, dtype):
+        return np.fromfile(path, dtype=dtype)
+    """
+    fs = _only(_lint(src), "DL124")
+    assert len(fs) == 1
+    assert "restore_snapshot" in fs[0].message
+
+
+def test_dl124_clean_when_loader_verifies_inline():
+    src = """\
+    import hashlib
+    import numpy as np
+
+    def load_weights(path, manifest):
+        data = open(path, "rb").read()
+        if hashlib.sha256(data).hexdigest() != manifest["sha256"]:
+            raise ValueError("corrupt snapshot")
+        return np.load(path)
+    """
+    assert _only(_lint(src), "DL124") == []
+
+
+def test_dl124_clean_when_loader_calls_in_file_verifier():
+    src = """\
+    import hashlib
+    import numpy as np
+
+    def _verify(path, manifest):
+        data = open(path, "rb").read()
+        return hashlib.sha256(data).hexdigest() == manifest["sha256"]
+
+    def load_weights(path, manifest):
+        if not _verify(path, manifest):
+            raise ValueError("corrupt snapshot")
+        return np.load(path)
+    """
+    assert _only(_lint(src), "DL124") == []
+
+
+def test_dl124_ignores_non_weight_loaders():
+    src = """\
+    import numpy as np
+
+    def read_manifest(path):
+        return np.load(path)
+
+    def load_host_state(path):
+        return np.load(path)
+    """
+    assert _only(_lint(src), "DL124") == []
+
+
+def test_dl124_ignores_the_verifier_itself():
+    src = """\
+    import numpy as np
+
+    def verify_snapshot_weights(path):
+        return np.load(path)
+    """
+    assert _only(_lint(src), "DL124") == []
+
+
+def test_dl124_one_finding_per_function():
+    src = """\
+    import numpy as np
+
+    def read_weight_shards(paths):
+        a = np.load(paths[0])
+        b = np.load(paths[1])
+        return a, b
+    """
+    fs = _only(_lint(src), "DL124")
+    assert len(fs) == 1

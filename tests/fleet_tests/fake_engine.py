@@ -26,24 +26,47 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from chainermn_tpu.serving.engine import Request
+from chainermn_tpu.serving.engine import Request, WeightsVersionSkew
 from chainermn_tpu.serving.reports import ServingReport
 
 
-def expected_tokens(prompt, seed: int, n: int, vocab: int = 43) -> List[int]:
-    """The stream a FakeEngine emits for (prompt, seed) — the oracle."""
-    base = int(np.asarray(prompt, np.int64).sum()) + 7 * seed
+def expected_tokens(prompt, seed: int, n: int, vocab: int = 43,
+                    salt: int = 0) -> List[int]:
+    """The stream a FakeEngine emits for (prompt, seed) — the oracle.
+    ``salt`` is the fake's "weights": a different salt is a different
+    model version emitting a provably different stream (the rollout
+    drill's per-version oracle; default 0 keeps every pre-rollout
+    expectation unchanged)."""
+    base = (int(np.asarray(prompt, np.int64).sum()) + 7 * seed
+            + 1009 * int(salt))
     return [(base + 13 * i) % vocab for i in range(n)]
+
+
+def fake_params(salt: int) -> dict:
+    """The params pytree a FakeEngine's 'weights' are: one int leaf —
+    enough for ``serving.weights.encode_weights`` to hash, chunk,
+    corrupt, and verify like a real snapshot."""
+    return {"salt": np.asarray(int(salt), np.int64)}
+
+
+def fake_salt(params) -> int:
+    """Invert :func:`fake_params` (tolerates the flat decoded dict)."""
+    if isinstance(params, dict):
+        return int(np.asarray(params["salt"]).reshape(()))
+    return int(params)
 
 
 class FakeEngine:
     """Duck-typed ``serving.Engine`` emitting ``expected_tokens``."""
 
     def __init__(self, n_slots: int = 2, max_new_tokens: int = 8,
-                 step_delay_s: float = 0.0):
+                 step_delay_s: float = 0.0, salt: int = 0,
+                 weights_version: Optional[str] = None):
         self.n_slots = n_slots
         self.default_max_new = max_new_tokens
         self.step_delay_s = step_delay_s
+        self.salt = int(salt)
+        self.weights_version = weights_version
         self.queue: deque = deque()
         self.active: Dict[int, Request] = {}
         self.prefilling: Dict[int, Request] = {}
@@ -85,7 +108,7 @@ class FakeEngine:
         emitted = 0
         for slot, req in list(self.active.items()):
             stream = expected_tokens(req.prompt, req.seed,
-                                     req.max_new_tokens)
+                                     req.max_new_tokens, salt=self.salt)
             tok = stream[len(req.tokens)]
             req.tokens.append(tok)
             self.report.record_token(req.request_id)
@@ -146,6 +169,7 @@ class FakeEngine:
             "temperature": req.temperature,
             "top_k": req.top_k,
             "seed": req.seed,
+            "weights_version": self.weights_version,
         }
 
     def import_handoff(self, handoff: dict, prompt,
@@ -156,6 +180,12 @@ class FakeEngine:
         contract."""
         if not self.free_slots:
             raise RuntimeError("no free slot to import a handoff into")
+        hv = handoff.get("weights_version")
+        if (hv is not None and self.weights_version is not None
+                and hv != self.weights_version):
+            raise WeightsVersionSkew(
+                f"handoff was minted under weights {hv!r} but this "
+                f"engine serves {self.weights_version!r}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size != int(handoff["prompt_len"]):
             raise ValueError(
@@ -234,6 +264,34 @@ class FakeEngine:
         """Transport could not deliver this slot's handoff: free it as
         an abort (the receiver's clean re-prefill owns the stream)."""
         self.release_held(req, aborted=True)
+
+    def swap_weights(self, params, weights_version: Optional[str] = None,
+                     *, converted: bool = False):
+        """The real engine's swap face: quiescence-gated salt change.
+        ``params`` is :func:`fake_params`'s pytree (or the flat dict
+        ``decode_weights`` returns). Returns ``(old_params,
+        old_version)`` for the rollback walk, like the real engine."""
+        del converted     # the fake has no layout to convert
+        if self.queue or self.active or self.prefilling or self.held:
+            raise RuntimeError(
+                "swap_weights requires a drained engine — "
+                f"{len(self.queue)} queued, {len(self.active)} active, "
+                f"{len(self.prefilling)} prefilling, "
+                f"{len(self.held)} held")
+        old_params = fake_params(self.salt)
+        old_version = self.weights_version
+        self.salt = fake_salt(params)
+        self.weights_version = weights_version
+        return old_params, old_version
+
+    def run_until_drained(self, max_steps: int = 100_000) -> None:
+        """Step until idle (the canary's off-traffic replay loop)."""
+        steps = 0
+        while not self.idle():
+            self.step()  # dlint: disable=DL104
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(f"not drained in {max_steps} steps")
 
     def abort_all(self, requeue: bool = False) -> List[Request]:
         hit = []

@@ -115,6 +115,8 @@ def test_counters_and_summary_shape():
         "migration_wire_bytes": {"f32": 1600},
         "transport": {"retransmits": 2, "reconnects": 4,
                       "dup_fenced": 2, "chunk_nacks": 1},
+        "rollouts": {"completed": 0, "rolled_back": 0,
+                     "canary_failures": 0, "wire_bytes": 0},
     }
     assert out["replicas"] == 1
     assert np.isfinite(out["tokens_per_s"])

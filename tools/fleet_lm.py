@@ -60,6 +60,20 @@ with ``Router.drain``'s live migration, not this signal; the
 disaggregated and ``--hosts`` modes finish their in-flight sessions.
 Either way the process flushes its reports and exits 0, and the shed
 ids are re-submitted by the next incarnation's idempotent replay.
+
+**SIGHUP requests a live rolling weight update** (router mode): with
+``--rollout PATH`` naming a published candidate snapshot, the serving
+loop runs ``fleet.RolloutController`` over the live router — bitwise
+canary gate, chunked relay, per-replica DRAIN → SWAP → READMIT — while
+traffic keeps flowing; the JSONL stays idempotent across the swap. On
+a COMPLETED rollout the candidate is atomically re-published to
+``--weights``, so a later restart warm-loads the new version; a
+SIGKILL inside the rollout window classifies as a crash
+(``classify_exit``) and the supervised restart converges to whichever
+version its verified local manifest names — the new one after the
+publish commit point, the old one before it. A canary miscompare or a
+relay failure leaves (or rolls back to) the incumbent version, fleet
+still serving. SIGHUP without ``--rollout`` is logged and ignored.
 """
 
 import argparse
@@ -141,10 +155,10 @@ def _engine_factory(args):
     else:
         params = init
 
-    def engine():
+    def make(p=None, weights_version=None):
         # decode_k=1 so kill_replica@step=N counts one token per
         # working iteration — the drill timing contract (serve_lm.py)
-        return Engine(model, params,
+        return Engine(model, params if p is None else p,
                       EngineConfig(n_slots=args.slots,
                                    capacity=args.capacity,
                                    max_new_tokens=args.max_new_tokens,
@@ -152,8 +166,16 @@ def _engine_factory(args):
                                    buckets=[args.prompt_len,
                                             args.capacity],
                                    decode_k=args.decode_k,
-                                   prefill_chunk=args.prefill_chunk))
+                                   prefill_chunk=args.prefill_chunk),
+                      weights_version=weights_version)
 
+    def engine():
+        return make()
+
+    # the rollout path (SIGHUP + --rollout) needs the template params
+    # and a versioned-engine constructor alongside the plain factory
+    engine.make = make
+    engine.params = params
     return engine
 
 
@@ -193,6 +215,74 @@ def _drain_flag():
     return drain
 
 
+def _reload_flag():
+    """Install the SIGHUP live-reload handler (module docstring): the
+    handler only flips the flag; the router serving loop runs the
+    rollout at its next iteration boundary, never mid-step."""
+    import signal
+
+    reload_ = {"requested": False}
+
+    def _on_reload(signum, frame):
+        reload_["requested"] = True
+
+    try:
+        signal.signal(signal.SIGHUP, _on_reload)
+    except (ValueError, AttributeError):
+        pass                           # not the main thread / no SIGHUP
+    return reload_
+
+
+def _run_rollout(args, router, fab):
+    """One SIGHUP-triggered rolling update over the live router: load
+    the ``--rollout`` candidate (manifest-verified), mint the canary
+    oracle greedy off-traffic on a reference engine holding it, then
+    walk the fleet CANARY → DRAIN → SWAP → READMIT. On a COMPLETED
+    walk the candidate re-publishes atomically to ``--weights`` — the
+    commit point a supervised restart converges from."""
+    import numpy as np
+
+    from chainermn_tpu.fleet import RolloutController
+    from chainermn_tpu.serving import load_weights, publish_weights
+    from chainermn_tpu.serving.weights import WeightsError
+
+    try:
+        v2, src = load_weights(args.rollout, like=fab.params)
+    except WeightsError as e:
+        _log(f"rollout: candidate {args.rollout} refused ({e}); "
+             "fleet untouched")
+        return None
+    version = os.path.basename(os.path.normpath(args.rollout))
+    _log(f"rollout: candidate {version} verified from {src}")
+
+    # the pinned canary prompt set: the first requests of the
+    # deterministic batch, replayed GREEDY under fixed seeds
+    rng = np.random.RandomState(args.seed)
+    can_p = []
+    for i in range(min(2, args.requests)):
+        prompt = rng.randint(0, args.vocab,
+                             (args.prompt_len,)).astype(np.int32)
+        can_p.append((prompt.tolist(), args.seed + i,
+                      args.max_new_tokens))
+    oracle_eng = fab.make(v2, version)
+    oreqs = [oracle_eng.submit(np.asarray(p, np.int32),
+                               max_new_tokens=n, seed=s)
+             for p, s, n in can_p]
+    oracle_eng.run_until_drained()
+    can_o = [list(r.tokens) for r in oreqs]
+
+    rc = RolloutController(router, fab.make, like=fab.params)
+    out = rc.rollout(v2, version, canary_prompts=can_p,
+                     canary_oracle=can_o, from_version="v1")
+    _log("rollout: " + json.dumps(
+        {k: out[k] for k in ("status", "version", "swapped", "crashed",
+                             "rolled_back", "reason")}, sort_keys=True))
+    if out["status"] == "completed" and args.weights:
+        publish_weights(v2, args.weights, weights_version=version)
+        _log(f"rollout: published {version} to {args.weights}")
+    return out
+
+
 def serve(args):
     from chainermn_tpu.fleet import DisaggregatedFleet, FleetReport, Router
     from chainermn_tpu.serving import DeadlineExceeded
@@ -204,7 +294,9 @@ def serve(args):
     prompts = _pending_prompts(args)
     report = FleetReport()
     drain = _drain_flag()
+    reload_ = _reload_flag()
     shed = False
+    rolled = False
     kw = dict(max_new_tokens=args.max_new_tokens,
               temperature=args.temperature, top_k=args.top_k)
 
@@ -232,14 +324,27 @@ def serve(args):
         fleet.close()
         summary = fleet.summary()
     else:
+        # a rollout's canary traces on the serving thread; co-located
+        # worker heartbeats starve under the GIL, so give health a
+        # compile-sized timeout when a live reload is on the table
         with Router([engine() for _ in range(args.replicas)],
                     max_queue_depth=args.max_queue_depth,
+                    health_timeout_ms=(600_000 if args.rollout
+                                       else None),
                     report=report) as router:
             futs = {i: router.submit(p, seed=args.seed + i, **kw)
                     for i, p in emit_order(prompts)}
             pending = dict(futs)
             with open(args.out, "a") as out:
                 while pending:
+                    if reload_["requested"] and not rolled:
+                        reload_["requested"] = False
+                        rolled = True
+                        if args.rollout:
+                            _run_rollout(args, router, engine)
+                        else:
+                            _log("SIGHUP ignored: no --rollout "
+                                 "candidate named")
                     if drain["requested"] and not shed:
                         shed = True
                         n = router.shed_pending()
@@ -607,6 +712,10 @@ def main(argv=None):
                     help="decode-host budget for a stream's handoff to "
                          "arrive before fencing it and re-prefilling "
                          "from seed (--hosts mode)")
+    ap.add_argument("--rollout", default=None,
+                    help="published candidate-weights path for the "
+                         "SIGHUP-triggered live rolling update "
+                         "(router mode; see the signal contract)")
     ap.add_argument("--max-queue-depth", type=int, default=None,
                     help="per-replica admission bound (router mode)")
     ap.add_argument("--requests", type=int, default=6)
