@@ -788,6 +788,90 @@ def main():
     except Exception as e:  # never sink the headline metric
         record["rollout_gate_error"] = f"{type(e).__name__}: {e}"[:300]
 
+    # speculative-decoding gate (docs/serving.md#speculative-decoding-
+    # servingspeculativepy), folded into the same JSON line. Structural
+    # claims, backend-independent: (1) SpeculativeEngine streams are
+    # BITWISE the plain single-engine streams, greedy AND sampled —
+    # acceptance may change the dispatch count, never the tokens — with
+    # the DL108 discipline intact (ONE propose trace, ONE verify trace
+    # per engine); (2) on the canned high-acceptance pair (draft
+    # sharing the target's weights) the acceptance rate clears 0.9 and
+    # each dispatch commits more than one token; (3) int8-block pages
+    # hold >= 3.5x the slots of f32 pages at equal memory, scale
+    # sidecars included. Speculative *throughput* stays an honest null
+    # off-TPU: a CPU draft's latency says nothing about the TPU
+    # draft/target cost ratio the economics depend on.
+    try:
+        from chainermn_tpu.serving.kv_cache import ServingStep
+        from chainermn_tpu.serving.speculative import SpeculativeEngine
+
+        draft_lm = TransformerLM(vocab=64, d_model=32, n_heads=4,
+                                 n_layers=1, d_ff=64, max_len=64,
+                                 attention="reference", pos_emb="rope")
+        draft_p = draft_lm.init(jax.random.PRNGKey(1),
+                                jnp.zeros((1, 4), jnp.int32))["params"]
+
+        # (1) bitwise vs the plain engine, greedy then sampled
+        sp_g = SpeculativeEngine(lm, lp, draft_lm, draft_p,
+                                 _fleet_cfg(), spec_k=3)
+        g_reqs = [sp_g.submit(p, max_new_tokens=n_new)
+                  for p in fleet_prompts]
+        sp_g.run_until_drained()
+        spec_greedy_ok = [list(r.tokens) for r in g_reqs] == fleet_ref
+
+        s_kw = dict(temperature=0.8, top_k=6)
+        s_oracle = Engine(lm, lp, _fleet_cfg())
+        s_ref = [s_oracle.submit(p, max_new_tokens=n_new, seed=31 + i,
+                                 **s_kw)
+                 for i, p in enumerate(fleet_prompts)]
+        s_oracle.run_until_drained()
+        sp_s = SpeculativeEngine(lm, lp, draft_lm, draft_p,
+                                 _fleet_cfg(), spec_k=3)
+        s_reqs = [sp_s.submit(p, max_new_tokens=n_new, seed=31 + i,
+                              **s_kw)
+                  for i, p in enumerate(fleet_prompts)]
+        sp_s.run_until_drained()
+        spec_sampled_ok = ([list(r.tokens) for r in s_reqs]
+                           == [list(r.tokens) for r in s_ref])
+        spec_traces_ok = (sp_g.draft.propose_traces == 1
+                          and sp_g.verify_traces == 1
+                          and sp_s.draft.propose_traces == 1
+                          and sp_s.verify_traces == 1)
+
+        # (2) canned high-acceptance pair: draft == target; max_new =
+        # 1 + 2*(spec_k+1) so the prefill token plus two FULL rounds
+        # exactly spend the budget (no truncated tail round)
+        hi_cfg = EngineConfig(n_slots=2, capacity=32, max_new_tokens=9,
+                              prefill_cohort=1, buckets=[8, 32])
+        sp_hi = SpeculativeEngine(lm, lp, lm, lp, hi_cfg, spec_k=3)
+        for i, p in enumerate(fleet_prompts):
+            sp_hi.submit(p, max_new_tokens=9, seed=31 + i, **s_kw)
+        sp_hi.run_until_drained()
+        hi = sp_hi.report.summary()
+
+        # (3) slots at equal memory: resident int8 pages vs f32 pages
+        f32_bytes = ServingStep(lm, lp, 2, 32).cache_bytes()
+        q8_bytes = ServingStep(lm, lp, 2, 32,
+                               kv_dtype="int8-block").cache_bytes()
+        slot_ratio = f32_bytes / q8_bytes if q8_bytes else 0.0
+
+        record["specdec_honest_null"] = jax.default_backend() != "tpu"
+        record["specdec_greedy_bitwise"] = bool(spec_greedy_ok)
+        record["specdec_sampled_bitwise"] = bool(spec_sampled_ok)
+        record["specdec_traces_ok"] = bool(spec_traces_ok)
+        record["specdec_acceptance_rate"] = round(
+            hi["acceptance_rate"], 6)
+        record["specdec_tokens_per_dispatch"] = round(
+            hi["tokens_per_dispatch"], 6)
+        record["specdec_int8_slot_ratio"] = round(slot_ratio, 6)
+        record["specdec_gate_ok"] = bool(
+            spec_greedy_ok and spec_sampled_ok and spec_traces_ok
+            and hi["acceptance_rate"] >= 0.9
+            and hi["tokens_per_dispatch"] > 1.0
+            and slot_ratio >= 3.5)
+    except Exception as e:  # never sink the headline metric
+        record["specdec_gate_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # async checkpoint plane gate
     # (docs/fault_tolerance.md#checkpoint-cadence), folded into the same
     # JSON line: the per-step stall of saving through

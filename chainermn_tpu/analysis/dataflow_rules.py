@@ -1,4 +1,4 @@
-"""dlint dataflow rules DL118–DL122: value-level contracts.
+"""dlint dataflow rules DL118–DL122, DL125: value-level contracts.
 
 These project passes stand on :mod:`.dataflow` (reaching definitions +
 def-use chains + interprocedural parameter summaries) and encode the
@@ -38,7 +38,16 @@ value contracts the rest of the stack only states in prose:
   ``static_argnums``/``static_argnames``, named ``self``/``cls``, and
   bare ``is None`` tests are static and exempt.
 
-All five fire only when EVERY definition reaching the flagged use has
+* **DL125 draft-target-key-confusion** — a token sampled with a
+  ``draft_shadow_keys`` SHADOW key row (serving/speculative.py's draft
+  proposal stream) committed through an emit/commit-style call with no
+  verify/accept call receiving it on the dataflow path: draft samples
+  are PROPOSALS — only the target's verify pass may put tokens into a
+  stream, or accepted streams stop being bitwise-identical to
+  non-speculative decode and the draft's shadow splits leak into the
+  real one-split-per-sampled-token key stream.
+
+All six fire only when EVERY definition reaching the flagged use has
 the hazardous property — an uncertain merge silences the finding (the
 package-wide precision stance, docs/static_analysis.md#dl118).
 """
@@ -861,3 +870,138 @@ def check_trace_count_instability(project: Project) -> List[Finding]:
 
 register(Rule("DL122", "trace-count-instability", f"{_DOC}#dl122",
               check_trace_count_instability, kind="project"))
+
+
+# ---------------------------------------------------------------------------
+# DL125 — draft-target-key-confusion
+# ---------------------------------------------------------------------------
+
+
+#: the taint source: serving/sampling.py's shadow-copy of the target's
+#: key rows for a draft proposal pass
+_DRAFT_KEY_MAKER = "draft_shadow_keys"
+#: samplers whose (logits, keys) call shape the rule understands
+_DRAFT_SAMPLERS = {"sample_tokens"}
+#: a call whose name carries one of these receives the token for
+#: target-side verification — the blessing that makes a commit legal
+_VERIFY_HINTS = ("verify", "accept")
+#: commit-style sinks a raw draft sample must never reach
+_COMMIT_SINKS = {"emit", "_emit", "commit", "commit_token",
+                 "record_token", "append", "push", "send", "publish"}
+
+
+def _call_name(call: ast.Call) -> str:
+    chain = _attr_chain(call.func)
+    return (chain[-1] if chain else _callee_name(call)) or ""
+
+
+class _DraftKeyWalker(FlowWalker):
+    """Taint tracking for the speculative-decoding PRNG contract.
+
+    ``draft_shadow_keys(...)`` results are SHADOW keys; a
+    ``sample_tokens`` call keyed by one yields a DRAFT token (result 0)
+    and a new shadow key (result 1). Path state is the set of draft-
+    token defs a verify/accept call has received on every path (merges
+    intersect — maybe-verified stays silent); a commit-style call whose
+    argument's reaching definitions are all unverified draft tokens is
+    the finding."""
+
+    def __init__(self, scope, mod: ModuleInfo, findings: List[Finding]):
+        super().__init__(scope)
+        self.mod = mod
+        self.findings = findings
+        self.shadow_keys: Set[int] = set()
+        self.draft_toks: Set[int] = set()
+        # per-sampler-call "keyed by shadow rows" verdict: the walker
+        # binds each tuple-unpack target (and its env entry) before
+        # on_def fires, so by the time the REBOUND key target of
+        # ``tok, shadow = sample_tokens(.., shadow, ..)`` is processed
+        # the key argument resolves to the def being created; the
+        # verdict cached while processing the token target is the one
+        # that saw the pre-bind environment
+        self._keyed_calls: Dict[int, bool] = {}
+
+    def initial_state(self):
+        return set()
+
+    def copy_state(self, state):
+        return set(state)
+
+    def merge_states(self, a, b):
+        return a & b
+
+    def _name_defs(self, expr) -> FrozenSet:
+        if isinstance(expr, ast.Name):
+            return self.env.get(expr.id, frozenset())
+        return frozenset()
+
+    def on_def(self, d) -> None:
+        v = self.def_value.get(d.uid)
+        if not isinstance(v, ast.Call):
+            return
+        name = _call_name(v)
+        if name == _DRAFT_KEY_MAKER:
+            if d.index in (None, 0):
+                self.shadow_keys.add(d.uid)
+            return
+        if name in _DRAFT_SAMPLERS:
+            key_arg = (v.args[1] if len(v.args) > 1
+                       and not isinstance(v.args[1], ast.Starred)
+                       else None)
+            for kw in v.keywords:
+                if kw.arg in ("keys", "key"):
+                    key_arg = kw.value
+            refs = self._name_defs(key_arg)
+            keyed = bool(refs) and all(
+                r.uid in self.shadow_keys for r in refs)
+            keyed = keyed or self._keyed_calls.get(id(v), False)
+            self._keyed_calls[id(v)] = keyed
+            if keyed:
+                if d.index in (None, 0):
+                    self.draft_toks.add(d.uid)
+                elif d.index == 1:
+                    # the advanced shadow key stays a shadow key
+                    self.shadow_keys.add(d.uid)
+
+    def on_call(self, call: ast.Call) -> None:
+        name = _call_name(call)
+        low = name.lower()
+        if any(h in low for h in _VERIFY_HINTS):
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in args:
+                for d in self._name_defs(arg):
+                    if d.uid in self.draft_toks:
+                        self.state.add(d.uid)
+            return
+        if name not in _COMMIT_SINKS:
+            return
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                continue
+            refs = self._name_defs(arg)
+            if refs and all(r.uid in self.draft_toks for r in refs) \
+                    and any(r.uid not in self.state for r in refs):
+                tok = arg.id if isinstance(arg, ast.Name) else "<token>"
+                self.findings.append(Finding(
+                    "DL125", self.mod.path, call.lineno,
+                    f"'{tok}' was sampled with a draft_shadow_keys "
+                    f"SHADOW key row and is committed by '{name}' "
+                    "with no verify/accept call receiving it on this "
+                    "path — draft samples are proposals; only the "
+                    "target's verify pass may put tokens into a "
+                    "stream, or accepted streams stop being bitwise "
+                    "and the shadow key splits leak into the real "
+                    "one-split-per-sampled-token stream (serving/"
+                    f"speculative.py; {_DOC}#dl125)."))
+
+
+def check_draft_target_key_confusion(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in project.modules.values():
+        for scope in scopes_in(mod.tree):
+            _DraftKeyWalker(scope, mod, findings).run()
+    return findings
+
+
+register(Rule("DL125", "draft-target-key-confusion", f"{_DOC}#dl125",
+              check_draft_target_key_confusion, kind="project"))

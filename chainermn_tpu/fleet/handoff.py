@@ -30,6 +30,17 @@ Wire formats:
   bytes (``wire_ratio``). Logit error after the handoff is bounded by
   the per-block scale — calibrated in tests/fleet_tests.
 
+When the SOURCE pages are already int8-resident (``kv_dtype=
+"int8-block"`` engines, serving/kv_cache.py), the quantized formats
+(2/4/5) ship the resident codes and scales VERBATIM — no dequantize →
+requantize round trip, so the wire bytes are exactly the page bytes the
+source engine was serving from and the handoff adds ZERO quantization
+error on top of the at-rest codec. The codec leaf is marked
+``resident`` so an int8 destination adopts the codes byte-for-byte
+(``pages_q8`` in the decoded dict) while an f32 destination gets the
+one inherent dequantization. Raw formats (1/3) from a resident source
+dequantize once at encode — the raw wire grammar stays f32 bytes.
+
 Decode REFUSES anything it cannot verify — unknown format, byte-count
 mismatch (truncation), digest mismatch (corruption), or a structurally
 broken manifest all raise :class:`HandoffError` — so a damaged handoff
@@ -115,6 +126,55 @@ class _Packer:
         self.offset += len(raw)
 
 
+def _pack_page(pk: "_Packer", block: str, page: dict, wire_format: str,
+               codec_leaves: Dict[str, dict]) -> int:
+    """Pack one KV block's leaves (shared by the monolithic and
+    streamed encoders). Returns the blockwise-codec block size the
+    quantized leaves actually use: the at-rest page block for resident
+    sources (codes/scales shipped verbatim), else ``QUANT_BLOCK``."""
+    from chainermn_tpu.collectives.quantized import (QUANT_BLOCK,
+                                                     block_dequantize,
+                                                     block_quantize)
+    blk = QUANT_BLOCK
+    resident = "k_q" in page
+    for leaf in ("k", "v"):
+        name = f"{block}/{leaf}"
+        if resident:
+            q = np.ascontiguousarray(np.asarray(page[leaf + "_q"],
+                                                np.int8))
+            s = np.ascontiguousarray(np.asarray(page[leaf + "_s"],
+                                                np.float32))
+            blk = q.size // s.size
+            if wire_format == "f32":
+                # the raw grammar is f32 bytes: the source's ONE
+                # inherent dequantization happens at encode
+                arr = np.asarray(block_dequantize(
+                    q.reshape(-1), s.reshape(-1), q.size, "int8-block",
+                    np.float32, blk)).reshape(q.shape)
+                pk.put(name, arr)
+            else:
+                # already quantized at rest: the wire IS the page —
+                # codes and scales verbatim, zero extra error
+                pk.put(name + "::q", q.reshape(-1))
+                pk.put(name + "::scale", s.reshape(-1))
+                codec_leaves[name] = {"shape": list(q.shape),
+                                      "dtype": "float32",
+                                      "size": int(q.size),
+                                      "resident": True}
+        else:
+            arr = np.asarray(page[leaf])
+            if wire_format == "f32":
+                pk.put(name, arr)
+            else:
+                q, s = block_quantize(arr.reshape(-1), wire_format)
+                pk.put(name + "::q", np.asarray(q))
+                pk.put(name + "::scale", np.asarray(s, np.float32))
+                codec_leaves[name] = {"shape": list(arr.shape),
+                                      "dtype": arr.dtype.name,
+                                      "size": int(arr.size)}
+    return blk
+
+
 def encode_handoff(handoff: dict,
                    wire_format: str = "f32") -> Tuple[dict, bytes]:
     """Serialize ``Engine.export_handoff``'s dict. Returns
@@ -126,21 +186,10 @@ def encode_handoff(handoff: dict,
             + ", ".join(HANDOFF_WIRE_FORMATS))
     pk = _Packer()
     codec_leaves: Dict[str, dict] = {}
+    blk = None
     for block in sorted(handoff["pages"]):
-        for leaf in ("k", "v"):
-            name = f"{block}/{leaf}"
-            arr = np.asarray(handoff["pages"][block][leaf])
-            if wire_format == "f32":
-                pk.put(name, arr)
-            else:
-                from chainermn_tpu.collectives.quantized import \
-                    block_quantize
-                q, s = block_quantize(arr.reshape(-1), wire_format)
-                pk.put(name + "::q", np.asarray(q))
-                pk.put(name + "::scale", np.asarray(s, np.float32))
-                codec_leaves[name] = {"shape": list(arr.shape),
-                                      "dtype": arr.dtype.name,
-                                      "size": int(arr.size)}
+        blk = _pack_page(pk, block, handoff["pages"][block],
+                         wire_format, codec_leaves)
     pk.put("key", np.asarray(handoff["key"], np.uint32))
     blob = b"".join(pk.chunks)
     # a dict carrying max_new_tokens is a decode-session export
@@ -167,7 +216,8 @@ def encode_handoff(handoff: dict,
     if wire_format != "f32":
         from chainermn_tpu.collectives.quantized import QUANT_BLOCK
         manifest["codec"] = {"wire_format": wire_format,
-                             "block": QUANT_BLOCK,
+                             "block": (blk if blk is not None
+                                       else QUANT_BLOCK),
                              "leaves": codec_leaves}
     return manifest, blob
 
@@ -205,6 +255,7 @@ def decode_handoff(manifest: dict, blob: bytes) -> dict:
                 raw, dtype=dt).reshape(ent["shape"])
         meta = manifest["meta"]
         pages: Dict[str, Dict[str, np.ndarray]] = {}
+        pages_q8: Dict[str, Dict[str, np.ndarray]] = {}
         if fmt not in _QUANT_FORMATS:
             for name, arr in flat.items():
                 if name == "key":
@@ -224,6 +275,16 @@ def decode_handoff(manifest: dict, blob: bytes) -> dict:
                 block, leaf = base.rsplit("/", 1)
                 pages.setdefault(block, {})[leaf] = deq.reshape(
                     spec["shape"])
+                if spec.get("resident"):
+                    # verbatim source page bytes: an int8-resident
+                    # destination adopts these directly (zero extra
+                    # quantization error), f32 destinations use the
+                    # dequantized ``pages``
+                    shape = list(spec["shape"])
+                    pages_q8.setdefault(block, {})[leaf + "_q"] = (
+                        flat[base + "::q"].reshape(shape))
+                    pages_q8.setdefault(block, {})[leaf + "_s"] = (
+                        flat[base + "::scale"].reshape(shape[0], -1))
         out = {
             "pages": pages,
             "cursor": int(meta["cursor"]),
@@ -236,6 +297,8 @@ def decode_handoff(manifest: dict, blob: bytes) -> dict:
             "seed": meta["seed"],
             "weights_version": meta.get("weights_version"),
         }
+        if pages_q8:
+            out["pages_q8"] = pages_q8
         if fmt in _SESSION_FORMATS:
             # the remaining-budget meta is what MAKES it a session; a
             # session manifest without it is structurally broken
@@ -305,20 +368,8 @@ def encode_handoff_streamed(
     for i, block in enumerate(blocks):
         pk = _Packer()
         codec_leaves: Dict[str, dict] = {}
-        for leaf in ("k", "v"):
-            name = f"{block}/{leaf}"
-            arr = np.asarray(handoff["pages"][block][leaf])
-            if wire_format == "f32":
-                pk.put(name, arr)
-            else:
-                from chainermn_tpu.collectives.quantized import \
-                    block_quantize
-                q, s = block_quantize(arr.reshape(-1), wire_format)
-                pk.put(name + "::q", np.asarray(q))
-                pk.put(name + "::scale", np.asarray(s, np.float32))
-                codec_leaves[name] = {"shape": list(arr.shape),
-                                      "dtype": arr.dtype.name,
-                                      "size": int(arr.size)}
+        blk = _pack_page(pk, block, handoff["pages"][block],
+                         wire_format, codec_leaves)
         blob = b"".join(pk.chunks)
         digest = hashlib.sha256(blob).hexdigest()
         man: Dict[str, Any] = {
@@ -327,9 +378,8 @@ def encode_handoff_streamed(
             "bytes": len(blob), "sha256": digest, "arrays": pk.arrays,
         }
         if wire_format != "f32":
-            from chainermn_tpu.collectives.quantized import QUANT_BLOCK
             man["codec"] = {"wire_format": wire_format,
-                            "block": QUANT_BLOCK, "leaves": codec_leaves}
+                            "block": blk, "leaves": codec_leaves}
         chunks.append((man, blob))
         table.append({"layer": block, "index": i,
                       "bytes": len(blob), "sha256": digest})
@@ -397,6 +447,7 @@ def decode_handoff_streamed(closing_manifest: dict, closing_blob: bytes,
                     f"not a streamed chunk manifest: {man.get('kind')!r}")
             by_index[int(man["index"])] = (man, blob)
         pages: Dict[str, Dict[str, np.ndarray]] = {}
+        pages_q8: Dict[str, Dict[str, np.ndarray]] = {}
         for ent in table:
             idx = int(ent["index"])
             if idx not in by_index:
@@ -432,6 +483,12 @@ def decode_handoff_streamed(closing_manifest: dict, closing_blob: bytes,
                     block, leaf = base.rsplit("/", 1)
                     pages.setdefault(block, {})[leaf] = deq.reshape(
                         spec["shape"])
+                    if spec.get("resident"):
+                        shape = list(spec["shape"])
+                        pages_q8.setdefault(block, {})[leaf + "_q"] = (
+                            flat[base + "::q"].reshape(shape))
+                        pages_q8.setdefault(block, {})[leaf + "_s"] = (
+                            flat[base + "::scale"].reshape(shape[0], -1))
         meta = closing_manifest["meta"]
         key = None
         for a in closing_manifest["arrays"]:
@@ -441,7 +498,7 @@ def decode_handoff_streamed(closing_manifest: dict, closing_blob: bytes,
                                     ).reshape(a["shape"])
         if key is None:
             raise HandoffError("closing manifest carries no PRNG key")
-        return {
+        out = {
             "pages": pages,
             "cursor": int(meta["cursor"]),
             "tokens": list(meta["tokens"]),
@@ -453,6 +510,9 @@ def decode_handoff_streamed(closing_manifest: dict, closing_blob: bytes,
             "seed": meta["seed"],
             "weights_version": meta.get("weights_version"),
         }
+        if pages_q8:
+            out["pages_q8"] = pages_q8
+        return out
     except HandoffError:
         raise
     except Exception as e:   # broken manifest structure → same contract

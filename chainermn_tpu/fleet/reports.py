@@ -65,6 +65,13 @@ class FleetReport:
         self.rollouts_rolled_back = 0    # failed mid-walk → back to v1
         self.canary_failures = 0         # canary miscompare → abort
         self.rollout_wire_bytes = 0      # relay bytes shipped (all hops)
+        # speculative decoding (serving/speculative.py) — fleet-level
+        # tallies a host folds out of its engines' ServingReports so
+        # acceptance travels with the routing counters
+        self.draft_tokens_proposed = 0
+        self.draft_tokens_accepted = 0
+        self.spec_dispatches = 0
+        self.spec_tokens_emitted = 0
 
     # ----------------------------------------------------------------
     # router / pool hooks
@@ -140,6 +147,16 @@ class FleetReport:
         self.transport_dup_fenced += int(r.get("duplicates", 0))
         self.streamed_chunk_nacks += int(r.get("chunk_nacked", 0))
 
+    def record_spec(self, proposed: int, accepted: int,
+                    emitted: int, dispatches: int = 1) -> None:
+        """Fold a replica's speculative-round tallies into the fleet
+        counters (a host typically calls this once per engine with the
+        ``ServingReport`` totals, ``dispatches=spec_dispatches``)."""
+        self.draft_tokens_proposed += int(proposed)
+        self.draft_tokens_accepted += int(accepted)
+        self.spec_dispatches += int(dispatches)
+        self.spec_tokens_emitted += int(emitted)
+
     # ----------------------------------------------------------------
     # wire serialization (cross-process fleet merge)
     # ----------------------------------------------------------------
@@ -147,8 +164,9 @@ class FleetReport:
     #: bump on any change to the counter schema below
     #: (2: migration/drain counters — PR 17 session migration;
     #:  3: transport wire-health counters — PR 18 socket plane;
-    #:  4: rolling-update counters — PR 19 versioned rollout)
-    WIRE_VERSION = 4
+    #:  4: rolling-update counters — PR 19 versioned rollout;
+    #:  5: speculative-decoding counters — PR 20 draft/verify rounds)
+    WIRE_VERSION = 5
 
     def to_wire(self) -> dict:
         """Version-tagged JSON-safe envelope of the fleet counters —
@@ -177,6 +195,10 @@ class FleetReport:
                     "rollouts_rolled_back": self.rollouts_rolled_back,
                     "canary_failures": self.canary_failures,
                     "rollout_wire_bytes": self.rollout_wire_bytes,
+                    "draft_tokens_proposed": self.draft_tokens_proposed,
+                    "draft_tokens_accepted": self.draft_tokens_accepted,
+                    "spec_dispatches": self.spec_dispatches,
+                    "spec_tokens_emitted": self.spec_tokens_emitted,
                 }}
 
     @classmethod
@@ -210,6 +232,10 @@ class FleetReport:
         out.rollouts_rolled_back = int(c["rollouts_rolled_back"])
         out.canary_failures = int(c["canary_failures"])
         out.rollout_wire_bytes = int(c["rollout_wire_bytes"])
+        out.draft_tokens_proposed = int(c["draft_tokens_proposed"])
+        out.draft_tokens_accepted = int(c["draft_tokens_accepted"])
+        out.spec_dispatches = int(c["spec_dispatches"])
+        out.spec_tokens_emitted = int(c["spec_tokens_emitted"])
         return out
 
     def absorb(self, other: "FleetReport") -> None:
@@ -238,6 +264,10 @@ class FleetReport:
         self.rollouts_rolled_back += other.rollouts_rolled_back
         self.canary_failures += other.canary_failures
         self.rollout_wire_bytes += other.rollout_wire_bytes
+        self.draft_tokens_proposed += other.draft_tokens_proposed
+        self.draft_tokens_accepted += other.draft_tokens_accepted
+        self.spec_dispatches += other.spec_dispatches
+        self.spec_tokens_emitted += other.spec_tokens_emitted
 
     # ----------------------------------------------------------------
     # aggregation
@@ -259,6 +289,7 @@ class FleetReport:
         qd: List[int] = []
         occ: List[float] = []
         submitted = completed = aborted = tokens = host_bytes = 0
+        proposed = accepted = dispatches = spec_tokens = 0
         span = 0.0
         for raw in raws:
             ttft.extend(raw["ttft_s"])
@@ -270,6 +301,12 @@ class FleetReport:
             aborted += raw["aborted"]
             tokens += raw["tokens_emitted"]
             host_bytes += raw["host_bytes"]
+            # speculative ratios, like host_bytes_per_token, only merge
+            # honestly from summed numerators/denominators
+            proposed += raw.get("draft_tokens_proposed", 0)
+            accepted += raw.get("draft_tokens_accepted", 0)
+            dispatches += raw.get("spec_dispatches", 0)
+            spec_tokens += raw.get("spec_tokens_emitted", 0)
             span = max(span, raw["wall_s"])
         return {
             "replicas": len(raws),
@@ -279,6 +316,12 @@ class FleetReport:
             "tokens_per_s": tokens / span if span > 0 else float("nan"),
             "host_bytes_per_token": (host_bytes / tokens if tokens
                                      else float("nan")),
+            "acceptance_rate": (accepted / proposed if proposed
+                                else float("nan")),
+            "tokens_per_dispatch": (spec_tokens / dispatches if dispatches
+                                    else float("nan")),
+            "draft_tokens_proposed": proposed,
+            "draft_tokens_accepted": accepted,
             "ttft_ms": _dist_ms(ttft),
             "itl_ms": _dist_ms(gaps),
             "queue_depth": {"mean": (sum(qd) / len(qd) if qd
@@ -314,6 +357,19 @@ class FleetReport:
                 "rolled_back": self.rollouts_rolled_back,
                 "canary_failures": self.canary_failures,
                 "wire_bytes": self.rollout_wire_bytes,
+            },
+            "speculative": {
+                "draft_tokens_proposed": self.draft_tokens_proposed,
+                "draft_tokens_accepted": self.draft_tokens_accepted,
+                "spec_dispatches": self.spec_dispatches,
+                "spec_tokens_emitted": self.spec_tokens_emitted,
+                "acceptance_rate": (
+                    self.draft_tokens_accepted
+                    / self.draft_tokens_proposed
+                    if self.draft_tokens_proposed else float("nan")),
+                "tokens_per_dispatch": (
+                    self.spec_tokens_emitted / self.spec_dispatches
+                    if self.spec_dispatches else float("nan")),
             },
         }
         return out

@@ -7,7 +7,8 @@ with donation), ``sampling`` is the on-device token sampler those
 programs compile in, ``engine`` is the single-threaded scheduler
 (slots, token-budget admission, retirement), ``frontend`` is the
 thread-safe client face (futures,
-RpcPolicy deadlines, watchdog-bounded aborts), ``reports`` is the
+RpcPolicy deadlines, watchdog-bounded aborts), ``speculative`` is the
+draft-propose/target-verify engine subclass, ``reports`` is the
 telemetry sibling of ``training/reports.py``, and ``weights`` is the
 warm-restart snapshot plane. See docs/serving.md.
 """
@@ -22,8 +23,10 @@ from chainermn_tpu.serving.kv_cache import (ServingStep, cache_bytes,
                                             prefill_apply,
                                             prefill_chunk_apply)
 from chainermn_tpu.serving.reports import ServingReport
-from chainermn_tpu.serving.sampling import (init_keys, request_key,
-                                            sample_tokens, split_keys)
+from chainermn_tpu.serving.sampling import (draft_shadow_keys, init_keys,
+                                            request_key, sample_tokens,
+                                            split_keys)
+from chainermn_tpu.serving.speculative import DraftStep, SpeculativeEngine
 from chainermn_tpu.serving.weights import (WeightsError, load_weights,
                                            publish_weights, pull_weights,
                                            weight_candidates)
@@ -35,7 +38,9 @@ __all__ = [
     "decode_k_apply", "init_cache", "prefill_apply",
     "prefill_chunk_apply",
     "ServingReport",
-    "init_keys", "request_key", "sample_tokens", "split_keys",
+    "DraftStep", "SpeculativeEngine",
+    "draft_shadow_keys", "init_keys", "request_key", "sample_tokens",
+    "split_keys",
     "WeightsError", "load_weights", "publish_weights", "pull_weights",
     "weight_candidates",
 ]

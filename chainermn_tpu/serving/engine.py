@@ -95,6 +95,11 @@ class EngineConfig:
     #                                      None → unbounded
     max_prefill_defer: int = 4        # iterations prefill may yield to
     #                                   decode before it runs anyway
+    kv_dtype: Optional[str] = None    # page storage mode: None/'f32' or
+    #                                   'int8-block' (kv_cache.py — int8
+    #                                   pages forbid ring wrap, so submit
+    #                                   enforces prompt + max_new ≤
+    #                                   capacity)
 
     def bucket_table(self) -> Tuple[int, ...]:
         return (tuple(sorted(self.buckets)) if self.buckets
@@ -150,7 +155,8 @@ class Engine:
             raise ValueError("prefill_chunk must be >= 1")
         self.steps = ServingStep(
             model, params, config.n_slots, config.capacity,
-            cache_dtype=config.cache_dtype, mesh=mesh, axis=axis)
+            cache_dtype=config.cache_dtype, mesh=mesh, axis=axis,
+            kv_dtype=config.kv_dtype)
         self.report = report or (ServingReport(time_fn) if time_fn
                                  else ServingReport())
         self.queue: deque[Request] = deque()
@@ -204,10 +210,16 @@ class Engine:
             raise ValueError(
                 f"prompt length {prompt.size} exceeds the largest prefill "
                 f"bucket ({self._buckets[-1]})")
+        budget = (max_new_tokens if max_new_tokens is not None
+                  else self.config.max_new_tokens)
+        if (self.steps.kv_dtype == "int8-block"
+                and prompt.size + budget > self.config.capacity):
+            raise ValueError(
+                f"int8-block pages forbid ring wrap: prompt ({prompt.size})"
+                f" + max_new_tokens ({budget}) exceeds the page capacity "
+                f"({self.config.capacity})")
         req = Request(request_id=next(self._ids), prompt=prompt,
-                      max_new_tokens=(max_new_tokens
-                                      if max_new_tokens is not None
-                                      else self.config.max_new_tokens),
+                      max_new_tokens=budget,
                       eos_id=eos_id, temperature=temperature,
                       top_k=top_k, seed=seed, hold=hold)
         self.queue.append(req)
@@ -421,8 +433,15 @@ class Engine:
         # per sampled token so far) — never re-derive from the seed
         self._keys = self._keys.at[slot].set(
             jnp.asarray(handoff["key"], jnp.uint32))
-        self.steps.import_slot(slot, handoff["pages"],
-                               int(handoff["cursor"]))
+        # a wire-decoded handoff from an int8-resident source carries
+        # the verbatim codes next to the dequantized pages — an int8
+        # destination adopts those bytes directly (zero extra
+        # quantization error, fleet/handoff.py)
+        pages = handoff["pages"]
+        if (self.steps.kv_dtype == "int8-block"
+                and handoff.get("pages_q8")):
+            pages = handoff["pages_q8"]
+        self.steps.import_slot(slot, pages, int(handoff["cursor"]))
         last = req.tokens[-1]
         hit_eos = req.eos_id is not None and last == req.eos_id
         if hit_eos or len(req.tokens) >= req.max_new_tokens:
@@ -498,6 +517,24 @@ class Engine:
     # scheduler iterations
     # ----------------------------------------------------------------
 
+    def _max_decode_advance(self) -> int:
+        """Cache columns one decode iteration may write per slot — the
+        wrap guard's and token budget's reservation unit. The base
+        engine advances ``decode_k``; ``speculative.SpeculativeEngine``
+        overrides this with its verify width (``spec_k + 1``)."""
+        return self.config.decode_k
+
+    def _on_prefill(self, tokens, lengths, slot_ids) -> None:
+        """Subclass hook, fired after every monolithic prefill dispatch
+        with the cohort's host-side arrays (sentinel rows included).
+        ``SpeculativeEngine`` mirrors the prompts into the draft model's
+        pages here; the base engine does nothing."""
+
+    def _on_prefill_chunk(self, tokens, starts, valid, slot_ids,
+                          final) -> None:
+        """Chunked twin of :meth:`_on_prefill` — fired after every
+        chunk dispatch with that dispatch's host-side arrays."""
+
     def _admit(self, avail: float) -> int:
         """One monolithic prefill cohort: same-bucket FIFO prompts into
         free slots, first token sampled on device."""
@@ -529,6 +566,7 @@ class Engine:
         tok, self._keys = self.steps.prefill_sampled(
             tokens, lengths, slot_ids, self._keys, self._temps,
             self._topks)
+        self._on_prefill(tokens, lengths, slot_ids)
         first = np.asarray(tok)                 # [S] int32 — ids, never logits
         self.report.record_host_bytes(first.nbytes)
         for i, req in enumerate(cohort):
@@ -553,7 +591,8 @@ class Engine:
         while True:
             forced = sorted(
                 slot for slot, r in self.prefilling.items()
-                if r.prefill_pos + cfg.decode_k > self.steps.capacity)
+                if (r.prefill_pos + self._max_decode_advance()
+                    > self.steps.capacity))
             if not forced:
                 if not (self.prefilling
                         or (self.queue and self.free_slots)):
@@ -610,6 +649,7 @@ class Engine:
         tok, self._keys = self.steps.prefill_chunk(
             tokens, starts, valid, sids, final, self._keys, self._temps,
             self._topks)
+        self._on_prefill_chunk(tokens, starts, valid, sids, final)
         first = np.asarray(tok)                 # [S] int32 ids (-1 = not final)
         self.report.record_host_bytes(first.nbytes)
         for i, (slot, req) in enumerate(cohort):
@@ -663,7 +703,7 @@ class Engine:
         self.iteration += 1
         budget = self.config.token_budget
         avail = (float("inf") if budget is None
-                 else budget - len(self.active) * self.config.decode_k)
+                 else budget - len(self.active) * self._max_decode_advance())
         if self.config.prefill_chunk is not None:
             admitted = self._advance_prefill_chunks(avail)
         else:

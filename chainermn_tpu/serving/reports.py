@@ -23,7 +23,9 @@ __all__ = ["ServingReport", "ReceivedServingReport", "percentile",
 #: version tag on every serialized report envelope — bump on any change
 #: to the ``raw()`` schema so a mixed-version fleet fails loudly instead
 #: of merging mis-shaped telemetry
-REPORT_WIRE_VERSION = 1
+#: (1 → 2: speculative-decoding counters — draft_tokens_proposed/
+#: accepted, spec_dispatches, spec_tokens_emitted)
+REPORT_WIRE_VERSION = 2
 
 
 def percentile(samples: List[float], q: float) -> float:
@@ -55,6 +57,12 @@ class ServingReport:
         self.aborted = 0
         self.tokens_emitted = 0
         self.host_bytes = 0           # device→host bytes on the emit path
+        # speculative decoding (serving/speculative.py): per-slot round
+        # counters — acceptance_rate and tokens_per_dispatch in summary()
+        self.draft_tokens_proposed = 0
+        self.draft_tokens_accepted = 0
+        self.spec_dispatches = 0      # one per (slot, round) pair
+        self.spec_tokens_emitted = 0
         self.ttft_s: List[float] = []
         self.token_gap_s: List[float] = []
         self.queue_depth_samples: List[int] = []
@@ -109,6 +117,21 @@ class ServingReport:
         ≤ 8 bytes/token; the old full-logits pull was ``vocab × 4``)."""
         self.host_bytes += int(nbytes)
 
+    def record_spec_round(self, proposed: int, accepted: int,
+                          emitted: int) -> None:
+        """One speculative round for ONE slot (the engine calls this per
+        live slot per propose+verify round): ``proposed`` draft tokens
+        went into the verify chunk, ``accepted`` matched the target's
+        own samples, and ``emitted`` tokens entered the stream
+        (``accepted + 1`` normally — the round's last token is always
+        target-sampled: correction, bonus, or terminal). The ratios an
+        operator sizes the draft model by — ``acceptance_rate`` and
+        ``tokens_per_dispatch`` — fold out of these in ``summary()``."""
+        self.draft_tokens_proposed += int(proposed)
+        self.draft_tokens_accepted += int(accepted)
+        self.spec_dispatches += 1
+        self.spec_tokens_emitted += int(emitted)
+
     # ----------------------------------------------------------------
     # output
     # ----------------------------------------------------------------
@@ -133,6 +156,10 @@ class ServingReport:
             "aborted": self.aborted,
             "tokens_emitted": self.tokens_emitted,
             "host_bytes": self.host_bytes,
+            "draft_tokens_proposed": self.draft_tokens_proposed,
+            "draft_tokens_accepted": self.draft_tokens_accepted,
+            "spec_dispatches": self.spec_dispatches,
+            "spec_tokens_emitted": self.spec_tokens_emitted,
             "wall_s": span,
         }
 
@@ -160,6 +187,19 @@ class ServingReport:
             "host_bytes_per_token": (self.host_bytes / self.tokens_emitted
                                      if self.tokens_emitted
                                      else float("nan")),
+            # speculative decoding: fraction of draft proposals the
+            # target's own samples confirmed, and how many tokens a
+            # (slot, round) pair advances — > 1 is the whole point
+            "acceptance_rate": (self.draft_tokens_accepted
+                                / self.draft_tokens_proposed
+                                if self.draft_tokens_proposed
+                                else float("nan")),
+            "tokens_per_dispatch": (self.spec_tokens_emitted
+                                    / self.spec_dispatches
+                                    if self.spec_dispatches
+                                    else float("nan")),
+            "draft_tokens_proposed": self.draft_tokens_proposed,
+            "draft_tokens_accepted": self.draft_tokens_accepted,
             "ttft_ms": self._dist_ms(self.ttft_s),
             # inter-token latency — the standard serving-benchmark name
             # for the same per-request token-gap distribution
@@ -214,7 +254,11 @@ class ReceivedServingReport:
         missing = [k for k in ("ttft_s", "token_gap_s",
                                "queue_depth_samples", "occupancy_samples",
                                "submitted", "completed", "aborted",
-                               "tokens_emitted", "host_bytes", "wall_s")
+                               "tokens_emitted", "host_bytes",
+                               "draft_tokens_proposed",
+                               "draft_tokens_accepted",
+                               "spec_dispatches", "spec_tokens_emitted",
+                               "wall_s")
                    if k not in raw]
         if missing:
             raise ValueError(

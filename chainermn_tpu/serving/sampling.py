@@ -30,7 +30,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["request_key", "init_keys", "split_keys", "sample_tokens"]
+__all__ = ["request_key", "init_keys", "split_keys", "sample_tokens",
+           "draft_shadow_keys"]
 
 
 def request_key(seed: int):
@@ -85,3 +86,24 @@ def sample_tokens(logits, keys, temperature, top_k):
 
     tokens = jnp.where(temperature > 0, sampled, greedy)
     return tokens, new_keys
+
+
+def draft_shadow_keys(keys):
+    """SHADOW copy of the target's per-slot keys for a speculative
+    draft pass (serving/speculative.py).
+
+    The draft model proposes tokens by sampling with the SAME key
+    values, at the same stream positions, that the target will use to
+    verify — that alignment is what makes the Gumbel-max categorical
+    draws coincide whenever draft and target logits are close, so
+    sampled-mode acceptance is nonzero. The shadow is discarded after
+    every speculative round: only the verify pass advances the REAL key
+    rows, exactly one split per emitted token, which is what keeps
+    accepted streams bitwise-identical to non-speculative decode and
+    keeps migration/replay contracts intact.
+
+    A draft-sampled token must NEVER be committed from this shadow
+    stream without a verify pass blessing it — dlint DL125
+    (draft-target-key-confusion) flags exactly that dataflow.
+    """
+    return jnp.asarray(keys, jnp.uint32).copy()

@@ -26,6 +26,7 @@ def test_registry_has_every_documented_rule():
             "DL107", "DL108", "DL109", "DL110", "DL111", "DL112",
             "DL113", "DL114", "DL115", "DL116", "DL117", "DL118",
             "DL119", "DL120", "DL121", "DL122", "DL123", "DL124",
+            "DL125",
             "DL201", "DL202", "DL203", "DL204"} <= set(RULES)
     for rule in RULES.values():
         assert rule.doc.startswith("docs/static_analysis.md#")
@@ -33,7 +34,7 @@ def test_registry_has_every_documented_rule():
     assert {r for r, rule in RULES.items()
             if rule.kind == "project"} \
         == {"DL113", "DL114", "DL115", "DL116",
-            "DL118", "DL119", "DL120", "DL121", "DL122"}
+            "DL118", "DL119", "DL120", "DL121", "DL122", "DL125"}
 
 
 # ---------------------------------------------------------------------------

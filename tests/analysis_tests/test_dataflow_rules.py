@@ -1,4 +1,4 @@
-"""dlint dataflow-rule fixtures (DL118–DL122): every rule trips on a
+"""dlint dataflow-rule fixtures (DL118–DL122, DL125): every rule trips on a
 seeded violation and stays quiet on its clean twin — the contract the
 catalogue rows in docs/static_analysis.md promise.
 
@@ -531,6 +531,92 @@ def test_dl122_clean_uncompiled_function():
 
 
 # ---------------------------------------------------------------------------
+# DL125 — draft-target-key-confusion
+# ---------------------------------------------------------------------------
+
+
+def test_dl125_flags_unverified_draft_commit():
+    src = """\
+    from chainermn_tpu.serving.sampling import draft_shadow_keys, \\
+        sample_tokens
+
+    def round(self, req, logits, keys, temps, topks):
+        shadow = draft_shadow_keys(keys)
+        tok, shadow = sample_tokens(logits, shadow, temps, topks)
+        self._emit(req, tok)
+    """
+    fs = _only(_lint(src), "DL125")
+    assert len(fs) == 1
+    assert fs[0].line == 7
+    assert "'tok'" in fs[0].message
+    assert "docs/static_analysis.md#dl125" in fs[0].message
+
+
+def test_dl125_flags_commit_of_rebound_shadow_sample():
+    # the shadow key advanced through sample_tokens stays a shadow key:
+    # the SECOND draw is just as unverified as the first
+    src = """\
+    from chainermn_tpu.serving.sampling import draft_shadow_keys, \\
+        sample_tokens
+
+    def round(self, out, logits, keys, temps, topks):
+        shadow = draft_shadow_keys(keys)
+        d1, shadow = sample_tokens(logits, shadow, temps, topks)
+        d2, shadow = sample_tokens(logits, shadow, temps, topks)
+        out.append(d2)
+    """
+    fs = _only(_lint(src), "DL125")
+    assert len(fs) == 1
+    assert "'d2'" in fs[0].message
+
+
+def test_dl125_clean_verified_draft_commit():
+    src = """\
+    from chainermn_tpu.serving.sampling import draft_shadow_keys, \\
+        sample_tokens
+
+    def round(self, req, logits, keys, temps, topks):
+        shadow = draft_shadow_keys(keys)
+        tok, shadow = sample_tokens(logits, shadow, temps, topks)
+        ok = self.verify_apply(tok)
+        if ok:
+            self._emit(req, tok)
+    """
+    assert _only(_lint(src), "DL125") == []
+
+
+def test_dl125_clean_real_key_sampling():
+    src = """\
+    from chainermn_tpu.serving.sampling import sample_tokens
+
+    def round(self, req, logits, keys, temps, topks):
+        tok, keys = sample_tokens(logits, keys, temps, topks)
+        self._emit(req, tok)
+    """
+    assert _only(_lint(src), "DL125") == []
+
+
+def test_dl125_clean_verify_on_one_branch_only_still_flags():
+    # blessing must hold on EVERY path reaching the commit — a verify
+    # on one branch does not sanctify the other
+    src = """\
+    from chainermn_tpu.serving.sampling import draft_shadow_keys, \\
+        sample_tokens
+
+    def round(self, req, logits, keys, temps, topks, fast):
+        shadow = draft_shadow_keys(keys)
+        tok, shadow = sample_tokens(logits, shadow, temps, topks)
+        if fast:
+            pass
+        else:
+            self.verify_apply(tok)
+        self._emit(req, tok)
+    """
+    fs = _only(_lint(src), "DL125")
+    assert len(fs) == 1
+
+
+# ---------------------------------------------------------------------------
 # the repo itself, per rule — the finding-or-clean acceptance check
 # ---------------------------------------------------------------------------
 
@@ -543,11 +629,12 @@ _ROOTS = [os.path.join(_REPO, d)
 @pytest.fixture(scope="module")
 def dataflow_repo_run():
     return run_lint(_ROOTS,
-                    rules=["DL118", "DL119", "DL120", "DL121", "DL122"])
+                    rules=["DL118", "DL119", "DL120", "DL121", "DL122",
+                           "DL125"])
 
 
 @pytest.mark.parametrize("rule", ["DL118", "DL119", "DL120", "DL121",
-                                  "DL122"])
+                                  "DL122", "DL125"])
 def test_repo_is_clean_per_dataflow_rule(dataflow_repo_run, rule):
     fs = _only(dataflow_repo_run.findings, rule)
     assert fs == [], "\n" + "\n".join(f.format() for f in fs)
@@ -556,4 +643,4 @@ def test_repo_is_clean_per_dataflow_rule(dataflow_repo_run, rule):
 def test_repo_run_exercised_every_dataflow_pass(dataflow_repo_run):
     # the clean verdict above is only meaningful if the passes ran
     assert {"DL118", "DL119", "DL120", "DL121",
-            "DL122"} <= set(dataflow_repo_run.timings)
+            "DL122", "DL125"} <= set(dataflow_repo_run.timings)

@@ -303,3 +303,120 @@ def test_streamed_refuses_session_exports():
     handoff["max_new_tokens"] = 5      # session migration: whole or not at all
     with pytest.raises(ValueError, match="migrate whole"):
         encode_handoff_streamed(handoff, "f32")
+
+
+# ---------------------------------------------------------------------------
+# int8-resident sources: pages already quantized on the exporting engine
+# ship their codes and scales VERBATIM — re-quantizing would stack a
+# second rounding error on top of the one the slot already paid
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _resident_handoff(seed=0):
+    model, params = _setup()
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=10,
+                       prefill_cohort=1, buckets=[8, 32],
+                       kv_dtype="int8-block")
+    eng = Engine(model, params, cfg)
+    rng = np.random.RandomState(seed)
+    prompt = rng.randint(0, VOCAB, (5,)).astype(np.int32)
+    req = eng.submit(prompt, max_new_tokens=4, temperature=0.8, top_k=6,
+                     seed=3, hold=True)
+    eng.run_until_drained()
+    handoff = eng.export_handoff(req)
+    eng.release_held(req)
+    return handoff, prompt
+
+
+_RESIDENT_LEAVES = ("k_q", "k_s", "v_q", "v_s")
+
+
+def test_resident_wire_bytes_are_verbatim():
+    """The quantized wire IS the resident pages: blob == the source's
+    code/scale bytes (packer order per block: k codes, k scales,
+    v codes, v scales) + the PRNG key tail. No transform, no extra
+    quantization error — bitwise by construction."""
+    handoff, _prompt = _resident_handoff()
+    manifest, blob = encode_handoff(handoff, "int8-block")
+    resident = b"".join(
+        np.ascontiguousarray(np.asarray(handoff["pages"][b][leaf])).tobytes()
+        for b in sorted(handoff["pages"]) for leaf in _RESIDENT_LEAVES)
+    key_tail = np.ascontiguousarray(
+        np.asarray(handoff["key"], np.uint32)).tobytes()
+    assert blob == resident + key_tail
+    # the manifest advertises the PAGE block, not the wire default
+    some_page = next(iter(handoff["pages"].values()))
+    page_block = (np.asarray(some_page["k_q"]).size
+                  // np.asarray(some_page["k_s"]).size)
+    assert manifest["codec"]["block"] == page_block
+
+
+def test_resident_pages_q8_roundtrip_bitwise():
+    handoff, _prompt = _resident_handoff()
+    manifest, blob = encode_handoff(handoff, "int8-block")
+    out = decode_handoff(manifest, blob)
+    assert "pages_q8" in out
+    for blk in out["pages_q8"]:
+        for leaf in _RESIDENT_LEAVES:
+            np.testing.assert_array_equal(
+                out["pages_q8"][blk][leaf],
+                np.asarray(handoff["pages"][blk][leaf]))
+
+
+def test_resident_adoption_continues_bitwise():
+    """int8 source → wire → int8 destination adopts the codes verbatim,
+    so the continued stream equals a fresh int8 engine's stream exactly
+    (the zero-extra-error observable)."""
+    model, params = _setup()
+    handoff, prompt = _resident_handoff()
+    manifest, blob = encode_handoff(handoff, "int8-block")
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=10,
+                       prefill_cohort=1, buckets=[8, 32],
+                       kv_dtype="int8-block")
+    dst = Engine(model, params, cfg)
+    adopted = dst.import_handoff(decode_handoff(manifest, blob), prompt,
+                                 max_new_tokens=8)
+    dst.run_until_drained()
+    ref_eng = Engine(model, params, cfg)
+    ref = ref_eng.submit(prompt, max_new_tokens=8, temperature=0.8,
+                         top_k=6, seed=3)
+    ref_eng.run_until_drained()
+    assert adopted.tokens == ref.tokens
+
+
+def test_raw_format_from_resident_source_dequantizes_once():
+    """An f32 wire from an int8 source carries ONE dequantization — the
+    same values an int8 wire's decoder reconstructs."""
+    handoff, _prompt = _resident_handoff()
+    m_raw, b_raw = encode_handoff(handoff, "f32")
+    raw = decode_handoff(m_raw, b_raw)
+    assert "pages_q8" not in raw
+    m_q, b_q = encode_handoff(handoff, "int8-block")
+    quant = decode_handoff(m_q, b_q)
+    for blk in handoff["pages"]:
+        for leaf in ("k", "v"):
+            np.testing.assert_array_equal(raw["pages"][blk][leaf],
+                                          quant["pages"][blk][leaf])
+
+
+def test_streamed_resident_roundtrip_bitwise():
+    handoff, _prompt = _resident_handoff()
+    chunks, closing, closing_blob = encode_handoff_streamed(
+        handoff, "int8-block")
+    out = decode_handoff_streamed(closing, closing_blob, chunks)
+    for blk in out["pages_q8"]:
+        for leaf in _RESIDENT_LEAVES:
+            np.testing.assert_array_equal(
+                out["pages_q8"][blk][leaf],
+                np.asarray(handoff["pages"][blk][leaf]))
+
+
+def test_f32_source_wire_is_unchanged_by_resident_support():
+    """Regression: an f32 source still quantizes at the wire with the
+    stock codec block and never grows a pages_q8 face."""
+    handoff, _prompt = _handoff()
+    manifest, blob = encode_handoff(handoff, "int8-block")
+    assert manifest["codec"]["block"] == QUANT_BLOCK
+    out = decode_handoff(manifest, blob)
+    assert "pages_q8" not in out

@@ -104,6 +104,8 @@ def test_counters_and_summary_shape():
                         receiver_stats={"duplicates": 2,
                                         "chunk_nacked": 1},
                         plane_stats={"reconnects": 4})
+    fr.record_spec(8, 6, 7)
+    fr.record_spec(4, 1, 2)
     ra = _report([0.001], tokens=5, host_bytes=20, span_s=1.0)
     out = fr.summary([ra])
     assert out["fleet"] == {
@@ -117,6 +119,12 @@ def test_counters_and_summary_shape():
                       "dup_fenced": 2, "chunk_nacks": 1},
         "rollouts": {"completed": 0, "rolled_back": 0,
                      "canary_failures": 0, "wire_bytes": 0},
+        "speculative": {"draft_tokens_proposed": 12,
+                        "draft_tokens_accepted": 7,
+                        "spec_dispatches": 2,
+                        "spec_tokens_emitted": 9,
+                        "acceptance_rate": 7 / 12,
+                        "tokens_per_dispatch": 4.5},
     }
     assert out["replicas"] == 1
     assert np.isfinite(out["tokens_per_s"])
@@ -185,6 +193,37 @@ def test_fleet_report_wire_round_trip_and_absorb():
     assert b.rejected == 1 and b.requeued == 2
     assert b.handoffs == 3 and b.handoff_fallbacks == 1
     assert b.handoff_wire_bytes == {"f32": 600, "int8-block": 60}
+
+
+def test_fleet_spec_counters_round_trip_and_absorb():
+    a = FleetReport()
+    a.record_spec(8, 6, 7)
+    wire = json.loads(json.dumps(a.to_wire()))
+    b = FleetReport.from_wire(wire)
+    assert b.to_wire() == a.to_wire()
+    host2 = FleetReport()
+    host2.record_spec(4, 1, 2)
+    b.absorb(host2)
+    assert b.draft_tokens_proposed == 12
+    assert b.draft_tokens_accepted == 7
+    assert b.spec_dispatches == 2
+    assert b.spec_tokens_emitted == 9
+
+
+def test_merge_pools_spec_counters_from_replica_raws():
+    """Acceptance rate must come from SUMMED proposals/accepts, not a
+    mean of per-replica rates (a 1-round replica would weigh as much
+    as a 1000-round one)."""
+    ra = _report([0.001], tokens=5, host_bytes=20, span_s=1.0)
+    ra.record_spec_round(4, 4, 5)
+    rb = _report([0.001], tokens=5, host_bytes=20, span_s=1.0)
+    rb.record_spec_round(4, 0, 1)
+    rb.record_spec_round(4, 2, 3)
+    merged = FleetReport.merge([ra, rb])
+    assert merged["draft_tokens_proposed"] == 12
+    assert merged["draft_tokens_accepted"] == 6
+    assert merged["acceptance_rate"] == 0.5
+    assert merged["tokens_per_dispatch"] == 3.0
 
 
 def test_fleet_report_wire_rejects_skew():

@@ -61,6 +61,48 @@ def test_decode_bitwise_matches_full_forward(kw):
                                       full[:, lp - 1 + t])
 
 
+def test_int8_pages_logit_error_calibrated_with_f32_control():
+    """int8-block pages perturb decode logits by no more than a small
+    multiple of the pages' own quantization step — and the CONTROL is
+    bitwise: the f32-page step driven by the same token stream equals
+    the full-forward oracle exactly, so whatever deviation the int8 run
+    shows is quantization and nothing else."""
+    model = _model(pos_emb="rope", n_layers=1)
+    b, lp, n_new = 1, 6, 4
+    prompt, params = _setup(model, b, lp)
+    f32 = ServingStep(model, params, n_slots=b, capacity=16)
+    q8 = ServingStep(model, params, n_slots=b, capacity=16,
+                     kv_dtype="int8-block")
+    full_jit = jax.jit(lambda p, t: model.apply({"params": p}, t))
+
+    # ONE token stream drives all three: greedy off the f32 logits
+    rows_f = [f32.prefill(prompt, [lp] * b, list(range(b)))]
+    rows_q = [q8.prefill(prompt, [lp] * b, list(range(b)))]
+    toks = jnp.asarray(prompt, jnp.int32)
+    for _ in range(n_new):
+        nxt = jnp.argmax(rows_f[-1], -1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        rows_f.append(f32.decode(nxt))
+        rows_q.append(q8.decode(nxt))
+
+    full = np.asarray(full_jit(params, toks))
+    for t, row in enumerate(rows_f):               # the bitwise control
+        np.testing.assert_array_equal(np.asarray(row), full[:, lp - 1 + t])
+
+    # calibrated bound: half the coarsest per-block scale the resident
+    # pages actually hold, amplified by a safety factor for the layers'
+    # worth of softmax/matmul mixing (same convention as the handoff
+    # wire-codec test)
+    max_step = 0.0
+    for page in q8.export_slot(0, int(q8.cursors()[0])).values():
+        for leaf in ("k_s", "v_s"):
+            max_step = max(max_step,
+                           float(np.abs(np.asarray(page[leaf])).max()) / 2)
+    worst = max(np.abs(np.asarray(rq) - np.asarray(rf)).max()
+                for rf, rq in zip(rows_f, rows_q))
+    assert 0 < worst <= 10 * max_step, (worst, max_step)
+
+
 def test_per_slot_cursors_advance_independently():
     """Slots prefilled at different depths decode against their own
     positions: each slot's logits bitwise-match a single-slot run."""

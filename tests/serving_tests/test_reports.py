@@ -68,3 +68,33 @@ def test_empty_report_is_well_formed():
     assert math.isnan(s["tokens_per_s"])
     assert math.isnan(s["ttft_ms"]["p50"])
     assert s["queue_depth"]["max"] == 0
+    assert math.isnan(s["acceptance_rate"])
+    assert math.isnan(s["tokens_per_dispatch"])
+
+
+def test_speculative_counters_and_ratios():
+    rep = ServingReport(time_fn=Clock())
+    # full accept of k=4 (5 emitted: 4 drafts + bonus), then a round
+    # rejected at the first draft (1 emitted: the corrected token)
+    rep.record_spec_round(4, 4, 5)
+    rep.record_spec_round(4, 0, 1)
+    s = rep.summary()
+    assert s["draft_tokens_proposed"] == 8
+    assert s["draft_tokens_accepted"] == 4
+    assert s["acceptance_rate"] == 0.5
+    assert s["tokens_per_dispatch"] == 3.0
+
+
+def test_speculative_counters_survive_the_wire():
+    rep = ServingReport(time_fn=Clock())
+    rep.record_submit(0)
+    rep.record_token(0)
+    rep.record_spec_round(3, 2, 3)
+    wire = json.loads(json.dumps(rep.to_wire()))
+    back = ServingReport.from_wire(wire)
+    assert back.raw() == rep.raw()
+    raw = back.raw()
+    assert raw["draft_tokens_proposed"] == 3
+    assert raw["draft_tokens_accepted"] == 2
+    assert raw["spec_dispatches"] == 1
+    assert raw["spec_tokens_emitted"] == 3

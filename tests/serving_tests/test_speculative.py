@@ -1,0 +1,240 @@
+"""Speculative decoding: the acceptance machinery must be invisible.
+
+The subsystem's whole contract (serving/speculative.py) is that for any
+scheduler shape and any sampling mode the emitted streams are
+bit-identical to the plain engine's — greedy AND sampled — because the
+draft's shadow keys coincide with the target's stream positions and the
+verify pass replays the one-split-per-sampled-token discipline exactly.
+These tests pin that equivalence against the non-speculative ``Engine``
+as the oracle, then check the operator-facing surface: trace counts,
+acceptance telemetry, determinism, and the admission guards.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu.serving.engine import Engine, EngineConfig
+from chainermn_tpu.serving.speculative import SpeculativeEngine
+from chainermn_tpu.models.transformer import TransformerLM
+
+
+def _model(n_layers=2, seed=0):
+    m = TransformerLM(vocab=43, d_model=32, n_heads=4, n_layers=n_layers,
+                      d_ff=48, max_len=64, attention="reference",
+                      pos_emb="rope")
+    p = m.init(jax.random.PRNGKey(seed),
+               jnp.zeros((1, 4), jnp.int32))["params"]
+    return m, p
+
+
+@pytest.fixture(scope="module")
+def models():
+    tgt, tp = _model(n_layers=2, seed=0)
+    dr, dp = _model(n_layers=1, seed=1)
+    return tgt, tp, dr, dp
+
+
+def _prompts(seed=0, lens=(3, 4, 5, 4)):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 43, (n,)).astype(np.int32) for n in lens]
+
+
+def _submit_all(eng, prompts, kws):
+    return [eng.submit(p, **kw) for p, kw in zip(prompts, kws)]
+
+
+_MODES = {
+    "greedy": lambda i: {},
+    "sampled": lambda i: dict(temperature=0.9, top_k=8, seed=100 + i),
+    "mixed": lambda i: ({} if i % 2 == 0
+                        else dict(temperature=0.8, top_k=6, seed=100 + i)),
+}
+
+
+@pytest.mark.parametrize("mode", sorted(_MODES))
+@pytest.mark.parametrize("kv_dtype", [None, "int8-block"])
+def test_spec_stream_is_bitwise_vs_oracle(models, mode, kv_dtype):
+    tgt, tp, dr, dp = models
+    prompts = _prompts()
+    kws = [_MODES[mode](i) for i in range(len(prompts))]
+    cfg = EngineConfig(n_slots=2, capacity=32, max_new_tokens=7,
+                       prefill_cohort=1, buckets=[8, 32],
+                       kv_dtype=kv_dtype)
+    oracle = Engine(tgt, tp, cfg)
+    spec = SpeculativeEngine(tgt, tp, dr, dp, cfg, spec_k=3)
+    o = _submit_all(oracle, prompts, kws)
+    s = _submit_all(spec, prompts, kws)
+    oracle.run_until_drained()
+    spec.run_until_drained()
+    for i, (a, b) in enumerate(zip(o, s)):
+        assert a.tokens == b.tokens, (mode, kv_dtype, i)
+    # DL108 discipline holds for the new dispatches too: ONE propose
+    # program, ONE verify program across every round of every request
+    assert spec.draft.propose_traces == 1
+    assert spec.verify_traces == 1
+
+
+@pytest.mark.parametrize("cfg_kw, spec_k", [
+    # chunked prefill shares pages with the catch-up chunk path
+    (dict(buckets=[32], prefill_chunk=2, max_new_tokens=6), 2),
+    # per-iteration token budget reorders admission, never the streams
+    (dict(buckets=[8, 32], max_new_tokens=6, token_budget=8), 3),
+    # the oracle running classic one-token decode is the same stream
+    (dict(buckets=[8, 32], max_new_tokens=6, decode_k=1), 3),
+])
+def test_spec_parity_across_scheduler_shapes(models, cfg_kw, spec_k):
+    tgt, tp, dr, dp = models
+    prompts = _prompts()
+    cfg = EngineConfig(n_slots=2, capacity=32, prefill_cohort=1, **cfg_kw)
+    oracle = Engine(tgt, tp, cfg)
+    spec = SpeculativeEngine(tgt, tp, dr, dp, cfg, spec_k=spec_k)
+    kws = [dict(temperature=0.7, top_k=5, seed=7) for _ in prompts]
+    o = _submit_all(oracle, prompts, kws)
+    s = _submit_all(spec, prompts, kws)
+    oracle.run_until_drained()
+    spec.run_until_drained()
+    for a, b in zip(o, s):
+        assert a.tokens == b.tokens
+
+
+def test_spec_eos_retirement_parity(models):
+    tgt, tp, dr, dp = models
+    prompt = _prompts()[0]
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=8,
+                       prefill_cohort=1, buckets=[8, 32])
+    probe = Engine(tgt, tp, cfg)
+    r = probe.submit(prompt)
+    probe.run_until_drained()
+    eos = r.tokens[3]                  # a token the stream actually emits
+    oracle = Engine(tgt, tp, cfg)
+    spec = SpeculativeEngine(tgt, tp, dr, dp, cfg, spec_k=4)
+    a = oracle.submit(prompt, eos_id=eos)
+    b = spec.submit(prompt, eos_id=eos)
+    oracle.run_until_drained()
+    spec.run_until_drained()
+    assert a.tokens == b.tokens
+    assert len(b.tokens) < 8           # eos actually cut the stream
+
+
+def test_self_draft_accepts_everything(models):
+    """Draft == target is the acceptance ceiling: identical weights on
+    identical mirrored pages produce identical proposals, so every
+    round emits the full spec_k + 1 window."""
+    tgt, tp, _, _ = models
+    prompt = _prompts()[0]
+    # prefill emits the first token; 1 + 2*(spec_k+1) leaves two FULL
+    # speculative rounds with no budget truncation
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=9,
+                       prefill_cohort=1, buckets=[8, 32])
+    for kw in ({}, dict(temperature=0.8, top_k=6, seed=11)):
+        spec = SpeculativeEngine(tgt, tp, tgt, tp, cfg, spec_k=3)
+        oracle = Engine(tgt, tp, cfg)
+        a = oracle.submit(prompt, **kw)
+        b = spec.submit(prompt, **kw)
+        oracle.run_until_drained()
+        spec.run_until_drained()
+        assert a.tokens == b.tokens
+        s = spec.report.summary()
+        assert s["acceptance_rate"] == 1.0
+        assert s["tokens_per_dispatch"] == 4.0
+        assert s["draft_tokens_proposed"] == 6
+        assert s["draft_tokens_accepted"] == 6
+
+
+def test_acceptance_telemetry_is_deterministic(models):
+    tgt, tp, dr, dp = models
+    prompts = _prompts()
+    cfg = EngineConfig(n_slots=2, capacity=32, max_new_tokens=7,
+                       prefill_cohort=1, buckets=[8, 32])
+
+    def run():
+        spec = SpeculativeEngine(tgt, tp, dr, dp, cfg, spec_k=3)
+        kws = [dict(temperature=0.9, top_k=8, seed=100 + i)
+               for i in range(len(prompts))]
+        reqs = _submit_all(spec, prompts, kws)
+        spec.run_until_drained()
+        raw = spec.report.raw()
+        return ([r.tokens for r in reqs],
+                {k: raw[k] for k in ("draft_tokens_proposed",
+                                     "draft_tokens_accepted",
+                                     "spec_dispatches",
+                                     "spec_tokens_emitted")})
+
+    toks1, spec1 = run()
+    toks2, spec2 = run()
+    assert toks1 == toks2
+    assert spec1 == spec2
+    assert spec1["spec_dispatches"] > 0
+    # every round emits at least the corrected token
+    assert spec1["spec_tokens_emitted"] >= spec1["spec_dispatches"]
+
+
+def test_submit_rejects_wrap_risk(models):
+    """Speculative pages never ring-wrap: the draft's lookahead must
+    fit, so admission adds spec_k to the classic budget check."""
+    tgt, tp, dr, dp = models
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=8,
+                       prefill_cohort=1, buckets=[8, 32])
+    spec = SpeculativeEngine(tgt, tp, dr, dp, cfg, spec_k=4)
+    prompt = np.arange(8, dtype=np.int32) % 43
+    spec.submit(prompt, max_new_tokens=32 - 8 - 4)        # exactly fits
+    with pytest.raises(ValueError, match="spec_k"):
+        spec.submit(prompt, max_new_tokens=32 - 8 - 4 + 1)
+
+
+def test_vocab_mismatch_rejected(models):
+    tgt, tp, _, _ = models
+    dr = TransformerLM(vocab=44, d_model=32, n_heads=4, n_layers=1,
+                       d_ff=48, max_len=64, attention="reference",
+                       pos_emb="rope")
+    dp = dr.init(jax.random.PRNGKey(1),
+                 jnp.zeros((1, 4), jnp.int32))["params"]
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=4,
+                       prefill_cohort=1, buckets=[8, 32])
+    with pytest.raises(ValueError, match="vocab"):
+        SpeculativeEngine(tgt, tp, dr, dp, cfg, spec_k=2)
+
+
+def test_spec_import_handoff_continues_bitwise(models):
+    """A held stream exported by a plain engine adopts into a
+    speculative destination (draft pages mirrored from the adopted
+    prefix) and continues exactly the source's stream."""
+    tgt, tp, dr, dp = models
+    prompt = _prompts()[0]
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=10,
+                       prefill_cohort=1, buckets=[8, 32])
+    src = Engine(tgt, tp, cfg)
+    held = src.submit(prompt, temperature=0.8, top_k=6, seed=3,
+                      max_new_tokens=4, hold=True)
+    src.run_until_drained()
+    h = src.export_handoff(held)
+    dst = SpeculativeEngine(tgt, tp, dr, dp, cfg, spec_k=3)
+    adopted = dst.import_handoff(h, prompt, max_new_tokens=8)
+    dst.run_until_drained()
+    oracle = Engine(tgt, tp, cfg)
+    ref = oracle.submit(prompt, temperature=0.8, top_k=6, seed=3,
+                        max_new_tokens=8)
+    oracle.run_until_drained()
+    assert adopted.tokens == ref.tokens
+
+
+def test_spec_import_rejects_wrap_risk(models):
+    tgt, tp, dr, dp = models
+    prompt = np.arange(8, dtype=np.int32) % 43
+    cfg = EngineConfig(n_slots=1, capacity=32, max_new_tokens=24,
+                       prefill_cohort=1, buckets=[8, 32])
+    src = Engine(tgt, tp, cfg)
+    held = src.submit(prompt, max_new_tokens=4, hold=True)
+    src.run_until_drained()
+    h = src.export_handoff(held)
+    dst = SpeculativeEngine(tgt, tp, dr, dp, cfg, spec_k=4)
+    with pytest.raises(ValueError, match="spec_k"):
+        dst.import_handoff(h, prompt, max_new_tokens=24)
+
+
+# numerics-heavy compile farm: covered nightly via the full run,
+# excluded from the tier-1 wall-clock budget
+pytestmark = pytest.mark.slow

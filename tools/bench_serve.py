@@ -4,17 +4,23 @@
 Two parts, one JSON line on stdout:
 
 1. **Cached vs full-recompute head-to-head** (the DL108 proof). The
-   same greedy decode runs THREE ways: through the paged KV cache
+   same greedy decode runs FOUR ways: through the paged KV cache
    (``serving/kv_cache.py`` — fixed shapes, ONE compiled decode
    program), as the naive full-forward recompute whose input grows
-   every token, and through the multi-token ``decode_k`` program
-   (on-device sampling, k tokens per dispatch). Trace counters
-   incremented at trace time count actual compiles; the bench
-   **asserts** ``cached_traces == 1``, ``recompute_traces ==
-   n_new_tokens``, ``decode_k_traces == 1``, identical greedy streams,
-   and ≤ 8 device→host bytes per decoded token (DL110's observable) —
-   the structural claims that hold on every backend, independent of
-   wall-clock noise — and exits non-zero if any fails.
+   every token, through the multi-token ``decode_k`` program
+   (on-device sampling, k tokens per dispatch), and through the
+   speculative engine (``serving/speculative.py`` — a seeded
+   ``--draft-layers`` draft proposing ``--spec-k`` tokens per target
+   verify dispatch). Trace counters incremented at trace time count
+   actual compiles; the bench **asserts** ``cached_traces == 1``,
+   ``recompute_traces == n_new_tokens``, ``decode_k_traces == 1``,
+   one propose + one verify trace with the speculative stream
+   bitwise-identical, a self-draft control accepting every proposal
+   (``spec_k + 1`` tokens per dispatch — the acceptance machinery's
+   structural ceiling), identical greedy streams, and ≤ 8 device→host
+   bytes per decoded token (DL110's observable) — the structural
+   claims that hold on every backend, independent of wall-clock noise
+   — and exits non-zero if any fails.
 2. **Offered-load sweep**. Poisson-less open-loop arrivals at each
    offered rate drive a real Engine; the ServingReport yields TTFT
    p50/p99, per-token latency, tokens/s, queue depth, and occupancy
@@ -140,6 +146,46 @@ def measure_decode_k(model, params, prompt, n_new, capacity, k=4):
             "tokens": req.tokens}
 
 
+def measure_speculative(model, params, draft, draft_params, prompt,
+                        n_new, capacity, spec_k):
+    """One speculative decode end to end: a 1-slot SpeculativeEngine
+    drives draft-propose/target-verify rounds. Called twice from
+    ``main``: once with a small seeded draft (the honest configuration
+    — a random draft accepts ~0 proposals, so acceptance there is data,
+    not a gate) and once SELF-DRAFTED (draft == target) where the
+    acceptance machinery must structurally yield acceptance 1.0 and
+    ``spec_k + 1`` tokens per dispatch. The trace claims hold in both:
+    ONE propose trace + ONE verify trace (DL108 over both programs) and
+    the greedy stream bitwise-equal to the plain cached decode. On a
+    CPU mesh the draft is not actually cheaper per-FLOP, so wall-clock
+    speedup is an honest null — acceptance_rate and tokens_per_dispatch
+    are the platform-independent part."""
+    from chainermn_tpu.serving import (EngineConfig, ServingReport,
+                                       SpeculativeEngine)
+
+    eng = SpeculativeEngine(
+        model, params, draft, draft_params,
+        EngineConfig(n_slots=1, capacity=capacity,
+                     max_new_tokens=n_new, prefill_cohort=1,
+                     buckets=[prompt.shape[1], capacity]),
+        spec_k=spec_k, report=ServingReport())
+    t0 = time.perf_counter()
+    req = eng.submit(prompt[0])
+    eng.run_until_drained()
+    wall = time.perf_counter() - t0
+    s = eng.report.summary()
+    return {"spec_k": spec_k,
+            "draft_layers": draft.n_layers,
+            "n_new_tokens": n_new,
+            "propose_traces": eng.draft.propose_traces,
+            "verify_traces": eng.verify_traces,
+            "wall_s": round(wall, 4),
+            "tokens_per_s": round(n_new / wall, 2),
+            "acceptance_rate": round(s["acceptance_rate"], 4),
+            "tokens_per_dispatch": round(s["tokens_per_dispatch"], 4),
+            "tokens": req.tokens}
+
+
 def sweep_point(model, params, offered_rps, args):
     """Open-loop arrivals at ``offered_rps`` requests/s against a real
     Engine; returns the ServingReport summary for the load point."""
@@ -198,6 +244,12 @@ def main(argv=None):
     ap.add_argument("--decode-k", type=int, default=4,
                     help="tokens per decode_k dispatch in the "
                          "multi-token measurement")
+    ap.add_argument("--spec-k", type=int, default=3,
+                    help="draft tokens per round in the speculative "
+                         "measurement")
+    ap.add_argument("--draft-layers", type=int, default=1,
+                    help="draft-model depth for the speculative "
+                         "measurement (0 disables it)")
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=8)
     ap.add_argument("--slots", type=int, default=4)
@@ -223,6 +275,30 @@ def main(argv=None):
     recompute = measure_recompute(model, params, prompt, args.new_tokens)
     multi = measure_decode_k(model, params, prompt, args.new_tokens,
                              args.capacity, k=args.decode_k)
+    spec = spec_self = None
+    if args.draft_layers > 0:
+        import jax.numpy as jnp
+
+        from chainermn_tpu.models.transformer import TransformerLM
+
+        draft = TransformerLM(vocab=args.vocab, d_model=args.d_model,
+                              n_heads=args.n_heads,
+                              n_layers=args.draft_layers,
+                              d_ff=2 * args.d_model,
+                              max_len=args.capacity,
+                              attention="reference", pos_emb="rope")
+        draft_params = draft.init(jax.random.PRNGKey(1),
+                                  jnp.zeros((1, 4), jnp.int32))["params"]
+        spec = measure_speculative(model, params, draft, draft_params,
+                                   prompt, args.new_tokens,
+                                   args.capacity, args.spec_k)
+        # self-draft control: prefill emits the first token, so the
+        # largest 1 + R*(spec_k+1) <= n_new keeps every round FULL —
+        # acceptance must then be exactly 1.0
+        r = max(1, (args.new_tokens - 1) // (args.spec_k + 1))
+        spec_self = measure_speculative(
+            model, params, model, params, prompt,
+            1 + r * (args.spec_k + 1), args.capacity, args.spec_k)
 
     # the structural proof: identical greedy streams, one compile vs
     # one compile PER LENGTH — and the multi-token program emits the
@@ -233,6 +309,18 @@ def main(argv=None):
           and multi["tokens"] == cached["tokens"]
           and multi["traces"] == 1
           and multi["host_bytes_per_token"] <= 8.0)
+    if spec is not None:
+        # the speculative engine must emit the SAME greedy stream from
+        # one propose trace + one verify trace; the self-draft control
+        # must accept EVERY proposal (spec_k + 1 tokens per dispatch)
+        # while staying on that same stream
+        n_self = len(spec_self["tokens"])
+        ok = (ok and spec["tokens"] == cached["tokens"]
+              and spec["propose_traces"] == 1
+              and spec["verify_traces"] == 1
+              and spec_self["tokens"] == cached["tokens"][:n_self]
+              and spec_self["acceptance_rate"] == 1.0
+              and spec_self["tokens_per_dispatch"] == args.spec_k + 1)
     record = {
         "metric": "serving_decode",
         "platform": backend,
@@ -246,6 +334,12 @@ def main(argv=None):
                               == multi["tokens"]),
         "trace_assertion_ok": ok,
     }
+    if spec is not None:
+        record["speculative"] = spec
+        record["speculative_self_draft"] = spec_self
+        record["streams_identical"] = (record["streams_identical"]
+                                       and spec["tokens"]
+                                       == cached["tokens"])
     if not args.skip_sweep:
         record["sweep"] = [
             sweep_point(model, params, float(l), args)
@@ -256,7 +350,13 @@ def main(argv=None):
               f"(cached={cached['traces']}, "
               f"recompute={recompute['traces']}, "
               f"decode_k={multi['traces']}, "
-              f"host_bytes/token={multi['host_bytes_per_token']})",
+              f"host_bytes/token={multi['host_bytes_per_token']}"
+              + (f", propose={spec['propose_traces']}, "
+                 f"verify={spec['verify_traces']}, "
+                 f"self_draft_acceptance={spec_self['acceptance_rate']}, "
+                 f"self_draft_tpd={spec_self['tokens_per_dispatch']}"
+                 if spec is not None else "")
+              + ")",
               file=sys.stderr)
         return 1
     return 0

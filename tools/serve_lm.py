@@ -12,7 +12,11 @@ same final output the unkilled run would have produced. That replay
 guarantee survives sampling too: each request's PRNG seed is derived
 from its id (``--seed + request_id``), so ``--temperature``/``--top-k``
 streams are as replayable as greedy ones (serving/sampling.py's
-one-split-per-token contract).
+one-split-per-token contract). ``--draft N`` swaps in the speculative
+engine (``serving/speculative.py``) with an N-layer draft model and
+``--kv-dtype int8-block`` selects quantized resident pages; both keep
+every replay guarantee because speculative streams are bitwise-
+identical to the plain engine's.
 
 Wrap it in the per-host restart loop for the fleet drill::
 
@@ -66,7 +70,8 @@ def serve(args):
 
     from chainermn_tpu.models.transformer import TransformerLM
     from chainermn_tpu.serving import (Engine, EngineConfig, ServingReport,
-                                       load_weights, publish_weights)
+                                       SpeculativeEngine, load_weights,
+                                       publish_weights)
     from chainermn_tpu.serving.weights import WeightsError
 
     model = TransformerLM(vocab=args.vocab, d_model=args.d_model,
@@ -86,15 +91,30 @@ def serve(args):
     else:
         params = init
 
-    eng = Engine(model, params,
-                 EngineConfig(n_slots=args.slots, capacity=args.capacity,
-                              max_new_tokens=args.max_new_tokens,
-                              prefill_cohort=1,
-                              buckets=[args.prompt_len, args.capacity],
-                              decode_k=args.decode_k,
-                              prefill_chunk=args.prefill_chunk,
-                              token_budget=args.token_budget),
-                 report=ServingReport())
+    cfg = EngineConfig(n_slots=args.slots, capacity=args.capacity,
+                       max_new_tokens=args.max_new_tokens,
+                       prefill_cohort=1,
+                       buckets=[args.prompt_len, args.capacity],
+                       decode_k=args.decode_k,
+                       prefill_chunk=args.prefill_chunk,
+                       token_budget=args.token_budget,
+                       kv_dtype=args.kv_dtype)
+    if args.draft:
+        # the draft model is derived from the seed, never warm-loaded:
+        # it only decides how far a round advances, so the replayed
+        # streams stay identical across restarts either way
+        draft = TransformerLM(vocab=args.vocab, d_model=args.d_model,
+                              n_heads=args.n_heads, n_layers=args.draft,
+                              d_ff=2 * args.d_model,
+                              max_len=args.capacity,
+                              attention="reference", pos_emb="rope")
+        draft_params = draft.init(jax.random.PRNGKey(args.seed + 1),
+                                  jnp.zeros((1, 4), jnp.int32))["params"]
+        eng = SpeculativeEngine(model, params, draft, draft_params, cfg,
+                                spec_k=args.spec_k, report=ServingReport())
+        _log(f"speculative: {args.draft}-layer draft, spec_k={args.spec_k}")
+    else:
+        eng = Engine(model, params, cfg, report=ServingReport())
 
     done = _done_ids(args.out)
     rng = np.random.RandomState(args.seed)
@@ -183,6 +203,17 @@ def main(argv=None):
     ap.add_argument("--token-budget", type=int, default=None,
                     help="per-iteration token budget shared by decode "
                          "and prefill (default: unbounded)")
+    ap.add_argument("--draft", type=int, default=0, metavar="N_LAYERS",
+                    help="speculative decode with an N_LAYERS draft "
+                         "model (seeded from --seed + 1); streams are "
+                         "bitwise-identical to the plain engine "
+                         "(default: off)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per speculative round")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=["f32", "int8-block"],
+                    help="paged-KV storage mode (int8-block trades a "
+                         "calibrated logit-error bound for ~4x slots)")
     ap.add_argument("--temperature", type=float, default=None,
                     help="sampling temperature (default: greedy argmax)")
     ap.add_argument("--top-k", type=int, default=None,
